@@ -1,0 +1,23 @@
+"""Test environment: force CPU backend with a virtual 8-device mesh.
+
+Must run before jax initializes its backend, hence env mutation at import
+time in conftest (pytest imports conftest before any test module).
+Multi-chip sharding tests (TP/EP/ring attention) run on these 8 virtual CPU
+devices; real-TPU behavior is exercised by bench.py and the driver's
+dryrun_multichip hook.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA:CPU's oneDNN matmuls run in reduced precision by default (~1e-1 abs
+# error on standard-normal f32 inputs), which swamps parity tolerances.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+import jax  # noqa: E402  (after env mutation, which is the point)
+
+jax.config.update("jax_default_matmul_precision", "highest")

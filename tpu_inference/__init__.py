@@ -1,0 +1,28 @@
+"""tpu_inference — a TPU-native distributed LLM inference framework.
+
+This package is the in-tree server half that the reference repo
+(`anthonychiuhy/distributed-llm-inference`, see SURVEY.md) delegates to an
+external Ollama endpoint (reference: traffic_generator/main.py:306). Everything
+here is designed TPU-first:
+
+- models/   pure-function JAX model definitions (Llama, Mixtral, GPT-2) over
+            parameter pytrees; bfloat16 matmuls on the MXU, f32 accumulation.
+- kernels/  Pallas TPU kernels (paged attention) + dense jnp reference paths.
+- engine/   paged KV cache (HBM block pool), continuous-batching scheduler,
+            prefill/decode compiled as separate bucketed XLA graphs, sampling,
+            speculative decoding.
+- parallel/ jax.sharding.Mesh construction, TP/EP NamedSharding specs, ring
+            attention (shard_map + ppermute) for sequence parallelism.
+- server/   aiohttp HTTP server speaking the Ollama /api/generate NDJSON
+            protocol (wire contract: SURVEY.md §2c) so the benchmark harness
+            drives a TPU slice unchanged.
+"""
+
+__version__ = "0.1.0"
+
+from tpu_inference.config import (  # noqa: F401
+    EngineConfig,
+    ModelConfig,
+    ParallelConfig,
+    ServerConfig,
+)

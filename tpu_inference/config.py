@@ -1,0 +1,216 @@
+"""Typed configuration for models, engine, parallelism, and server.
+
+The reference framework configures itself with a module-level dict literal
+(reference: traffic_generator/main.py:302-313) and three module constants
+(main.py:298-300). Here configuration is typed dataclasses; the harness-facing
+dict keys (`url`, `model`, `temperature`, `max_tokens`, `trace_path`,
+`data_path`, `max_trace`, `log_path`) are preserved by the client harness in
+`traffic_generator/` so existing configs keep working.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only transformer.
+
+    Covers Llama-style (RMSNorm/RoPE/GQA/SwiGLU), Mixtral (adds MoE fields)
+    and GPT-2 (LayerNorm/learned-positional/GELU) families.
+    """
+
+    name: str = "llama"
+    family: str = "llama"  # "llama" | "mixtral" | "gpt2"
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # MoE (Mixtral family); n_experts == 0 means dense FFN.
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
+    # Static per-expert token capacity = ceil(k*T/E * factor); overflow drops.
+    expert_capacity_factor: float = 2.0
+    # GPT-2 family uses learned positional embeddings + LayerNorm with bias.
+    use_learned_pos: bool = False
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_rep(self) -> int:
+        """Query heads per KV head (GQA group size)."""
+        return self.n_heads // self.n_kv_heads
+
+    def validate(self) -> None:
+        assert self.d_model % self.n_heads == 0
+        assert self.n_heads % self.n_kv_heads == 0
+        if self.n_experts:
+            assert self.n_experts_per_tok <= self.n_experts
+
+
+# ---------------------------------------------------------------------------
+# Presets. Tiny variants are for tests (random init, CPU-mesh friendly).
+# ---------------------------------------------------------------------------
+
+def llama3_8b() -> ModelConfig:
+    return ModelConfig(
+        name="llama-3-8b", family="llama", vocab_size=128256, d_model=4096,
+        n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        max_seq_len=8192, rope_theta=500000.0,
+    )
+
+
+def llama3_70b() -> ModelConfig:
+    return ModelConfig(
+        name="llama-3-70b", family="llama", vocab_size=128256, d_model=8192,
+        n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672,
+        max_seq_len=8192, rope_theta=500000.0,
+    )
+
+
+def mixtral_8x7b() -> ModelConfig:
+    return ModelConfig(
+        name="mixtral-8x7b", family="mixtral", vocab_size=32000, d_model=4096,
+        n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        max_seq_len=8192, rope_theta=1000000.0, n_experts=8,
+        n_experts_per_tok=2,
+    )
+
+
+def gpt2_small() -> ModelConfig:
+    return ModelConfig(
+        name="gpt2", family="gpt2", vocab_size=50257, d_model=768,
+        n_layers=12, n_heads=12, n_kv_heads=12, d_ff=3072,
+        max_seq_len=1024, norm_eps=1e-5, use_learned_pos=True, use_bias=True,
+        tie_embeddings=True,
+    )
+
+
+def tiny_llama(vocab_size: int = 512) -> ModelConfig:
+    """Small Llama for unit tests; dims chosen TPU-tile friendly."""
+    return ModelConfig(
+        name="tiny-llama", family="llama", vocab_size=vocab_size, d_model=128,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, max_seq_len=1024,
+        rope_theta=10000.0, dtype=jnp.float32,
+    )
+
+
+def tiny_mixtral(vocab_size: int = 512) -> ModelConfig:
+    return ModelConfig(
+        name="tiny-mixtral", family="mixtral", vocab_size=vocab_size,
+        d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        max_seq_len=1024, rope_theta=10000.0, n_experts=4,
+        n_experts_per_tok=2, dtype=jnp.float32,
+    )
+
+
+def tiny_gpt2(vocab_size: int = 512) -> ModelConfig:
+    return ModelConfig(
+        name="tiny-gpt2", family="gpt2", vocab_size=vocab_size, d_model=128,
+        n_layers=2, n_heads=4, n_kv_heads=4, d_ff=256, max_seq_len=512,
+        use_learned_pos=True, use_bias=True, tie_embeddings=True,
+        dtype=jnp.float32,
+    )
+
+
+PRESETS = {
+    "llama-3-8b": llama3_8b,
+    "llama-3-70b": llama3_70b,
+    "mixtral-8x7b": mixtral_8x7b,
+    "gpt2": gpt2_small,
+    "tiny-llama": tiny_llama,
+    "tiny-mixtral": tiny_mixtral,
+    "tiny-gpt2": tiny_gpt2,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Device-mesh axes. Axis size 1 disables that axis.
+
+    The mesh is (dp, tp, sp). TP shards attention heads and FFN hidden dim
+    with XLA all-reduce over ICI; EP (Mixtral) reuses the tp axis for experts;
+    SP (ring attention / sequence parallelism) shards the sequence dim.
+    """
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.tp * self.sp
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-engine knobs: paging, batching, bucketing."""
+
+    # Paged KV cache.
+    page_size: int = 16               # tokens per KV page
+    num_pages: int = 512              # pool size (per chip, per model)
+    max_pages_per_seq: int = 64       # => max context = page_size * this
+    # Continuous batching.
+    max_batch_size: int = 8           # decode slots in the batched graph
+    max_queue_len: int = 512
+    # Prefill bucketing: prompt is right-padded up to the nearest bucket so
+    # XLA compiles a bounded number of prefill graphs.
+    prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024)
+    chunked_prefill_size: int = 0     # 0 = whole-prompt prefill
+    # Sampling defaults (overridable per request).
+    temperature: float = 0.0          # 0 => greedy
+    top_k: int = 0                    # 0 => disabled
+    top_p: float = 1.0
+    max_new_tokens: int = 1024
+    # Speculative decoding (0 = off).
+    num_speculative_tokens: int = 0
+
+    @property
+    def max_context(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerConfig:
+    """HTTP server config (Ollama-protocol endpoint, SURVEY.md §2c)."""
+
+    host: str = "127.0.0.1"
+    port: int = 11434
+    model_name: str = "tiny-llama"    # name echoed in NDJSON records
+    tokenizer: str = "byte"           # "byte" | path to HF tokenizer
+    request_timeout_s: float = 600.0
+    # Hold HTTP headers until the first token is ready so client-side TTFT
+    # (first streamed chunk) matches header-arrival time (SURVEY.md §2c).
+    defer_headers_until_first_token: bool = True
+
+
+@dataclasses.dataclass
+class FrameworkConfig:
+    """Top-level bundle used by the CLI and server entry point."""
+
+    model: ModelConfig = dataclasses.field(default_factory=tiny_llama)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+    server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
+    checkpoint_path: Optional[str] = None  # HF safetensors dir; None = random init
+    seed: int = 0

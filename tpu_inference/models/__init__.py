@@ -1,0 +1,13 @@
+"""Pure-function JAX model definitions over parameter pytrees.
+
+Each family module exposes:
+  init_params(cfg, key)            -> params pytree (random init)
+  forward(params, cfg, tokens, positions, kv, attn) -> (logits, kv)
+
+where ``attn`` is an AttentionFn injected by the caller (engine supplies the
+paged-cache implementation; tests supply dense causal attention). This keeps
+model math independent of KV-cache policy, sharding, and batching strategy.
+"""
+
+from tpu_inference.models import common, gpt2, llama, mixtral  # noqa: F401
+from tpu_inference.models.registry import build_model, get_model_fns  # noqa: F401

@@ -1,0 +1,130 @@
+"""Shared transformer building blocks (TPU-idiomatic JAX).
+
+Conventions:
+- Activations flow in ``cfg.dtype`` (bfloat16 in production) so matmuls hit
+  the MXU at full rate; normalization statistics and attention softmax
+  accumulate in float32.
+- All functions are pure and shape-static, safe under ``jax.jit``.
+- Attention is *injected*: model forward passes take an ``AttentionFn``
+  ``attn(layer_idx, q, k, v, kv) -> (out, kv)`` with q [B,S,Hq,D] and
+  k/v [B,S,Hkv,D]; the engine's paged-cache attention, the dense causal
+  test path, and the Pallas kernels all fit this signature.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# attn(layer_idx, q, k, v, kv_state) -> (attn_out, kv_state)
+AttentionFn = Callable[[int, jax.Array, jax.Array, jax.Array, Any],
+                       Tuple[jax.Array, Any]]
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with float32 statistics, output in x.dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array,
+               eps: float) -> jax.Array:
+    """LayerNorm (GPT-2 family) with float32 statistics."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    normed = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    """Inverse frequencies for rotary embeddings, [head_dim // 2] f32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta ** exponent)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding.
+
+    x: [B, S, H, D]; positions: [B, S] int32. Uses the half-split pairing
+    (first half with second half), matching HF Llama's rotate_half.
+    """
+    half = x.shape[-1] // 2
+    inv_freq = rope_frequencies(x.shape[-1], theta)           # [half]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,half]
+    cos = jnp.cos(angles)[:, :, None, :]                      # [B,S,1,half]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """Expand KV heads for GQA: [B, S, Hkv, D] -> [B, S, Hkv*n_rep, D]."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           q_offset: jax.Array | int = 0,
+                           kv_len: jax.Array | None = None) -> jax.Array:
+    """Dense causal attention; the correctness reference for all kernels.
+
+    q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] (GQA expanded internally).
+    ``q_offset`` is the absolute position of q's first token within the KV
+    sequence (for chunked prefill / decode against a cache). ``kv_len``
+    masks out cache slots beyond the valid length. Softmax in float32.
+    """
+    b, sq, hq, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    k = repeat_kv(k, hq // hkv)
+    v = repeat_kv(v, hq // hkv)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    # [B, H, Sq, Skv]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    q_pos = q_offset + jnp.arange(sq)[:, None]           # [Sq, 1]
+    k_pos = jnp.arange(skv)[None, :]                     # [1, Skv]
+    mask = k_pos <= q_pos                                # causal
+    if kv_len is not None:
+        valid = k_pos < jnp.reshape(kv_len, (-1, 1, 1, 1))
+        mask = jnp.logical_and(mask, valid)
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def make_dense_attn(theta_unused: float = 0.0) -> AttentionFn:
+    """AttentionFn for cache-free full-sequence forward (tests, parity)."""
+
+    def attn(layer_idx: int, q, k, v, kv):
+        del layer_idx
+        return dense_causal_attention(q, k, v), kv
+
+    return attn
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU FFN: down( silu(x @ gate) * (x @ up) )."""
+    gate = jax.nn.silu(jnp.dot(x, w_gate, preferred_element_type=jnp.float32))
+    up = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
+    return jnp.dot((gate * up).astype(x.dtype), w_down,
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def linear(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
+    out = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)

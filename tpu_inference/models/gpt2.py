@@ -1,0 +1,98 @@
+"""GPT-2 family (LayerNorm + learned positions + GELU, fused QKV) in pure JAX.
+
+Serves the harness-parity config 0 ("CPU gpt2 HTTP stub", BASELINE.json
+configs[0]). Same stacked-layer ``lax.scan`` structure and injected-attention
+design as models/llama.py. Parity is pinned against HF ``GPT2LMHeadModel`` in
+tests/test_gpt2_parity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_inference.config import ModelConfig
+from tpu_inference.models.common import AttentionFn, layer_norm, linear
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    cfg.validate()
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    keys = jax.random.split(key, 6)
+
+    def norm(k, shape):
+        return (0.02 * jax.random.normal(k, shape, jnp.float32)).astype(cfg.dtype)
+
+    return {
+        "embed": norm(keys[0], (cfg.vocab_size, d)),
+        "pos_embed": norm(keys[1], (cfg.max_seq_len, d)),
+        "blocks": {
+            "ln1_w": jnp.ones((L, d), cfg.dtype),
+            "ln1_b": jnp.zeros((L, d), cfg.dtype),
+            "w_qkv": norm(keys[2], (L, d, 3 * d)),
+            "b_qkv": jnp.zeros((L, 3 * d), cfg.dtype),
+            "w_proj": norm(keys[3], (L, d, d)),
+            "b_proj": jnp.zeros((L, d), cfg.dtype),
+            "ln2_w": jnp.ones((L, d), cfg.dtype),
+            "ln2_b": jnp.zeros((L, d), cfg.dtype),
+            "w_fc": norm(keys[4], (L, d, f)),
+            "b_fc": jnp.zeros((L, f), cfg.dtype),
+            "w_out": norm(keys[5], (L, f, d)),
+            "b_out": jnp.zeros((L, d), cfg.dtype),
+        },
+        "ln_f_w": jnp.ones((d,), cfg.dtype),
+        "ln_f_b": jnp.zeros((d,), cfg.dtype),
+    }
+
+
+def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
+           positions: jax.Array, kv: Any, attn: AttentionFn):
+    b, s, d = x.shape
+    hd = cfg.head_dim
+
+    h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
+    qkv = linear(h, lp["w_qkv"], lp["b_qkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, cfg.n_heads, hd)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
+
+    attn_out, kv = attn(layer_idx, q, k, v, kv)
+    attn_out = attn_out.reshape(b, s, d)
+    x = x + linear(attn_out, lp["w_proj"], lp["b_proj"])
+
+    h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
+    h = jax.nn.gelu(linear(h, lp["w_fc"], lp["b_fc"]), approximate=True)
+    x = x + linear(h, lp["w_out"], lp["b_out"])
+    return x, kv
+
+
+def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                   positions: jax.Array, kv: Any,
+                   attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    x = (params["embed"][tokens] + params["pos_embed"][positions]).astype(cfg.dtype)
+
+    def body(carry, scanned):
+        x, kv = carry
+        layer_idx, lp = scanned
+        x, kv = _block(cfg, layer_idx, lp, x, positions, kv, attn)
+        return (x, kv), None
+
+    layer_ids = jnp.arange(cfg.n_layers)
+    (x, kv), _ = jax.lax.scan(body, (x, kv), (layer_ids, params["blocks"]))
+    x = layer_norm(x, params["ln_f_w"], params["ln_f_b"], cfg.norm_eps)
+    return x, kv
+
+
+def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    return jnp.dot(hidden, params["embed"].T,
+                   preferred_element_type=jnp.float32)
+
+
+def forward(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, kv: Any,
+            attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    hidden, kv = forward_hidden(params, cfg, tokens, positions, kv, attn)
+    return unembed(params, cfg, hidden), kv

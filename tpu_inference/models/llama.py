@@ -1,0 +1,121 @@
+"""Llama-family decoder (RMSNorm + RoPE + GQA + SwiGLU) as pure JAX.
+
+TPU-first design notes:
+- Per-layer weights are **stacked along a leading layer axis** and the block
+  stack runs under ``jax.lax.scan`` — one traced layer body regardless of
+  depth, so Llama-70B (80 layers) compiles as fast as the tiny test model.
+- Activations are cfg.dtype (bf16 in production) feeding the MXU; norms and
+  softmax accumulate f32 (see models/common.py).
+- Attention is injected (AttentionFn), so the same forward serves full-context
+  parity tests, paged-KV decode, and Pallas kernels.
+
+Functional parity target: the reference repo has no model code (SURVEY.md §0);
+this implements the server-side model the reference delegates to an external
+Ollama endpoint (reference: traffic_generator/main.py:306). Correctness is
+pinned against HuggingFace ``LlamaForCausalLM`` in tests/test_llama_parity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_inference.config import ModelConfig
+from tpu_inference.models.common import (
+    AttentionFn,
+    apply_rope,
+    rms_norm,
+    swiglu,
+)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    """Random init (normal, 0.02 std) with stacked layer weights."""
+    cfg.validate()
+    d, f = cfg.d_model, cfg.d_ff
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 8)
+
+    def norm(k, shape):
+        return (0.02 * jax.random.normal(k, shape, jnp.float32)).astype(cfg.dtype)
+
+    L = cfg.n_layers
+    params = {
+        "embed": norm(keys[0], (cfg.vocab_size, d)),
+        "blocks": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": norm(keys[1], (L, d, cfg.n_heads * hd)),
+            "wk": norm(keys[2], (L, d, cfg.n_kv_heads * hd)),
+            "wv": norm(keys[3], (L, d, cfg.n_kv_heads * hd)),
+            "wo": norm(keys[4], (L, cfg.n_heads * hd, d)),
+            "ffn_norm": jnp.ones((L, d), cfg.dtype),
+            "w_gate": norm(keys[5], (L, d, f)),
+            "w_up": norm(keys[6], (L, d, f)),
+            "w_down": norm(keys[7], (L, f, d)),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(jax.random.split(keys[0])[0],
+                                 (d, cfg.vocab_size))
+    return params
+
+
+def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
+           positions: jax.Array, kv: Any, attn: AttentionFn):
+    """One transformer block. x: [B, S, D]."""
+    b, s, d = x.shape
+    hd = cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.dot(h, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+    k = jnp.dot(h, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+    v = jnp.dot(h, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = q.reshape(b, s, cfg.n_heads, hd)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+
+    attn_out, kv = attn(layer_idx, q, k, v, kv)
+    attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
+    x = x + jnp.dot(attn_out, lp["wo"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+    return x, kv
+
+
+def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                   positions: jax.Array, kv: Any,
+                   attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    """Token ids -> final hidden states. tokens, positions: [B, S]."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def body(carry, scanned):
+        x, kv = carry
+        layer_idx, lp = scanned
+        x, kv = _block(cfg, layer_idx, lp, x, positions, kv, attn)
+        return (x, kv), None
+
+    layer_ids = jnp.arange(cfg.n_layers)
+    (x, kv), _ = jax.lax.scan(body, (x, kv), (layer_ids, params["blocks"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x, kv
+
+
+def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """Hidden states -> f32 logits."""
+    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return jnp.dot(hidden, w, preferred_element_type=jnp.float32)
+
+
+def forward(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, kv: Any,
+            attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    """Convenience: full-sequence logits (tests / tiny models)."""
+    hidden, kv = forward_hidden(params, cfg, tokens, positions, kv, attn)
+    return unembed(params, cfg, hidden), kv

@@ -1,0 +1,159 @@
+"""Mixtral family: Llama-style attention + sparse MoE FFN, in pure JAX.
+
+TPU-first MoE formulation: token-choice top-k routing expressed as **static
+dispatch/combine einsums** (Switch-Transformer / Mesh-TF style) instead of
+ragged gather/scatter — every shape is static so XLA tiles the expert matmuls
+on the MXU, and the expert axis shards cleanly for expert parallelism (each
+chip computes its local experts; the dispatch/combine einsums become
+all-to-alls over ICI under a NamedSharding on the expert dim — see
+parallel/shardings.py).
+
+Capacity model: each expert processes at most C = ceil(k*T/E * factor) tokens
+per call; overflow tokens lose that expert's contribution (standard capacity
+dropping). Tests pin routing math against HF ``MixtralForCausalLM``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_inference.config import ModelConfig
+from tpu_inference.models.common import AttentionFn, apply_rope, rms_norm
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    cfg.validate()
+    d, f, L, E = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.n_experts
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 10)
+
+    def norm(k, shape):
+        return (0.02 * jax.random.normal(k, shape, jnp.float32)).astype(cfg.dtype)
+
+    params = {
+        "embed": norm(keys[0], (cfg.vocab_size, d)),
+        "blocks": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "wq": norm(keys[1], (L, d, cfg.n_heads * hd)),
+            "wk": norm(keys[2], (L, d, cfg.n_kv_heads * hd)),
+            "wv": norm(keys[3], (L, d, cfg.n_kv_heads * hd)),
+            "wo": norm(keys[4], (L, cfg.n_heads * hd, d)),
+            "ffn_norm": jnp.ones((L, d), cfg.dtype),
+            "w_router": norm(keys[5], (L, d, E)),
+            "w_gate": norm(keys[6], (L, E, d, f)),
+            "w_up": norm(keys[7], (L, E, d, f)),
+            "w_down": norm(keys[8], (L, E, f, d)),
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": norm(keys[9], (d, cfg.vocab_size)),
+    }
+    return params
+
+
+def expert_capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    """Static per-expert capacity for a call processing n_tokens tokens."""
+    c = math.ceil(cfg.n_experts_per_tok * n_tokens / cfg.n_experts
+                  * cfg.expert_capacity_factor)
+    return max(c, cfg.n_experts_per_tok)
+
+
+def moe_ffn(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
+    """Sparse MoE FFN. x: [B, S, D] -> [B, S, D].
+
+    Dispatch/combine are dense one-hot einsums with static shapes:
+      dispatch [T, E, C] maps tokens into per-expert buffers,
+      expert_in = einsum('tec,td->ecd'), experts run as one batched matmul
+      over the leading E axis, combine applies routing weights on the way out.
+    """
+    b, s, d = x.shape
+    t = b * s
+    e, k = cfg.n_experts, cfg.n_experts_per_tok
+    cap = expert_capacity(cfg, t)
+    x2 = x.reshape(t, d)
+
+    router_logits = jnp.dot(x2, lp["w_router"],
+                            preferred_element_type=jnp.float32)  # [T, E]
+    top_vals, top_idx = jax.lax.top_k(router_logits, k)          # [T, k]
+    # Mixtral normalizes softmax over the selected k logits only.
+    top_w = jax.nn.softmax(top_vals, axis=-1)                    # [T, k] f32
+    choice_oh = jax.nn.one_hot(top_idx, e, dtype=jnp.int32)      # [T, k, E]
+    mask = jnp.sum(choice_oh, axis=1)                            # [T, E] {0,1}
+    combine_w = jnp.einsum("tk,tke->te", top_w,
+                           choice_oh.astype(jnp.float32))        # [T, E]
+
+    # Position of each token within its expert's buffer; one_hot maps
+    # out-of-range (dropped / unrouted) positions to all-zero rows.
+    pos = jnp.cumsum(mask, axis=0) * mask - 1                    # [T, E]
+    dispatch = jax.nn.one_hot(pos, cap, dtype=cfg.dtype)         # [T, E, C]
+    dispatch = dispatch * mask[..., None].astype(cfg.dtype)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x2,
+                           preferred_element_type=jnp.float32).astype(cfg.dtype)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"],
+                                  preferred_element_type=jnp.float32))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"],
+                    preferred_element_type=jnp.float32)
+    expert_out = jnp.einsum("ecf,efd->ecd", (gate * up).astype(cfg.dtype),
+                            lp["w_down"],
+                            preferred_element_type=jnp.float32)  # [E, C, D] f32
+
+    combine = dispatch.astype(jnp.float32) * combine_w[..., None]
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out.astype(x.dtype).reshape(b, s, d)
+
+
+def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
+           positions: jax.Array, kv: Any, attn: AttentionFn):
+    b, s, d = x.shape
+    hd = cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.dot(h, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
+    k = jnp.dot(h, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
+    v = jnp.dot(h, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions, cfg.rope_theta)
+    k = apply_rope(k.reshape(b, s, cfg.n_kv_heads, hd), positions,
+                   cfg.rope_theta)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
+
+    attn_out, kv = attn(layer_idx, q, k, v, kv)
+    attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
+    x = x + jnp.dot(attn_out, lp["wo"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+    x = x + moe_ffn(cfg, lp, h)
+    return x, kv
+
+
+def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                   positions: jax.Array, kv: Any,
+                   attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def body(carry, scanned):
+        x, kv = carry
+        layer_idx, lp = scanned
+        x, kv = _block(cfg, layer_idx, lp, x, positions, kv, attn)
+        return (x, kv), None
+
+    layer_ids = jnp.arange(cfg.n_layers)
+    (x, kv), _ = jax.lax.scan(body, (x, kv), (layer_ids, params["blocks"]))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return x, kv
+
+
+def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    return jnp.dot(hidden, params["lm_head"],
+                   preferred_element_type=jnp.float32)
+
+
+def forward(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, kv: Any,
+            attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    hidden, kv = forward_hidden(params, cfg, tokens, positions, kv, attn)
+    return unembed(params, cfg, hidden), kv

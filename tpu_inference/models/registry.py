@@ -1,0 +1,23 @@
+"""Model family registry: maps ModelConfig.family -> module of pure fns."""
+
+from __future__ import annotations
+
+import types
+from typing import Tuple
+
+import jax
+
+from tpu_inference.config import ModelConfig
+
+
+def get_model_fns(cfg: ModelConfig) -> types.ModuleType:
+    from tpu_inference.models import gpt2, llama, mixtral
+
+    return {"llama": llama, "mixtral": mixtral, "gpt2": gpt2}[cfg.family]
+
+
+def build_model(cfg: ModelConfig, seed: int = 0) -> Tuple[dict, types.ModuleType]:
+    """Random-init params + family module."""
+    mod = get_model_fns(cfg)
+    params = mod.init_params(cfg, jax.random.PRNGKey(seed))
+    return params, mod

