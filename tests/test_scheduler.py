@@ -1,0 +1,109 @@
+"""Continuous-batching scheduler: admission, streaming callbacks, cancel."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_inference import config as cfgs
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.scheduler import EngineScheduler
+from tpu_inference.models import build_model
+
+
+@pytest.fixture(scope="module")
+def engine():
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    engine_cfg = cfgs.EngineConfig(
+        page_size=8, num_pages=128, max_pages_per_seq=8, max_batch_size=4,
+        prefill_buckets=(16, 32))
+    params, _ = build_model(model_cfg, seed=0)
+    return InferenceEngine(model_cfg, engine_cfg, params=params)
+
+
+def _submit_and_wait(sched, seqs, timeout=120.0):
+    events = {s.request_id: [] for s in seqs}
+    done = {s.request_id: threading.Event() for s in seqs}
+
+    for s in seqs:
+        sched.submit(
+            s,
+            on_token=lambda sq, t: events[sq.request_id].append(t),
+            on_finish=lambda sq: done[sq.request_id].set())
+    for s in seqs:
+        assert done[s.request_id].wait(timeout), f"request {s.request_id} hung"
+    return events
+
+
+def test_scheduler_streams_all_requests(engine):
+    sched = EngineScheduler(engine).start()
+    rng = np.random.default_rng(0)
+    seqs = [Sequence(request_id=i,
+                     prompt_tokens=rng.integers(0, 256, size=5 + i).tolist(),
+                     max_new_tokens=6) for i in range(6)]  # > max_batch_size
+    events = _submit_and_wait(sched, seqs)
+    for s in seqs:
+        assert events[s.request_id] == s.generated
+        assert len(s.generated) == 6
+        assert s.finish_reason == "length"
+    stats = sched.stats.snapshot(engine)
+    assert stats["requests_finished"] == 6
+    assert stats["kv_pages_in_use"] == 0          # everything released
+    sched.stop()
+
+
+def test_scheduler_queue_overflow(engine):
+    ecfg = engine.engine_cfg
+    sched = EngineScheduler(engine)   # not started: queue only fills
+    finished = []
+    for i in range(ecfg.max_queue_len + 3):
+        s = Sequence(request_id=1000 + i, prompt_tokens=[1, 2, 3],
+                     max_new_tokens=1)
+        sched.submit(s, on_token=lambda *a: None,
+                     on_finish=lambda sq: finished.append(sq))
+    assert len(finished) == 3
+    assert all(s.finish_reason == "queue_full" for s in finished)
+    assert sched.stats.requests_rejected == 3
+
+
+def test_scheduler_rejects_too_large(engine):
+    """A request that can never fit must be rejected, not block the queue."""
+    sched = EngineScheduler(engine)
+    finished = []
+    s = Sequence(request_id=500, prompt_tokens=[1] * 10,
+                 max_new_tokens=10**6)
+    s2 = Sequence(request_id=501, prompt_tokens=[1] * 200 * 8,
+                  max_new_tokens=1)        # prompt alone exceeds the pool
+    for seq in (s, s2):
+        sched.submit(seq, on_token=lambda *a: None,
+                     on_finish=lambda sq: finished.append(sq))
+    # request 500 is admittable (need capped at max_pages_per_seq=8);
+    # request 501's prompt alone busts the 127-page pool? No: prompt is
+    # clamped to max_context on prefill, so reservation caps too — both fit.
+    assert all(f.finish_reason != "too_large" for f in finished)
+    small = EngineScheduler(
+        __import__("tpu_inference.engine.engine", fromlist=["InferenceEngine"])
+        .InferenceEngine(engine.model_cfg,
+                         cfgs.EngineConfig(page_size=8, num_pages=4,
+                                           max_pages_per_seq=64,
+                                           max_batch_size=2,
+                                           prefill_buckets=(16,)),
+                         params=engine.params))
+    s3 = Sequence(request_id=502, prompt_tokens=[1] * 10, max_new_tokens=512)
+    small.submit(s3, on_token=lambda *a: None,
+                 on_finish=lambda sq: finished.append(sq))
+    assert s3.finish_reason == "too_large"
+
+
+def test_scheduler_cancel_queued(engine):
+    sched = EngineScheduler(engine)   # not started
+    s = Sequence(request_id=77, prompt_tokens=[1, 2], max_new_tokens=5)
+    sched.submit(s, on_token=lambda *a: None, on_finish=lambda *a: None)
+    sched.cancel(77)
+    assert s.finish_reason == "cancelled"
+    # Starting afterwards must not execute the cancelled request.
+    sched.start()
+    time.sleep(0.3)
+    assert s.generated == []
+    sched.stop()

@@ -1,0 +1,144 @@
+"""Hermetic HTTP server tests: the exact wire contract the benchmark
+harness depends on (SURVEY.md §2c), served by a tiny random-init model."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpu_inference.config import (EngineConfig, FrameworkConfig, ServerConfig,
+                                  tiny_llama)
+from tpu_inference.server.http import InferenceServer
+
+FINAL_FIELDS = {"model", "created_at", "response", "done", "done_reason",
+                "context", "total_duration", "load_duration",
+                "prompt_eval_count", "prompt_eval_duration", "eval_count",
+                "eval_duration"}
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=8,
+                            max_batch_size=4, prefill_buckets=(16, 32, 64)),
+        server=ServerConfig(model_name="tiny-llama", tokenizer="byte"))
+    return InferenceServer(cfg)
+
+
+def _run(server, coro_fn):
+    async def wrapper():
+        app = server.make_app()
+        async with TestClient(TestServer(app)) as client:
+            return await coro_fn(client)
+
+    return asyncio.run(wrapper())
+
+
+def test_streaming_ndjson_contract(server):
+    async def go(client):
+        resp = await client.post("/api/generate", json={
+            "model": "tiny-llama", "prompt": "Hello TPU",
+            "temperature": 0.0, "max_tokens": 8, "stream": True})
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("application/x-ndjson")
+        raw = await resp.read()
+        lines = [json.loads(l) for l in raw.splitlines()]
+        assert len(lines) >= 2
+        for line in lines[:-1]:
+            assert line["done"] is False
+            assert set(line) == {"model", "created_at", "response", "done"}
+            assert line["model"] == "tiny-llama"
+        final = lines[-1]
+        assert final["done"] is True
+        assert FINAL_FIELDS <= set(final)
+        assert final["eval_count"] == 8 or final["done_reason"] == "stop"
+        assert final["prompt_eval_count"] == len("Hello TPU") + 1  # +BOS
+        assert final["prompt_eval_duration"] > 0
+        assert final["total_duration"] > 0
+        assert len(final["context"]) == final["prompt_eval_count"] + final["eval_count"]
+        return lines
+
+    _run(server, go)
+
+
+def test_non_streaming_single_object(server):
+    async def go(client):
+        resp = await client.post("/api/generate", json={
+            "prompt": "abc", "stream": False, "max_tokens": 5})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["done"] is True
+        assert isinstance(body["response"], str)
+        assert FINAL_FIELDS <= set(body)
+        return body
+
+    _run(server, go)
+
+
+def test_options_num_predict_honored(server):
+    """Ollama-placement options.num_predict must control generation length."""
+    async def go(client):
+        resp = await client.post("/api/generate", json={
+            "prompt": "xyz", "stream": False, "max_tokens": 99,
+            "options": {"num_predict": 3, "temperature": 0.0}})
+        body = await resp.json()
+        assert body["eval_count"] == 3 or body["done_reason"] == "stop"
+        return body
+
+    _run(server, go)
+
+
+def test_greedy_is_deterministic(server):
+    async def go(client):
+        outs = []
+        for _ in range(2):
+            resp = await client.post("/api/generate", json={
+                "prompt": "determinism", "stream": False, "max_tokens": 6,
+                "temperature": 0.0})
+            outs.append((await resp.json())["context"])
+        assert outs[0] == outs[1]
+
+    _run(server, go)
+
+
+def test_bad_requests(server):
+    async def go(client):
+        r1 = await client.post("/api/generate", data=b"{not json")
+        assert r1.status == 400
+        r2 = await client.post("/api/generate", json={"model": "x"})
+        assert r2.status == 400
+        return r1, r2
+
+    _run(server, go)
+
+
+def test_aux_routes(server):
+    async def go(client):
+        assert (await client.get("/healthz")).status == 200
+        tags = await (await client.get("/api/tags")).json()
+        assert tags["models"][0]["name"] == "tiny-llama"
+        metrics = await (await client.get("/metrics")).json()
+        assert "kv_pages_in_use" in metrics
+        version = await (await client.get("/api/version")).json()
+        assert "version" in version
+
+    _run(server, go)
+
+
+def test_concurrent_requests_interleave(server):
+    """Multiple in-flight requests (continuous batching through HTTP)."""
+    async def go(client):
+        async def one(i):
+            resp = await client.post("/api/generate", json={
+                "prompt": f"request {i}", "stream": False, "max_tokens": 6})
+            return await resp.json()
+
+        bodies = await asyncio.gather(*[one(i) for i in range(6)])
+        for b in bodies:
+            assert b["done"] is True
+            assert b["eval_count"] >= 1
+        return bodies
+
+    _run(server, go)

@@ -1,0 +1,38 @@
+"""Byte tokenizer + incremental UTF-8-safe stream decoding."""
+
+from tpu_inference.server.tokenizer import (ByteTokenizer, IncrementalDecoder,
+                                            build_tokenizer)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello, world! héllo 🌍"
+    ids = tok.encode(text, add_bos=False)
+    assert tok.decode(ids) == text
+    with_bos = tok.encode(text)
+    assert with_bos[0] == tok.bos_token_id
+    assert tok.decode(with_bos) == text  # specials stripped
+
+
+def test_incremental_decoder_splits_utf8():
+    tok = ByteTokenizer()
+    text = "héllo🌍x"
+    ids = tok.encode(text, add_bos=False)
+    dec = IncrementalDecoder(tok)
+    chunks = [dec.push(i) for i in ids]
+    # No chunk may contain a replacement char (split multibyte held back).
+    assert all("�" not in c for c in chunks)
+    assert "".join(chunks) + dec.flush() == text
+
+
+def test_incremental_decoder_one_byte_at_a_time_ascii():
+    tok = ByteTokenizer()
+    dec = IncrementalDecoder(tok)
+    out = [dec.push(i) for i in tok.encode("abc", add_bos=False)]
+    assert out == ["a", "b", "c"]
+
+
+def test_build_tokenizer_byte():
+    tok = build_tokenizer("byte", vocab_size=512)
+    assert tok.vocab_size == 512
+    assert tok.eos_token_id == 257

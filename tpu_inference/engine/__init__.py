@@ -1,0 +1,2 @@
+"""Serving engine: paged KV cache, compiled prefill/decode graphs,
+continuous-batching scheduler, sampling, speculative decoding."""

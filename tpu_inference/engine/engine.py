@@ -1,0 +1,353 @@
+"""The inference engine: bucketed prefill + batched decode as two XLA graphs.
+
+TPU-first structure (SURVEY.md §7, hard parts 2-3):
+- **Two compiled graphs**, not one: ``prefill`` (one sequence, prompt padded
+  to a static bucket) and ``decode`` (fixed max-batch, one token per active
+  slot). Every shape is static; prompt-length variation is handled by a small
+  set of buckets, batch variation by validity masks — zero recompiles in
+  steady state.
+- **KV buffers are donated** (``donate_argnums``) so the pool is updated in
+  place in HBM instead of being double-buffered.
+- Attention inside the graphs goes through the injected AttentionFn: the
+  dense gather-based reference here, or the Pallas paged kernel
+  (kernels/paged_attention.py) on TPU.
+- The host never blocks per token on device_get of logits: decode returns
+  sampled token ids ([B] int32), the only per-step host transfer.
+
+The reference repo has no engine (it load-tests an external server,
+SURVEY.md §0); capability parity is defined by BASELINE.json configs 1-4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_inference.config import EngineConfig, ModelConfig
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.kv_cache import KVPages, PageAllocator
+from tpu_inference.engine.sampling import SamplingParams, sample
+from tpu_inference.models.registry import build_model, get_model_fns
+
+
+def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
+                    positions: jax.Array, valid: jax.Array,
+                    q_offset: jax.Array, kv_len: jax.Array,
+                    attn_backend: str = "dense"):
+    """AttentionFn that writes new K/V into the paged pool then attends.
+
+    block_tables [B, MP]; positions/valid [B, S]; q_offset/kv_len [B].
+    """
+    from tpu_inference.models.common import dense_causal_attention
+
+    def attn(layer_idx, q, k, v, kv: KVPages):
+        slots = kvc.slot_mapping(block_tables, positions, valid, page_size)
+        kv = kvc.write_kv(kv, layer_idx, k, v, slots)
+        if attn_backend == "pallas" and q.shape[1] == 1:
+            from tpu_inference.kernels.paged_attention import paged_attention
+            out = paged_attention(q[:, 0], kv.k[layer_idx], kv.v[layer_idx],
+                                  block_tables, kv_len)
+            return out[:, None], kv
+        k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
+        out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,
+                                     kv_len=kv_len)
+        return out, kv
+
+    return attn
+
+
+@dataclasses.dataclass
+class Sequence:
+    """Host-side state for one running sequence (one decode slot)."""
+
+    request_id: int
+    prompt_tokens: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    # Filled by the engine:
+    slot: int = -1
+    pages: List[int] = dataclasses.field(default_factory=list)
+    ctx_len: int = 0                       # tokens currently in KV
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    finish_reason: str = ""
+    # Timing (server metrics; SURVEY.md §5 observability).
+    enqueue_time: float = 0.0
+    prefill_start: float = 0.0
+    first_token_time: float = 0.0
+    finish_time: float = 0.0
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1] if self.generated else self.prompt_tokens[-1]
+
+
+class InferenceEngine:
+    """Owns device state (params, KV pool) and the compiled step functions."""
+
+    def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                 params: Optional[dict] = None, seed: int = 0,
+                 attn_backend: str = "dense",
+                 shard_fn: Optional[Callable[[dict], dict]] = None):
+        model_cfg.validate()
+        self.model_cfg = model_cfg
+        self.engine_cfg = engine_cfg
+        self.mod = get_model_fns(model_cfg)
+        if params is None:
+            params, _ = build_model(model_cfg, seed=seed)
+        if shard_fn is not None:
+            params = shard_fn(params)
+        self.params = params
+        self.attn_backend = attn_backend
+        self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg)
+        self.allocator = PageAllocator(engine_cfg.num_pages)
+        self.max_pages = engine_cfg.max_pages_per_seq
+        self._base_key = jax.random.PRNGKey(seed)
+        self._step_count = 0
+        self.slots: List[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
+
+        self._prefill_jit = jax.jit(
+            partial(self._prefill_fn), donate_argnums=(1,))
+        self._decode_jit = jax.jit(
+            partial(self._decode_fn), donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # Device graphs (pure functions of arrays; jitted once per bucket/batch)
+    # ------------------------------------------------------------------
+
+    def _prefill_fn(self, params, kv: KVPages, tokens, prompt_len, prefix_len,
+                    block_table, key, temperature, top_p):
+        """One sequence, tokens [1, S_bucket] right-padded.
+
+        prefix_len > 0 means ``prefix_len`` tokens are already cached in this
+        sequence's pages (multi-turn / chunked prefill); new tokens occupy
+        positions [prefix_len, prefix_len + prompt_len).
+        """
+        cfg = self.model_cfg
+        s = tokens.shape[1]
+        ar = jnp.arange(s)[None, :]
+        positions = prefix_len[:, None] + ar                     # [1, S]
+        valid = ar < prompt_len[:, None]
+        total_len = prefix_len + prompt_len
+        positions = jnp.minimum(positions, self.engine_cfg.max_context - 1)
+        attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_table,
+                               positions, valid, q_offset=prefix_len,
+                               kv_len=total_len)
+        hidden, kv = self.mod.forward_hidden(params, cfg, tokens, positions,
+                                             kv, attn)
+        last = jnp.take_along_axis(
+            hidden, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]                                                  # [1, D]
+        logits = self.mod.unembed(params, cfg, last)             # [1, V]
+        sp = SamplingParams(temperature=temperature, top_p=top_p)
+        tok = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
+        return kv, tok, logits
+
+    def _decode_fn(self, params, kv: KVPages, tokens, ctx_lens, block_tables,
+                   active, key, temperature, top_p):
+        """One step for the whole decode batch. tokens/ctx_lens/active: [B]."""
+        cfg = self.model_cfg
+        b = tokens.shape[0]
+        positions = jnp.minimum(ctx_lens, self.engine_cfg.max_context - 1)
+        positions = positions[:, None]                            # [B, 1]
+        valid = active[:, None]                                   # [B, 1]
+        attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_tables,
+                               positions, valid, q_offset=ctx_lens,
+                               kv_len=ctx_lens + 1,
+                               attn_backend=self.attn_backend)
+        hidden, kv = self.mod.forward_hidden(params, cfg, tokens[:, None],
+                                             positions, kv, attn)
+        logits = self.mod.unembed(params, cfg, hidden[:, 0])      # [B, V]
+        sp = SamplingParams(temperature=temperature, top_p=top_p)
+        toks = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
+        return kv, toks, logits
+
+    # ------------------------------------------------------------------
+    # Host-side orchestration
+    # ------------------------------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._step_count += 1
+        return jax.random.fold_in(self._base_key, self._step_count)
+
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def _pages_reserved(self, seq: Sequence) -> int:
+        """Worst-case page need for admission control (capped at the
+        per-sequence maximum, since ctx is clamped to max_context)."""
+        need = kvc.pages_needed(
+            len(seq.prompt_tokens) + seq.max_new_tokens,
+            self.engine_cfg.page_size)
+        return min(need, self.max_pages)
+
+    def can_admit(self, seq: Sequence) -> bool:
+        return bool(self.free_slots()) and self.allocator.can_allocate(
+            self._pages_reserved(seq))
+
+    def can_ever_admit(self, seq: Sequence) -> bool:
+        """False if the request exceeds the pool even when fully idle."""
+        return self._pages_reserved(seq) <= self.engine_cfg.num_pages - 1
+
+    def _block_table_array(self, pages: List[int]) -> np.ndarray:
+        bt = np.zeros((self.max_pages,), np.int32)
+        bt[:len(pages)] = pages
+        return bt
+
+    def prefill(self, seq: Sequence, slot: Optional[int] = None) -> int:
+        """Admit a sequence: allocate pages, run the prefill graph (chunked
+        when the prompt exceeds the largest bucket), sample the first token.
+        Returns the slot index."""
+        ecfg = self.engine_cfg
+        if slot is None:
+            slot = self.free_slots()[0]
+        # Keep the most recent tokens of over-long prompts (leave room for
+        # at least one generated token).
+        prompt = seq.prompt_tokens[-(ecfg.max_context - 1):]
+        n_pages = kvc.pages_needed(len(prompt), ecfg.page_size)
+        seq.pages = self.allocator.allocate(n_pages)
+        seq.slot = slot
+        seq.prefill_start = time.perf_counter()
+        bt = self._block_table_array(seq.pages)[None]
+
+        # Chunked prefill: each chunk attends to itself + all cached tokens
+        # (prefix_len). Only the final chunk's sampled token is kept.
+        chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
+        offset = 0
+        tok = None
+        while offset < len(prompt):
+            chunk = prompt[offset:offset + chunk_cap]
+            bucket = ecfg.bucket_for(len(chunk))
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :len(chunk)] = chunk
+            self.kv, tok, _ = self._prefill_jit(
+                self.params, self.kv, jnp.asarray(toks),
+                jnp.asarray([len(chunk)], np.int32),
+                jnp.asarray([offset], np.int32), jnp.asarray(bt),
+                self._next_key(),
+                jnp.asarray([seq.temperature], np.float32),
+                jnp.asarray([seq.top_p], np.float32))
+            offset += len(chunk)
+        seq.ctx_len = len(prompt)
+        first = int(tok[0])
+        seq.generated.append(first)
+        seq.first_token_time = time.perf_counter()
+        self.slots[slot] = seq
+        self._maybe_finish(seq, first)
+        return slot
+
+    def _maybe_finish(self, seq: Sequence, tok: int) -> None:
+        if seq.eos_token_id is not None and tok == seq.eos_token_id:
+            seq.done, seq.finish_reason = True, "stop"
+        elif len(seq.generated) >= seq.max_new_tokens:
+            seq.done, seq.finish_reason = True, "length"
+        elif seq.ctx_len + 1 >= self.engine_cfg.max_context:
+            seq.done, seq.finish_reason = True, "length"
+        if seq.done:
+            seq.finish_time = time.perf_counter()
+
+    def release(self, seq: Sequence) -> None:
+        """Free a finished sequence's pages and slot."""
+        self.allocator.free(seq.pages)
+        seq.pages = []
+        if seq.slot >= 0 and self.slots[seq.slot] is seq:
+            self.slots[seq.slot] = None
+
+    def active_sequences(self) -> List[Sequence]:
+        return [s for s in self.slots if s is not None and not s.done]
+
+    def decode_step(self) -> Dict[int, int]:
+        """One batched decode step. Returns {request_id: new_token} for the
+        sequences that advanced."""
+        ecfg = self.engine_cfg
+        b = ecfg.max_batch_size
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return {}
+
+        # Grow block tables for sequences crossing a page boundary.
+        for seq in active_seqs:
+            if kvc.pages_needed(1, ecfg.page_size, already=seq.ctx_len) > 0:
+                if len(seq.pages) >= self.max_pages:
+                    seq.done, seq.finish_reason = True, "length"
+                    seq.finish_time = time.perf_counter()
+                    continue
+                if not self.allocator.can_allocate(1):
+                    # Pool exhausted mid-flight. The scheduler's admission
+                    # control makes this rare; fail this sequence safely
+                    # rather than corrupting others' pages.
+                    seq.done, seq.finish_reason = True, "oom"
+                    seq.finish_time = time.perf_counter()
+                    continue
+                seq.pages.extend(self.allocator.allocate(1))
+        active_seqs = [s for s in active_seqs if not s.done]
+        if not active_seqs:
+            return {}
+
+        tokens = np.zeros((b,), np.int32)
+        ctx_lens = np.zeros((b,), np.int32)
+        bts = np.zeros((b, self.max_pages), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        top_ps = np.ones((b,), np.float32)
+        for seq in active_seqs:
+            i = seq.slot
+            tokens[i] = seq.last_token
+            ctx_lens[i] = seq.ctx_len
+            bts[i] = self._block_table_array(seq.pages)
+            active[i] = True
+            temps[i] = seq.temperature
+            top_ps[i] = seq.top_p
+
+        self.kv, toks, _ = self._decode_jit(
+            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
+            jnp.asarray(bts), jnp.asarray(active), self._next_key(),
+            jnp.asarray(temps), jnp.asarray(top_ps))
+        toks = np.asarray(toks)
+
+        out: Dict[int, int] = {}
+        for seq in active_seqs:
+            tok = int(toks[seq.slot])
+            seq.ctx_len += 1
+            seq.generated.append(tok)
+            if seq.first_token_time == 0.0:
+                seq.first_token_time = time.perf_counter()
+            self._maybe_finish(seq, tok)
+            out[seq.request_id] = tok
+        return out
+
+    # ------------------------------------------------------------------
+    # Convenience batch generation (tests, bench, config-1 path)
+    # ------------------------------------------------------------------
+
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Generate for a batch of token-id prompts; returns generated ids."""
+        seqs = [Sequence(request_id=i, prompt_tokens=list(p),
+                         max_new_tokens=max_new_tokens, temperature=temperature,
+                         top_p=top_p, eos_token_id=eos_token_id)
+                for i, p in enumerate(prompts)]
+        for s in seqs:
+            if not self.can_ever_admit(s):
+                raise ValueError(
+                    f"request {s.request_id} needs {self._pages_reserved(s)} "
+                    f"pages; pool holds {self.engine_cfg.num_pages - 1}")
+        results: Dict[int, List[int]] = {}
+        pending = list(seqs)
+        while pending or self.active_sequences():
+            while pending and self.free_slots() and self.can_admit(pending[0]):
+                self.prefill(pending.pop(0))
+            self.decode_step()
+            for s in [s for s in self.slots if s is not None and s.done]:
+                results[s.request_id] = s.generated
+                self.release(s)
+        return [results[i] for i in range(len(seqs))]

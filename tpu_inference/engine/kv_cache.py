@@ -1,0 +1,140 @@
+"""Paged KV cache: an HBM block pool with per-sequence block tables.
+
+The reference delegates all KV management to its external Ollama server
+(SURVEY.md §0); this is the TPU-native equivalent of vLLM's PagedAttention
+memory model, re-designed for XLA's static-shape world:
+
+- Device side, per layer: one pool array ``[L, P, page, Hkv, D]`` for K and V.
+  Page 0 is a reserved **trash page**: padded / inactive token slots write
+  there, so every scatter has a valid static target and no branching.
+- Sequences address the pool through **block tables** ``[B, max_pages]``
+  (int32 page ids, 0-filled), recomputed on the host and shipped each step —
+  tiny arrays, so host->device traffic stays negligible.
+- Writes are flat scatters (token -> page*page_size + offset); reads gather a
+  sequence's pages into a contiguous [B, max_pages*page, Hkv, D] view for the
+  dense-reference attention path. The Pallas decode kernel (kernels/) reads
+  pages directly from HBM instead of materializing the gather.
+
+Host side, ``PageAllocator`` is a free-list with refcounts so shared prompt
+prefixes can map the same physical pages (copy-on-write is unnecessary for
+inference: pages are append-only within a sequence).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpu_inference.config import EngineConfig, ModelConfig
+
+
+class KVPages(NamedTuple):
+    """Device-side KV pool. k, v: [L, num_pages, page_size, Hkv, head_dim]."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def alloc_kv_pages(model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                   dtype=None) -> KVPages:
+    shape = (model_cfg.n_layers, engine_cfg.num_pages, engine_cfg.page_size,
+             model_cfg.n_kv_heads, model_cfg.head_dim)
+    dtype = dtype or model_cfg.dtype
+    return KVPages(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def slot_mapping(block_tables: jax.Array, positions: jax.Array,
+                 valid: jax.Array, page_size: int) -> jax.Array:
+    """Map absolute token positions to flat pool slots.
+
+    block_tables: [B, max_pages]; positions: [B, S]; valid: [B, S] bool.
+    Invalid tokens map to slot 0 (the trash page). Returns [B, S] int32.
+    """
+    page_of_pos = positions // page_size                     # [B, S]
+    page_ids = jnp.take_along_axis(block_tables, page_of_pos, axis=1)
+    slots = page_ids * page_size + positions % page_size
+    return jnp.where(valid, slots, 0).astype(jnp.int32)
+
+
+def write_kv(kv: KVPages, layer_idx: jax.Array, k_new: jax.Array,
+             v_new: jax.Array, slots: jax.Array) -> KVPages:
+    """Scatter new K/V ([B, S, Hkv, D]) into the pool at flat ``slots`` [B,S]."""
+    L, P, pg, H, D = kv.k.shape
+    flat = slots.reshape(-1)
+    kf = kv.k.reshape(L, P * pg, H, D)
+    vf = kv.v.reshape(L, P * pg, H, D)
+    kf = kf.at[layer_idx, flat].set(k_new.reshape(-1, H, D))
+    vf = vf.at[layer_idx, flat].set(v_new.reshape(-1, H, D))
+    return KVPages(k=kf.reshape(L, P, pg, H, D), v=vf.reshape(L, P, pg, H, D))
+
+
+def gather_kv(kv: KVPages, layer_idx: jax.Array,
+              block_tables: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Gather each sequence's pages into contiguous [B, max_pages*pg, H, D]."""
+    b, mp = block_tables.shape
+    _, _, pg, H, D = kv.k.shape
+    k = kv.k[layer_idx][block_tables].reshape(b, mp * pg, H, D)
+    v = kv.v[layer_idx][block_tables].reshape(b, mp * pg, H, D)
+    return k, v
+
+
+class PageAllocator:
+    """Host-side free-list allocator with refcounts (prefix sharing).
+
+    Page 0 is reserved as the trash page and never allocated. The engine's
+    admission control (SURVEY.md §5 "Failure detection": OOM-safe admission)
+    asks ``can_allocate`` before scheduling a sequence.
+    """
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._refs = [0] * num_pages
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, n: int = 1) -> List[int]:
+        if len(self._free) < n:
+            raise MemoryError(f"KV pool exhausted: need {n}, have {len(self._free)}")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def share(self, page: int) -> int:
+        """Increment refcount for a prefix-shared page."""
+        assert self._refs[page] > 0
+        self._refs[page] += 1
+        return page
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == 0:
+                continue
+            assert self._refs[p] > 0, f"double free of page {p}"
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(p)
+
+
+def pages_needed(n_tokens: int, page_size: int,
+                 already: int = 0) -> int:
+    """Pages to add so a sequence of ``already`` tokens can hold n_tokens more."""
+    total = -(-(already + n_tokens) // page_size)
+    have = -(-already // page_size)
+    return max(0, total - have)

@@ -1,0 +1,73 @@
+"""Token sampling, jit-safe and batched.
+
+All control flow is data-parallel (`jnp.where` over the batch), so one
+compiled graph serves any mix of greedy / temperature / top-k / top-p
+requests in the same decode batch — no per-request recompiles (XLA static
+shapes, SURVEY.md §7 hard part 2). top_k is a static graph parameter
+(lax.top_k needs a static k); the server buckets requests by it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingParams(NamedTuple):
+    """Per-slot device arrays, shape [B]."""
+
+    temperature: jax.Array   # f32; <= 0 means greedy
+    top_p: jax.Array         # f32 in (0, 1]; 1 disables
+
+    @staticmethod
+    def greedy(batch: int) -> "SamplingParams":
+        return SamplingParams(temperature=jnp.zeros((batch,), jnp.float32),
+                              top_p=jnp.ones((batch,), jnp.float32))
+
+
+def _apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    """Keep the top_k logits per row, -inf the rest. Static k."""
+    if top_k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]          # [B, 1]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filtering. top_p: [B]. Keeps the smallest prefix of the
+    probability-sorted vocab whose mass reaches top_p (always >= 1 token)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]      # desc
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # Token i is kept if the cumulative mass *before* it is < top_p.
+    keep_sorted = (cum - sorted_probs) < top_p[:, None]
+    # Per-row logit threshold = smallest kept logit.
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def sample(logits: jax.Array, key: jax.Array, params: SamplingParams,
+           top_k: int = 0) -> jax.Array:
+    """logits: [B, V] f32 -> token ids [B] int32.
+
+    Greedy rows (temperature <= 0) and sampled rows coexist in one batch.
+    """
+    b = logits.shape[0]
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(params.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    scaled = _apply_top_k(scaled, top_k)
+    scaled = _apply_top_p(scaled, params.top_p)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    return jnp.where(params.temperature <= 0.0, greedy_tok, sampled)
+
+
+def logprobs_of(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Log-prob of given tokens under logits. [B, V], [B] -> [B]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]

@@ -1,0 +1,223 @@
+"""Continuous-batching scheduler: the host loop that feeds the TPU.
+
+The reference's "Scheduler" (traffic_generator/main.py:53-84) only decides
+when the *client* sends requests; this is the missing server-side scheduler
+(SURVEY.md §1 "no scheduler-in-the-engine sense").
+
+Design:
+- One dedicated engine thread runs the device loop (JAX dispatch blocks the
+  caller, so it must stay off the asyncio event loop). The aiohttp server
+  submits requests from any thread; token/finish callbacks fire on the
+  engine thread and the server trampolines them onto its event loop.
+- FCFS admission with **worst-case page reservation**: a request is admitted
+  only when a decode slot is free and the pool can hold its prompt plus its
+  full generation budget (OOM-safe admission control, SURVEY.md §5).
+- Join/leave at step boundaries: at most ``max_prefills_per_step`` prefills
+  per iteration (prefill is the latency-heavy graph), then one batched
+  decode step for every active slot.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional
+
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+
+# on_token(seq, token_id); on_finish(seq)
+TokenCallback = Callable[[Sequence, int], None]
+FinishCallback = Callable[[Sequence], None]
+
+
+@dataclasses.dataclass
+class SchedulerStats:
+    """Server-side observability counters (SURVEY.md §5)."""
+
+    steps: int = 0
+    prefills: int = 0
+    tokens_generated: int = 0
+    requests_finished: int = 0
+    requests_rejected: int = 0
+    batch_occupancy_sum: float = 0.0
+    peak_pages_in_use: int = 0
+
+    def snapshot(self, engine: InferenceEngine) -> Dict:
+        occ = (self.batch_occupancy_sum / self.steps) if self.steps else 0.0
+        total = engine.engine_cfg.num_pages - 1
+        return {
+            "steps": self.steps,
+            "prefills": self.prefills,
+            "tokens_generated": self.tokens_generated,
+            "requests_finished": self.requests_finished,
+            "requests_rejected": self.requests_rejected,
+            "mean_batch_occupancy": occ,
+            "kv_pages_total": total,
+            "kv_pages_in_use": total - engine.allocator.num_free,
+            "peak_pages_in_use": self.peak_pages_in_use,
+        }
+
+
+@dataclasses.dataclass
+class _Pending:
+    seq: Sequence
+    on_token: TokenCallback
+    on_finish: FinishCallback
+
+
+class EngineScheduler:
+    """Threaded continuous-batching loop around an InferenceEngine."""
+
+    def __init__(self, engine: InferenceEngine,
+                 max_prefills_per_step: int = 1,
+                 idle_sleep_s: float = 0.001):
+        self.engine = engine
+        self.max_prefills_per_step = max_prefills_per_step
+        self.idle_sleep_s = idle_sleep_s
+        self.stats = SchedulerStats()
+        self._waiting: Deque[_Pending] = collections.deque()
+        self._callbacks: Dict[int, _Pending] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -------------------------------------------------- submission API
+
+    def submit(self, seq: Sequence, on_token: TokenCallback,
+               on_finish: FinishCallback) -> None:
+        """Queue a request; callbacks fire on the engine thread."""
+        if len(self._waiting) >= self.engine.engine_cfg.max_queue_len:
+            self.stats.requests_rejected += 1
+            seq.done, seq.finish_reason = True, "queue_full"
+            on_finish(seq)
+            return
+        if not self.engine.can_ever_admit(seq):
+            # Would block the FCFS queue forever — reject immediately.
+            self.stats.requests_rejected += 1
+            seq.done, seq.finish_reason = True, "too_large"
+            on_finish(seq)
+            return
+        seq.enqueue_time = time.perf_counter()
+        with self._lock:
+            self._waiting.append(_Pending(seq, on_token, on_finish))
+        self._work.set()
+
+    def cancel(self, request_id: int) -> None:
+        """Cancel a queued or running request (client disconnect)."""
+        with self._lock:
+            for p in list(self._waiting):
+                if p.seq.request_id == request_id:
+                    self._waiting.remove(p)
+                    p.seq.done, p.seq.finish_reason = True, "cancelled"
+                    return
+            p = self._callbacks.get(request_id)
+            if p is not None and not p.seq.done:
+                p.seq.done = True
+                p.seq.finish_reason = "cancelled"
+
+    # -------------------------------------------------- engine loop
+
+    def start(self) -> "EngineScheduler":
+        self._stop.clear()   # restartable (server app cycles in tests)
+        self._thread = threading.Thread(target=self.run, name="engine-loop",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful shutdown; with drain=True, finish in-flight work first."""
+        if drain:
+            deadline = time.monotonic() + timeout
+            while (time.monotonic() < deadline
+                   and (self._waiting or self.engine.active_sequences())):
+                time.sleep(0.01)
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def _admit(self) -> int:
+        """Prefill up to max_prefills_per_step waiting requests."""
+        admitted = 0
+        while admitted < self.max_prefills_per_step:
+            with self._lock:
+                if not self._waiting:
+                    break
+                pending = self._waiting[0]
+                if pending.seq.done:          # cancelled while queued
+                    self._waiting.popleft()
+                    continue
+                if not self.engine.can_admit(pending.seq):
+                    break
+                self._waiting.popleft()
+                # Register before releasing the lock so cancel() always
+                # finds the request in _waiting or _callbacks.
+                self._callbacks[pending.seq.request_id] = pending
+            seq = pending.seq
+            try:
+                self.engine.prefill(seq)
+            except Exception:  # noqa: BLE001 — keep the engine loop alive
+                import traceback
+                traceback.print_exc()
+                seq.done, seq.finish_reason = True, "error"
+                self._finish(seq)   # releases pages/slot
+                continue
+            self.stats.prefills += 1
+            self.stats.tokens_generated += 1
+            admitted += 1
+            pending.on_token(seq, seq.generated[-1])
+            if seq.done:
+                self._finish(seq)
+        return admitted
+
+    def _finish(self, seq: Sequence) -> None:
+        with self._lock:
+            pending = self._callbacks.pop(seq.request_id, None)
+        self.engine.release(seq)
+        self.stats.requests_finished += 1
+        if pending is not None:
+            pending.on_finish(seq)
+
+    def run(self) -> None:
+        engine = self.engine
+        while not self._stop.is_set():
+            self._admit()
+            active = engine.active_sequences()
+            if not active:
+                # Reap cancelled-in-flight sequences even when idle.
+                for s in [s for s in engine.slots if s is not None and s.done]:
+                    self._finish(s)
+                if not self._waiting:
+                    self._work.clear()
+                    self._work.wait(timeout=0.1)
+                else:
+                    time.sleep(self.idle_sleep_s)
+                continue
+
+            try:
+                new_tokens = engine.decode_step()
+            except Exception:  # noqa: BLE001 — keep the engine loop alive
+                import traceback
+                traceback.print_exc()
+                for s in active:
+                    s.done, s.finish_reason = True, "error"
+                    s.finish_time = time.perf_counter()
+                    self._finish(s)
+                continue
+            self.stats.steps += 1
+            self.stats.batch_occupancy_sum += len(active)
+            self.stats.tokens_generated += len(new_tokens)
+            in_use = (engine.engine_cfg.num_pages - 1) - engine.allocator.num_free
+            self.stats.peak_pages_in_use = max(self.stats.peak_pages_in_use,
+                                               in_use)
+
+            for rid, tok in new_tokens.items():
+                pending = self._callbacks.get(rid)
+                if pending is not None:
+                    pending.on_token(pending.seq, tok)
+            for s in [s for s in engine.slots if s is not None and s.done]:
+                self._finish(s)

@@ -80,9 +80,10 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Dense causal attention; the correctness reference for all kernels.
 
     q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] (GQA expanded internally).
-    ``q_offset`` is the absolute position of q's first token within the KV
-    sequence (for chunked prefill / decode against a cache). ``kv_len``
-    masks out cache slots beyond the valid length. Softmax in float32.
+    ``q_offset`` (scalar or [B]) is the absolute position of q's first token
+    within the KV sequence (for chunked prefill / decode against a cache).
+    ``kv_len`` (scalar or [B]) masks out cache slots beyond the valid length.
+    Softmax in float32.
     """
     b, sq, hq, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
@@ -92,13 +93,14 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # [B, H, Sq, Skv]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    q_pos = q_offset + jnp.arange(sq)[:, None]           # [Sq, 1]
-    k_pos = jnp.arange(skv)[None, :]                     # [1, Skv]
-    mask = k_pos <= q_pos                                # causal
+    offs = jnp.broadcast_to(jnp.asarray(q_offset), (b,))        # [B]
+    q_pos = offs[:, None] + jnp.arange(sq)[None, :]             # [B, Sq]
+    k_pos = jnp.arange(skv)                                     # [Skv]
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]            # [B, Sq, Skv]
     if kv_len is not None:
-        valid = k_pos < jnp.reshape(kv_len, (-1, 1, 1, 1))
-        mask = jnp.logical_and(mask, valid)
-    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+        lens = jnp.broadcast_to(jnp.asarray(kv_len), (b,))
+        mask = jnp.logical_and(mask, k_pos[None, None, :] < lens[:, None, None])
+    scores = jnp.where(mask[:, None, :, :], scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -1,0 +1,1 @@
+"""HTTP serving layer: Ollama-protocol endpoint + tokenizers + metrics."""

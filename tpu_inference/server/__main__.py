@@ -1,0 +1,43 @@
+"""CLI entry point: ``python -m tpu_inference.server --model tiny-llama``.
+
+The reference has no CLI (argparse commented out; reference:
+traffic_generator/main.py:4). This is the serve() entry SURVEY.md §3.5
+plans for.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from aiohttp import web
+
+from tpu_inference.config import PRESETS
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="TPU-native LLM inference server "
+                                            "(Ollama-protocol endpoint)")
+    p.add_argument("--model", default="tiny-llama", choices=sorted(PRESETS))
+    p.add_argument("--tokenizer", default="byte",
+                   help="'byte' or path to a local HF tokenizer dir")
+    p.add_argument("--checkpoint", default=None,
+                   help="HF safetensors directory (random init if omitted)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=11434)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=16)
+    args = p.parse_args()
+
+    from tpu_inference.server.http import build_server
+
+    server = build_server(model=args.model, tokenizer=args.tokenizer,
+                          checkpoint=args.checkpoint,
+                          max_batch_size=args.max_batch_size,
+                          num_pages=args.num_pages, page_size=args.page_size)
+    app = server.make_app()
+    web.run_app(app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

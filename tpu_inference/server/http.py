@@ -1,0 +1,254 @@
+"""Ollama-protocol HTTP server over the TPU engine.
+
+Wire contract (load-bearing — SURVEY.md §2c; the reference's traffic
+generator must run unchanged against this server):
+
+- ``POST /api/generate`` with JSON ``{"model", "prompt", "temperature",
+  "max_tokens", "stream"}`` (reference: traffic_generator/main.py:241-247).
+  ``options.temperature`` / ``options.num_predict`` are honored too (the
+  documented Ollama placement).
+- stream=true: ``200`` with ``Content-Type: application/x-ndjson`` and
+  chunked transfer; one JSON line per token
+  ``{"model", "created_at", "response", "done": false}``; the terminal line
+  adds ``done_reason``, ``context`` (token ids) and the ns-duration counters
+  ``total_duration, load_duration, prompt_eval_count, prompt_eval_duration,
+  eval_count, eval_duration``.
+- stream=false: one JSON object, ``response`` = full text + same counters.
+- **Headers are withheld until the first token is ready** so the client-side
+  TTFT metric (first streamed chunk ≈ header arrival; reference
+  logs/log.json) measures model latency, not connection latency.
+
+Also serves ``GET /api/tags``, ``/api/version``, ``/healthz``, and
+``/metrics`` (scheduler counters: batch occupancy, KV-page utilization —
+SURVEY.md §5 observability).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime
+import itertools
+import json
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from tpu_inference.config import FrameworkConfig, PRESETS
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.scheduler import EngineScheduler
+from tpu_inference.server.tokenizer import IncrementalDecoder, build_tokenizer
+
+
+def _now_iso() -> str:
+    return (datetime.datetime.now(datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%S.%f000Z"))
+
+
+class InferenceServer:
+    """Engine + scheduler + tokenizer behind the Ollama HTTP protocol."""
+
+    def __init__(self, cfg: FrameworkConfig,
+                 engine: Optional[InferenceEngine] = None):
+        self.cfg = cfg
+        t0 = time.perf_counter()
+        self.engine = engine or InferenceEngine(cfg.model, cfg.engine,
+                                                seed=cfg.seed)
+        self.tokenizer = build_tokenizer(cfg.server.tokenizer,
+                                         vocab_size=cfg.model.vocab_size)
+        self.load_duration_ns = int((time.perf_counter() - t0) * 1e9)
+        self.scheduler = EngineScheduler(self.engine)
+        self._ids = itertools.count()
+
+    # ------------------------------------------------------------- app
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/api/generate", self.handle_generate)
+        app.router.add_get("/api/tags", self.handle_tags)
+        app.router.add_get("/api/version", self.handle_version)
+        app.router.add_get("/healthz", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.on_startup.append(self._on_startup)
+        app.on_cleanup.append(self._on_cleanup)
+        return app
+
+    async def _on_startup(self, app) -> None:
+        self.scheduler.start()
+
+    async def _on_cleanup(self, app) -> None:
+        self.scheduler.stop(drain=False)
+
+    # ------------------------------------------------------------- routes
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_version(self, request: web.Request) -> web.Response:
+        from tpu_inference import __version__
+
+        return web.json_response({"version": __version__})
+
+    async def handle_tags(self, request: web.Request) -> web.Response:
+        return web.json_response({"models": [{
+            "name": self.cfg.server.model_name,
+            "model": self.cfg.server.model_name,
+            "details": {"family": self.cfg.model.family,
+                        "parameter_size": self.cfg.model.name},
+        }]})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(self.scheduler.stats.snapshot(self.engine))
+
+    async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        recv_t = time.perf_counter()
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "invalid JSON body"}), content_type="application/json")
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "missing 'prompt'"}), content_type="application/json")
+
+        opts = body.get("options") or {}
+        ecfg = self.cfg.engine
+        temperature = float(opts.get("temperature",
+                                     body.get("temperature", ecfg.temperature)))
+        max_tokens = int(opts.get("num_predict",
+                                  body.get("max_tokens", ecfg.max_new_tokens)))
+        max_tokens = max(1, min(max_tokens, ecfg.max_context - 1))
+        top_p = float(opts.get("top_p", body.get("top_p", ecfg.top_p)))
+        stream = bool(body.get("stream", True))
+        model_name = body.get("model") or self.cfg.server.model_name
+
+        prompt_ids = self.tokenizer.encode(prompt)
+        rid = next(self._ids)
+        seq = Sequence(request_id=rid, prompt_tokens=prompt_ids,
+                       max_new_tokens=max_tokens, temperature=temperature,
+                       top_p=top_p, eos_token_id=self.tokenizer.eos_token_id)
+
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+
+        def on_token(s: Sequence, tok: int) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, ("token", tok))
+
+        def on_finish(s: Sequence) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, ("finish", s))
+
+        self.scheduler.submit(seq, on_token, on_finish)
+        try:
+            if stream:
+                return await self._stream_response(request, queue, seq,
+                                                   model_name, recv_t)
+            return await self._unary_response(request, queue, seq, model_name,
+                                              recv_t)
+        except asyncio.TimeoutError:
+            # Request exceeded request_timeout_s: free the slot and pages.
+            self.scheduler.cancel(rid)
+            raise web.HTTPGatewayTimeout(text=json.dumps(
+                {"error": "request timed out"}), content_type="application/json")
+        except (asyncio.CancelledError, ConnectionResetError):
+            self.scheduler.cancel(rid)
+            raise
+
+    # ------------------------------------------------------------- helpers
+
+    def _final_record(self, seq: Sequence, model_name: str,
+                      recv_t: float) -> dict:
+        now = time.perf_counter()
+        prompt_eval_ns = max(0, int((seq.first_token_time - seq.prefill_start)
+                                    * 1e9)) if seq.first_token_time else 0
+        finish = seq.finish_time or now
+        eval_ns = max(0, int((finish - (seq.first_token_time or finish)) * 1e9))
+        return {
+            "model": model_name,
+            "created_at": _now_iso(),
+            "response": "",
+            "done": True,
+            "done_reason": seq.finish_reason or "stop",
+            "context": list(seq.prompt_tokens) + list(seq.generated),
+            "total_duration": int((now - recv_t) * 1e9),
+            "load_duration": self.load_duration_ns,
+            "prompt_eval_count": len(seq.prompt_tokens),
+            "prompt_eval_duration": prompt_eval_ns,
+            "eval_count": len(seq.generated),
+            "eval_duration": eval_ns,
+        }
+
+    async def _stream_response(self, request: web.Request, queue: asyncio.Queue,
+                               seq: Sequence, model_name: str,
+                               recv_t: float) -> web.StreamResponse:
+        resp = web.StreamResponse(status=200, headers={
+            "Content-Type": "application/x-ndjson"})
+        resp.enable_chunked_encoding()
+        decoder = IncrementalDecoder(self.tokenizer)
+        prepared = False
+        timeout = self.cfg.server.request_timeout_s
+
+        while True:
+            kind, payload = await asyncio.wait_for(queue.get(), timeout)
+            if kind == "token":
+                chunk = decoder.push(payload)
+                if not prepared:
+                    # First token ready -> now send headers (TTFT contract).
+                    await resp.prepare(request)
+                    prepared = True
+                line = {"model": model_name, "created_at": _now_iso(),
+                        "response": chunk, "done": False}
+                await resp.write(json.dumps(line).encode() + b"\n")
+            else:
+                if not prepared:
+                    await resp.prepare(request)
+                    prepared = True
+                tail = decoder.flush()
+                if tail:
+                    await resp.write(json.dumps(
+                        {"model": model_name, "created_at": _now_iso(),
+                         "response": tail, "done": False}).encode() + b"\n")
+                final = self._final_record(payload, model_name, recv_t)
+                await resp.write(json.dumps(final).encode() + b"\n")
+                await resp.write_eof()
+                return resp
+
+    async def _unary_response(self, request: web.Request, queue: asyncio.Queue,
+                              seq: Sequence, model_name: str,
+                              recv_t: float) -> web.Response:
+        tokens = []
+        timeout = self.cfg.server.request_timeout_s
+        while True:
+            kind, payload = await asyncio.wait_for(queue.get(), timeout)
+            if kind == "token":
+                tokens.append(payload)
+            else:
+                final = self._final_record(payload, model_name, recv_t)
+                # Strip EOS from the visible text.
+                vis = [t for t in tokens
+                       if t != self.tokenizer.eos_token_id]
+                final["response"] = self.tokenizer.decode(vis)
+                return web.json_response(final)
+
+
+def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
+                 checkpoint: Optional[str] = None, **engine_overrides
+                 ) -> InferenceServer:
+    """Convenience constructor used by CLI, tests, and benchmarks."""
+    import dataclasses
+
+    from tpu_inference.config import EngineConfig, ServerConfig
+
+    model_cfg = PRESETS[model]()
+    engine_cfg = EngineConfig(**engine_overrides) if engine_overrides else EngineConfig()
+    cfg = FrameworkConfig(model=model_cfg, engine=engine_cfg,
+                          server=ServerConfig(model_name=model,
+                                              tokenizer=tokenizer),
+                          checkpoint_path=checkpoint)
+    if checkpoint:
+        from tpu_inference.models import weights
+
+        params = weights.load_checkpoint(model_cfg, checkpoint)
+        engine = InferenceEngine(model_cfg, engine_cfg, params=params)
+        return InferenceServer(cfg, engine=engine)
+    return InferenceServer(cfg)

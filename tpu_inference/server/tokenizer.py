@@ -1,0 +1,102 @@
+"""Tokenizer adapters.
+
+Two implementations behind one tiny interface:
+- ``ByteTokenizer``: hermetic UTF-8 byte-level tokenizer (vocab 256 bytes +
+  BOS/EOS). No files, no network — used by tests, the CPU stub config, and
+  any tiny random-init model. Incremental decoding buffers split UTF-8
+  sequences so streamed chunks are always valid text.
+- ``HFTokenizer``: wraps a local HuggingFace tokenizer directory (Llama,
+  Mixtral, GPT-2 vocabularies) via ``transformers.AutoTokenizer``.
+
+The reference repo never tokenizes (prompt lengths come pre-counted in its
+corpus; SURVEY.md §2a #3) — tokenization there happens inside the external
+Ollama server. This module is that missing server half.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_token_id: Optional[int]
+    eos_token_id: Optional[int]
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]: ...
+    def decode(self, ids: List[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as tokens; ids 0-255 = bytes, 256 = BOS, 257 = EOS."""
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 258
+        self.vocab_size = vocab_size
+        self.bos_token_id = 256
+        self.eos_token_id = 257
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_token_id] + ids) if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Local HuggingFace tokenizer directory (no network)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_token_id = self._tok.bos_token_id
+        self.eos_token_id = self._tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_token_id is not None:
+            ids = [self.bos_token_id] + ids
+        return ids
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+class IncrementalDecoder:
+    """Streams token ids -> text chunks without emitting broken UTF-8 or
+    partial multi-token glyphs. One instance per in-flight request.
+
+    Only the undecodable tail is buffered and re-decoded (the HF
+    ``TextStreamer`` strategy), so per-token cost is O(holdback), not
+    O(tokens generated). When the pending decode ends in a replacement
+    char the bytes may be an incomplete multi-byte sequence the next token
+    completes — hold them back; otherwise emit and reset.
+    """
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._pending: List[int] = []
+
+    def push(self, token_id: int) -> str:
+        self._pending.append(token_id)
+        text = self._tok.decode(self._pending)
+        if text.endswith("�"):
+            return ""
+        self._pending.clear()
+        return text
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._pending)
+        self._pending.clear()
+        return text
+
+
+def build_tokenizer(spec: str, vocab_size: int = 512) -> Tokenizer:
+    """'byte' -> ByteTokenizer; anything else is a local HF tokenizer path."""
+    if spec == "byte":
+        return ByteTokenizer(vocab_size=max(vocab_size, 258))
+    return HFTokenizer(spec)
