@@ -1,0 +1,107 @@
+"""Synthesize the harness datasets the reference declares but doesn't ship.
+
+``data/BurstGPT_1.csv`` and ``data/conversations.json`` are listed in the
+reference's ``.MISSING_LARGE_BLOBS`` (not present in the mount), so this
+regenerates statistically similar stand-ins, deterministically:
+
+- conversations.json: corpus of prompts binned by token length (schema per
+  SURVEY.md §2a #3: id -> {prompt, len_prompt, len_output, output}). Prompts
+  are ASCII so byte-tokenized length == char length, letting tests reason
+  about token counts exactly.
+- BurstGPT_1.csv: synthetic arrival trace (gamma inter-arrivals, lognormal
+  token lengths — the shape BurstGPT exhibits) with the column set the
+  reference's notebooks read: Timestamp, Request tokens, Response tokens.
+- trace1.csv: 6-row toy trace in the same format as the reference's
+  committed copy (reference data/trace1.csv).
+
+Run: ``python benchmarks/make_data.py [--out data]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+WORDS = ("the quick brown fox jumps over a lazy dog while many small "
+         "systems stream tokens across fast networks to measure latency "
+         "under bursty load patterns every single day").split()
+
+
+def text_of_token_len(rng: np.random.Generator, n_tokens: int) -> str:
+    """ASCII text of exactly n_tokens bytes (byte tokenizer: 1 byte/token)."""
+    parts = []
+    size = 0
+    while size < n_tokens:
+        w = WORDS[rng.integers(len(WORDS))]
+        parts.append(w)
+        size += len(w) + 1
+    text = " ".join(parts)[:n_tokens]
+    return text.ljust(n_tokens, "x")
+
+
+def make_conversations(rng: np.random.Generator, path: str,
+                       n_per_bin: int = 3) -> None:
+    prompt_bins = [2, 4, 8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512,
+                   768, 1024]
+    output_bins = [4, 16, 64, 200, 512, 1024]
+    corpus = {}
+    idx = 0
+    for p in prompt_bins:
+        for g in output_bins:
+            for _ in range(n_per_bin if p <= 256 else 1):
+                corpus[str(idx)] = {
+                    "prompt": text_of_token_len(rng, p),
+                    "len_prompt": p,
+                    "len_output": g,
+                    "output": text_of_token_len(rng, min(g, 128)),
+                }
+                idx += 1
+    with open(path, "w") as f:
+        json.dump(corpus, f)
+    print(f"wrote {path}: {len(corpus)} entries")
+
+
+def make_burstgpt(rng: np.random.Generator, path: str,
+                  n_rows: int = 10000, mean_interarrival: float = 0.5) -> None:
+    inter = rng.gamma(shape=0.6, scale=mean_interarrival / 0.6, size=n_rows)
+    ts = np.cumsum(inter)
+    ts[0] = 0.0
+    req = np.clip(rng.lognormal(mean=5.8, sigma=1.0, size=n_rows),
+                  2, 8192).astype(int)
+    resp = np.clip(rng.lognormal(mean=5.0, sigma=1.0, size=n_rows),
+                   1, 2048).astype(int)
+    with open(path, "w") as f:
+        f.write("Timestamp,Request tokens,Response tokens\n")
+        for t, p, g in zip(ts, req, resp):
+            f.write(f"{t:.3f},{p},{g}\n")
+    print(f"wrote {path}: {n_rows} rows")
+
+
+def make_trace1(path: str) -> None:
+    rows = [(0, 472, 18), (1, 1087, 230), (2, 417, 276), (3, 1360, 647),
+            (4, 185, 215), (5, 586, 293)]
+    with open(path, "w") as f:
+        f.write("Timestamp,Request tokens,Response tokens\n")
+        for t, p, g in rows:
+            f.write(f"{t},{p},{g}\n")
+    print(f"wrote {path}: {len(rows)} rows")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data")
+    ap.add_argument("--rows", type=int, default=10000)
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    rng = np.random.default_rng(20260729)
+    make_conversations(rng, os.path.join(args.out, "conversations.json"))
+    make_burstgpt(rng, os.path.join(args.out, "BurstGPT_1.csv"),
+                  n_rows=args.rows)
+    make_trace1(os.path.join(args.out, "trace1.csv"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""Benchmark-harness tests: unit coverage for the client components the
+reference only exercised manually via notebooks (SURVEY.md §4), plus the
+hermetic end-to-end replay — the harness driving the in-process TPU-stack
+server over real HTTP (BASELINE.json config 0 acceptance)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from traffic_generator import (BurstUser, DataLoader, MetricCollector, Query,
+                               Scheduler, SteadyUser, TrafficGenerator)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+METRIC_FIELDS = {"number_of_input_tokens", "request_start_time",
+                 "response_headers_received_time", "first_token_arrive_time",
+                 "response_end_time", "scheduled_start_time", "success"}
+
+
+def test_steady_user_timestamps():
+    u = SteadyUser(req_freq=2.0, duration=3.0, delay_start=1.0)
+    ts = u.get_timestamps()
+    assert len(ts) == 6
+    assert ts[0] == 1.0
+    assert ts[1] == pytest.approx(1.5)
+
+
+def test_burst_user_timestamps():
+    assert BurstUser(n_req=4, time=2.5).get_timestamps() == [2.5] * 4
+
+
+def test_schedule_from_users_sorted():
+    df = Scheduler.get_schedule_from_users([
+        SteadyUser(req_freq=1.0, duration=2.0, delay_start=0.5,
+                   prompt_tokens=100, response_tokens=50),
+        BurstUser(n_req=2, time=1.0),
+    ])
+    assert list(df.columns) == ["Timestamp", "Request tokens",
+                                "Response tokens", "User"]
+    assert df["Timestamp"].is_monotonic_increasing
+    assert set(df["Request tokens"]) == {100, 500}
+
+
+def test_schedule_from_trace_respects_max():
+    df = Scheduler.get_schedule_from_trace(
+        os.path.join(REPO, "data", "trace1.csv"), max_trace=4)
+    assert len(df) == 4
+    assert df["Request tokens"].dtype.kind == "i"
+
+
+def test_query_nearest_length_match():
+    inputs = [("short", 5, 10, ""), ("medium", 50, 10, ""),
+              ("long", 500, 10, ""), ("medium-long-out", 50, 200, "")]
+    sched = pd.DataFrame({
+        "Timestamp": [0.0, 1.0, 2.0, 3.0],
+        "Request tokens": [6, 45, 5000, 52],
+        "Response tokens": [10, 150, 10, 10],
+    })
+    q = Query(inputs, sched)
+    picks = [q.get_query() for _ in range(4)]
+    assert picks[0][0] == "short"
+    assert picks[1][0] == "medium-long-out"   # same prompt dist, closer output
+    assert picks[2][0] == "long"
+    assert picks[2][1] == 1024                # clamped to max_prompt_len
+    assert picks[3][0] in ("medium", "medium-long-out")
+    assert picks[3][2] == 10
+    q.reset()
+    assert q.get_query()[3] == 0              # query ids restart
+
+
+def test_query_rejects_empty_corpus():
+    with pytest.raises(ValueError):
+        Query([], pd.DataFrame({"Timestamp": [], "Request tokens": [],
+                                "Response tokens": []}))
+
+
+def test_dataloader_roundtrip(tmp_path):
+    corpus = {"0": {"prompt": "p", "len_prompt": 1, "len_output": 2,
+                    "output": "oo"}}
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps(corpus))
+    data = DataLoader.get_data_from_path(str(path))
+    assert data == [("p", 1, 2, "oo")]
+
+
+@pytest.fixture(scope="module")
+def corpus_and_trace(tmp_path_factory):
+    """Small corpus + dense 6-request trace for the hermetic replay."""
+    rng = np.random.default_rng(0)
+    tmp = tmp_path_factory.mktemp("harness")
+    corpus = {}
+    for i, (p, g) in enumerate([(5, 4), (12, 6), (30, 8), (60, 5)]):
+        corpus[str(i)] = {"prompt": "x" * p, "len_prompt": p,
+                          "len_output": g, "output": ""}
+    (tmp / "conversations.json").write_text(json.dumps(corpus))
+    with open(tmp / "trace.csv", "w") as f:
+        f.write("Timestamp,Request tokens,Response tokens\n")
+        for i in range(6):
+            f.write(f"{i * 0.1:.1f},{int(rng.integers(4, 64))},"
+                    f"{int(rng.integers(3, 8))}\n")
+    return tmp
+
+
+def test_end_to_end_replay_against_tpu_stack(corpus_and_trace):
+    """The full config-0 loop: harness -> HTTP -> scheduler -> engine ->
+    NDJSON stream -> metrics JSON, all in one process."""
+    from aiohttp import web
+
+    from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                      ServerConfig, tiny_llama)
+    from tpu_inference.server.http import InferenceServer
+
+    cfg = FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(page_size=8, num_pages=256, max_pages_per_seq=16,
+                            max_batch_size=4, prefill_buckets=(32, 64)),
+        server=ServerConfig(tokenizer="byte"))
+    server = InferenceServer(cfg)
+    tmp = corpus_and_trace
+
+    async def go():
+        runner = web.AppRunner(server.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        data = DataLoader.get_data_from_path(str(tmp / "conversations.json"))
+        schedule = Scheduler.get_schedule_from_trace(str(tmp / "trace.csv"))
+        collector = MetricCollector()
+        gen = TrafficGenerator(
+            data, schedule,
+            {"url": f"http://127.0.0.1:{port}/api/generate",
+             "model": "tiny-llama", "temperature": 0.0, "max_tokens": None,
+             "stream": True}, collector)
+        metrics = await gen.issue_queries()
+        await runner.cleanup()
+        return metrics
+
+    metrics = asyncio.run(go())
+    assert len(metrics) == 6
+    for qid, m in metrics.items():
+        assert METRIC_FIELDS <= set(m), f"query {qid} missing fields"
+        assert m["success"] is True
+        assert (m["scheduled_start_time"] <= m["request_start_time"]
+                <= m["first_token_arrive_time"] <= m["response_end_time"])
+        # TTFT contract: headers arrive with the first token, not before.
+        assert (m["first_token_arrive_time"] - m["response_headers_received_time"]
+                < 0.25)
+
+
+def test_replay_marks_failures(corpus_and_trace):
+    """Connection refused -> success=False, no crash (reference caught the
+    same errors; its exception *tracer* crashed on a global, main.py:220)."""
+    tmp = corpus_and_trace
+    data = DataLoader.get_data_from_path(str(tmp / "conversations.json"))
+    schedule = Scheduler.get_schedule_from_trace(str(tmp / "trace.csv"),
+                                                 max_trace=2)
+    collector = MetricCollector()
+    gen = TrafficGenerator(
+        data, schedule,
+        {"url": "http://127.0.0.1:9/api/generate", "model": "x",
+         "temperature": 0.0, "max_tokens": 5, "stream": True}, collector)
+    metrics = gen.start_profile()
+    assert all(m["success"] is False for m in metrics.values())

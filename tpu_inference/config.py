@@ -199,6 +199,9 @@ class ServerConfig:
     model_name: str = "tiny-llama"    # name echoed in NDJSON records
     tokenizer: str = "byte"           # "byte" | path to HF tokenizer
     request_timeout_s: float = 600.0
+    # Compile all engine graphs before accepting traffic (keeps XLA compile
+    # out of the first requests' TTFT).
+    warmup: bool = True
     # Hold HTTP headers until the first token is ready so client-side TTFT
     # (first streamed chunk) matches header-arrival time (SURVEY.md §2c).
     defer_headers_until_first_token: bool = True

@@ -174,6 +174,39 @@ class InferenceEngine:
     # Host-side orchestration
     # ------------------------------------------------------------------
 
+    def warmup(self) -> float:
+        """Compile every prefill bucket + the decode graph before serving.
+
+        Without this, the first requests pay XLA compile inside their TTFT
+        (and the compile blocks the GIL, starving the HTTP event loop so
+        streamed tokens burst out after headers). Shapes are what XLA keys
+        on, so prompt_len=1 per bucket suffices; writes land on the trash
+        page. Returns seconds spent.
+        """
+        t0 = time.perf_counter()
+        ecfg = self.engine_cfg
+        bt = np.zeros((1, self.max_pages), np.int32)
+        one = jnp.asarray([1], np.int32)
+        zero = jnp.asarray([0], np.int32)
+        tz = jnp.asarray([0.0], np.float32)
+        tp = jnp.asarray([1.0], np.float32)
+        for bucket in ecfg.prefill_buckets:
+            if bucket > ecfg.max_context:
+                continue
+            toks = jnp.zeros((1, bucket), jnp.int32)
+            self.kv, _, _ = self._prefill_jit(
+                self.params, self.kv, toks, one, zero, jnp.asarray(bt),
+                self._next_key(), tz, tp)
+        b = ecfg.max_batch_size
+        self.kv, _, _ = self._decode_jit(
+            self.params, self.kv, jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, self.max_pages), jnp.int32),
+            jnp.zeros((b,), bool), self._next_key(),
+            jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
+        jax.block_until_ready(self.kv)
+        return time.perf_counter() - t0
+
     def _next_key(self) -> jax.Array:
         self._step_count += 1
         return jax.random.fold_in(self._base_key, self._step_count)

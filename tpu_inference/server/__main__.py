@@ -27,14 +27,19 @@ def main() -> None:
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages-per-seq", type=int, default=64,
+                   help="max context = page-size * this")
+    p.add_argument("--no-warmup", action="store_true")
     args = p.parse_args()
 
     from tpu_inference.server.http import build_server
 
     server = build_server(model=args.model, tokenizer=args.tokenizer,
                           checkpoint=args.checkpoint,
+                          warmup=not args.no_warmup,
                           max_batch_size=args.max_batch_size,
-                          num_pages=args.num_pages, page_size=args.page_size)
+                          num_pages=args.num_pages, page_size=args.page_size,
+                          max_pages_per_seq=args.max_pages_per_seq)
     app = server.make_app()
     web.run_app(app, host=args.host, port=args.port)
 

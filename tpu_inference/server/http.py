@@ -74,6 +74,9 @@ class InferenceServer:
         return app
 
     async def _on_startup(self, app) -> None:
+        if self.cfg.server.warmup:
+            secs = self.engine.warmup()
+            print(f"engine warmup: compiled all graphs in {secs:.1f}s")
         self.scheduler.start()
 
     async def _on_cleanup(self, app) -> None:
@@ -232,18 +235,17 @@ class InferenceServer:
 
 
 def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
-                 checkpoint: Optional[str] = None, **engine_overrides
-                 ) -> InferenceServer:
+                 checkpoint: Optional[str] = None, warmup: bool = True,
+                 **engine_overrides) -> InferenceServer:
     """Convenience constructor used by CLI, tests, and benchmarks."""
-    import dataclasses
-
     from tpu_inference.config import EngineConfig, ServerConfig
 
     model_cfg = PRESETS[model]()
     engine_cfg = EngineConfig(**engine_overrides) if engine_overrides else EngineConfig()
     cfg = FrameworkConfig(model=model_cfg, engine=engine_cfg,
                           server=ServerConfig(model_name=model,
-                                              tokenizer=tokenizer),
+                                              tokenizer=tokenizer,
+                                              warmup=warmup),
                           checkpoint_path=checkpoint)
     if checkpoint:
         from tpu_inference.models import weights

@@ -1,0 +1,28 @@
+"""Benchmark client: BurstGPT trace replay with per-request latency tracing.
+
+Clean-room re-implementation of the reference harness (SURVEY.md §2a
+components 1-9; reference: traffic_generator/main.py) with its known defects
+fixed:
+
+- the exception-tracing callback no longer touches a global logger
+  (reference bug at main.py:220);
+- ``max_tokens`` / ``temperature`` are sent both at the top level (where the
+  reference put them) and under ``options`` (where Ollama actually reads
+  them), so the knobs take effect against either server;
+- the nearest-length query matcher is vectorized numpy instead of a 1M-cell
+  Python-loop table build (reference main.py:96-154);
+- synthetic user schedules take configurable token sizes (reference
+  hardcoded 500/500 at main.py:69-70).
+
+The per-request metrics JSON schema is preserved exactly
+(reference logs/log.json): ``number_of_input_tokens, request_start_time,
+response_headers_received_time, first_token_arrive_time, response_end_time,
+scheduled_start_time, success``.
+"""
+
+from traffic_generator.data import DataLoader  # noqa: F401
+from traffic_generator.generator import TrafficGenerator  # noqa: F401
+from traffic_generator.metrics import MetricCollector, RequestTracer  # noqa: F401
+from traffic_generator.query import Query  # noqa: F401
+from traffic_generator.schedule import Scheduler  # noqa: F401
+from traffic_generator.users import BurstUser, SteadyUser  # noqa: F401

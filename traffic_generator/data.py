@@ -1,0 +1,26 @@
+"""Prompt-corpus loading (reference: main.py:40-51).
+
+Corpus format (`conversations.json`): ``{id: {"prompt": str,
+"len_prompt": int, "len_output": int, "output": str}}`` — schema per
+SURVEY.md §2a #3.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+Entry = Tuple[str, int, int, str]  # (prompt, len_prompt, len_output, output)
+
+
+class DataLoader:
+    @staticmethod
+    def load_json_from_path(path: str) -> dict:
+        with open(path) as f:
+            return json.load(f)
+
+    @classmethod
+    def get_data_from_path(cls, path: str) -> List[Entry]:
+        raw = cls.load_json_from_path(path)
+        return [(v["prompt"], int(v["len_prompt"]), int(v["len_output"]),
+                 v.get("output", "")) for v in raw.values()]

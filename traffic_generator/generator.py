@@ -1,0 +1,101 @@
+"""The traffic driver: open-loop trace replay over HTTP
+(reference: main.py:230-294).
+
+One coroutine per scheduled request: sleep until the scheduled arrival time,
+POST to the Ollama-protocol endpoint, stream the NDJSON body, and record
+TTFT (first streamed chunk), end-to-end latency, and success — all relative
+to the shared session epoch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Sequence
+
+import aiohttp
+import pandas as pd
+
+from traffic_generator.data import Entry
+from traffic_generator.metrics import MetricCollector
+from traffic_generator.query import Query
+
+
+class TrafficGenerator:
+    """Replays a schedule against ``config['url']``.
+
+    config keys (reference main.py:302-313 compatible): ``url``, ``model``,
+    ``temperature``, ``max_tokens``, ``stream``, plus optional
+    ``request_timeout`` (seconds).
+    """
+
+    def __init__(self, data: Sequence[Entry], schedule: pd.DataFrame,
+                 config: dict, logger: MetricCollector,
+                 max_prompt_len: int = 1024, max_gen_len: int = 1024):
+        self.config = dict(config)
+        self.logger = logger
+        self.queries = Query(data, schedule, max_prompt_len=max_prompt_len,
+                             max_gen_len=max_gen_len)
+
+    def _payload(self, prompt: str, len_output: int) -> dict:
+        temperature = float(self.config.get("temperature", 0.0))
+        # Per-query generation length comes from the trace (the reference
+        # sent a fixed config['max_tokens'] for every request, at a JSON
+        # level Ollama ignores — SURVEY.md §2a "known defects").
+        max_tokens = int(self.config.get("max_tokens") or len_output)
+        return {
+            "model": self.config.get("model", "default"),
+            "prompt": prompt,
+            "temperature": temperature,
+            "max_tokens": max_tokens,
+            "stream": bool(self.config.get("stream", True)),
+            "options": {"temperature": temperature,
+                        "num_predict": max_tokens},
+        }
+
+    async def inference_call(self, session: aiohttp.ClientSession,
+                             prompt: str, len_output: int, sleep_time: float,
+                             query_id: int) -> None:
+        collector = self.logger
+        await asyncio.sleep(sleep_time)
+        try:
+            async with session.post(
+                    self.config["url"], json=self._payload(prompt, len_output),
+                    trace_request_ctx={"query_id": query_id,
+                                       "collector": collector}) as resp:
+                resp.raise_for_status()
+                first = True
+                async for _chunk in resp.content:
+                    if first:
+                        collector.record(query_id, "first_token_arrive_time",
+                                         collector.elapsed())
+                        first = False
+                collector.record(query_id, "response_end_time",
+                                 collector.elapsed())
+                collector.record(query_id, "success", True)
+                print(f"[END] query {query_id}")
+        except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
+            # ClientError covers response/connection AND payload errors
+            # (mid-stream resets); one failed query must never abort the
+            # whole gather and lose the run's metrics.
+            collector.record(query_id, "success", False)
+            print(f"[FAIL] query {query_id}: {exc!r}")
+
+    async def issue_queries(self) -> dict:
+        timeout = aiohttp.ClientTimeout(
+            total=float(self.config.get("request_timeout", 600.0)))
+        async with aiohttp.ClientSession(
+                trace_configs=[self.logger.trace_config],
+                timeout=timeout) as session:
+            calls = []
+            for _ in range(len(self.queries)):
+                prompt, len_p, len_g, qid, t = self.queries.get_query()
+                self.logger.init_query(qid, len_p, t)
+                calls.append(self.inference_call(session, prompt, len_g, t,
+                                                 qid))
+            self.logger.start_session()
+            await asyncio.gather(*calls)
+        return self.logger.metrics
+
+    def start_profile(self) -> dict:
+        self.queries.reset()
+        return asyncio.run(self.issue_queries())

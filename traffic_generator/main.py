@@ -1,0 +1,72 @@
+"""Benchmark harness entry point (reference: main.py:298-343).
+
+``python traffic_generator/main.py`` replays a BurstGPT-format trace against
+an Ollama-protocol endpoint and writes per-request latency metrics to JSON.
+The config dict keys match the reference (trace_path, data_path, max_trace,
+url, model, temperature, max_tokens, log_path), and argparse overrides are
+enabled (the reference left argparse commented out, main.py:4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from traffic_generator.data import DataLoader  # noqa: E402
+from traffic_generator.generator import TrafficGenerator  # noqa: E402
+from traffic_generator.metrics import MetricCollector  # noqa: E402
+from traffic_generator.schedule import Scheduler  # noqa: E402
+
+MAX_PROMPT_LEN = 1024
+MAX_GEN_LEN = 1024
+
+config = {
+    "trace_path": "data/trace1.csv",
+    "data_path": "data/conversations.json",
+    "max_trace": 100,
+    "url": "http://127.0.0.1:11434/api/generate",
+    "model": "tiny-llama",
+    "temperature": 0.0,
+    "max_tokens": None,       # None -> per-query length from the trace
+    "stream": True,
+    "log_path": "logs/log.json",
+}
+
+
+def parse_args() -> dict:
+    p = argparse.ArgumentParser(description="BurstGPT trace replay harness")
+    for key, val in config.items():
+        arg = "--" + key.replace("_", "-")
+        if isinstance(val, bool):
+            p.add_argument(arg, default=val,
+                           type=lambda s: s.lower() not in ("0", "false", "no"))
+        elif val is None:
+            p.add_argument(arg, default=None)
+        else:
+            p.add_argument(arg, type=type(val), default=val)
+    return vars(p.parse_args())
+
+
+def main() -> dict:
+    cfg = {**config, **{k: v for k, v in parse_args().items() if v is not None}}
+    data = DataLoader.get_data_from_path(cfg["data_path"])
+    schedule = Scheduler.get_schedule_from_trace(cfg["trace_path"],
+                                                 cfg["max_trace"])
+    print(schedule)
+    collector = MetricCollector()
+    generator = TrafficGenerator(data, schedule, cfg, collector,
+                                 max_prompt_len=MAX_PROMPT_LEN,
+                                 max_gen_len=MAX_GEN_LEN)
+    metrics = generator.start_profile()
+    print(metrics)
+    log_path = cfg["log_path"]
+    os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+    collector.save(log_path)
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Per-request latency instrumentation (reference: main.py:184-222).
+
+``RequestTracer`` subclasses ``aiohttp.TraceConfig`` and records request
+lifecycle timestamps relative to the collector's session epoch. All state
+flows through ``trace_request_ctx`` — no globals (the reference's exception
+callback referenced a global ``logger`` and raised NameError when used as a
+library, main.py:220).
+
+Output schema (preserved exactly; reference logs/log.json):
+per query id -> ``{number_of_input_tokens, request_start_time,
+response_headers_received_time, first_token_arrive_time, response_end_time,
+scheduled_start_time, success}``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict
+
+import aiohttp
+
+
+class MetricCollector:
+    """Accumulates per-request metric dicts; JSON-serializable."""
+
+    def __init__(self):
+        self.metrics: Dict[int, dict] = {}
+        self.session_start_timestamp: float = 0.0
+        self.trace_config = RequestTracer()
+
+    def start_session(self) -> None:
+        self.session_start_timestamp = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.session_start_timestamp
+
+    def init_query(self, query_id: int, n_input_tokens: int,
+                   scheduled_start: float) -> None:
+        # Timing fields default to null so failed requests keep the full
+        # reference schema (reference main.py:274-277 wrote None on failure).
+        self.metrics[query_id] = {
+            "number_of_input_tokens": n_input_tokens,
+            "request_start_time": None,
+            "response_headers_received_time": None,
+            "first_token_arrive_time": None,
+            "response_end_time": None,
+            "scheduled_start_time": scheduled_start,
+            "success": None,
+        }
+
+    def record(self, query_id: int, field: str, value) -> None:
+        self.metrics.setdefault(query_id, {})[field] = value
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.metrics, f, indent=1)
+
+
+class RequestTracer(aiohttp.TraceConfig):
+    """aiohttp request-lifecycle hooks -> MetricCollector fields."""
+
+    def __init__(self):
+        super().__init__()
+        self.on_request_start.append(self._on_start)
+        self.on_request_end.append(self._on_end)
+        self.on_request_exception.append(self._on_exception)
+
+    @staticmethod
+    def _ctx(context):
+        ctx = context.trace_request_ctx or {}
+        return ctx.get("collector"), ctx.get("query_id")
+
+    async def _on_start(self, session, context, params) -> None:
+        collector, qid = self._ctx(context)
+        if collector is None:
+            return
+        collector.record(qid, "request_start_time", collector.elapsed())
+        print(f"[START] query {qid}")
+
+    async def _on_end(self, session, context, params) -> None:
+        collector, qid = self._ctx(context)
+        if collector is None:
+            return
+        collector.record(qid, "response_headers_received_time",
+                         collector.elapsed())
+
+    async def _on_exception(self, session, context, params) -> None:
+        collector, qid = self._ctx(context)
+        if collector is None:
+            return
+        collector.record(qid, "success", False)
+        print(f"[ERROR] query {qid}: {params.exception!r}")

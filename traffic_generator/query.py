@@ -1,0 +1,68 @@
+"""Query synthesis: match scheduled token lengths to corpus prompts.
+
+Replaces the reference's O(P*G) Python-loop lookup-table build
+(main.py:96-154) with a vectorized nearest-neighbor search over the corpus:
+for a scheduled (prompt_len, output_len) pair, pick the corpus entry with
+the nearest prompt length, breaking ties by nearest output length — the
+same row-first priority the reference's table fill encodes, computed as a
+single lexicographic distance argmin per query.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+import pandas as pd
+
+from traffic_generator.data import Entry
+
+
+class Query:
+    """Iterates a schedule, yielding length-matched prompts.
+
+    ``get_query() -> [prompt, len_prompt, len_output, query_id, timestamp]``
+    (reference main.py:156-175 contract; the reference's ``prompr`` typo is
+    not preserved).
+    """
+
+    def __init__(self, inputs: Sequence[Entry], schedule: pd.DataFrame,
+                 max_prompt_len: int = 1024, max_gen_len: int = 1024):
+        if len(inputs) == 0:
+            raise ValueError("empty corpus")
+        self.inputs = list(inputs)
+        self.schedule = schedule.sort_values(
+            "Timestamp", kind="stable").reset_index(drop=True)
+        self.max_prompt_len = max_prompt_len
+        self.max_gen_len = max_gen_len
+        self._corpus_p = np.array([e[1] for e in self.inputs])
+        self._corpus_g = np.array([e[2] for e in self.inputs])
+        self._match_idx = self._match_all()
+        self.query_id = -1
+
+    def _match_all(self) -> np.ndarray:
+        """Vectorized nearest-length match for every schedule row."""
+        want_p = np.minimum(self.schedule["Request tokens"].to_numpy(),
+                            self.max_prompt_len)
+        want_g = np.minimum(self.schedule["Response tokens"].to_numpy(),
+                            self.max_gen_len)
+        # [n_sched, n_corpus] distances; prompt distance dominates.
+        dp = np.abs(self._corpus_p[None, :] - want_p[:, None]).astype(np.int64)
+        dg = np.abs(self._corpus_g[None, :] - want_g[:, None]).astype(np.int64)
+        weight = int(max(self._corpus_g.max(), self.max_gen_len)) + 1
+        return np.argmin(dp * weight + dg, axis=1)
+
+    def __len__(self) -> int:
+        return len(self.schedule)
+
+    def reset(self) -> None:
+        self.query_id = -1
+
+    def get_query(self) -> List:
+        self.query_id += 1
+        row = self.schedule.iloc[self.query_id]
+        len_p = int(min(row["Request tokens"], self.max_prompt_len))
+        len_g = int(min(row["Response tokens"], self.max_gen_len))
+        entry = self.inputs[self._match_idx[self.query_id]]
+        return [entry[0], len_p, len_g, self.query_id,
+                float(row["Timestamp"])]

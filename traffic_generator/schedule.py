@@ -1,0 +1,44 @@
+"""Client-side arrival schedules (reference: main.py:53-84).
+
+A schedule is a DataFrame with columns ``Timestamp`` (float seconds),
+``Request tokens`` and ``Response tokens`` (ints) — BurstGPT trace format —
+plus an optional ``User`` column for synthetic-user schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Union
+
+import pandas as pd
+
+from traffic_generator.users import BurstUser, SteadyUser
+
+User = Union[SteadyUser, BurstUser]
+
+TRACE_DTYPES = {"Timestamp": float, "Request tokens": int,
+                "Response tokens": int}
+
+
+class Scheduler:
+    """Builds arrival schedules from trace files or synthetic users."""
+
+    @staticmethod
+    def get_schedule_from_trace(path: str,
+                                max_trace: Optional[int] = None) -> pd.DataFrame:
+        df = pd.read_csv(path, usecols=list(TRACE_DTYPES)).astype(TRACE_DTYPES)
+        if max_trace is not None:
+            df = df.head(max_trace)
+        return df.reset_index(drop=True)
+
+    @staticmethod
+    def get_schedule_from_users(users: Iterable[User]) -> pd.DataFrame:
+        rows = []
+        for uid, user in enumerate(users):
+            for t in user.get_timestamps():
+                rows.append({"Timestamp": float(t),
+                             "Request tokens": user.prompt_tokens,
+                             "Response tokens": user.response_tokens,
+                             "User": uid})
+        df = pd.DataFrame(rows, columns=["Timestamp", "Request tokens",
+                                         "Response tokens", "User"])
+        return df.sort_values("Timestamp", kind="stable").reset_index(drop=True)

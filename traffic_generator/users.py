@@ -1,0 +1,44 @@
+"""Synthetic user arrival models (reference: main.py:13-37).
+
+Each user model produces a list of request timestamps (seconds from replay
+start); the Scheduler turns a set of users into an arrival schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass
+class SteadyUser:
+    """Fires requests at a constant rate for a fixed duration.
+
+    Timestamps: delay_start, delay_start + 1/rate, ... (reference
+    main.py:13-27 semantics).
+    """
+
+    req_freq: float              # requests per second
+    duration: float              # seconds of activity
+    delay_start: float = 0.0
+    # Token sizes for schedule synthesis (reference hardcoded 500/500).
+    prompt_tokens: int = 500
+    response_tokens: int = 500
+
+    def get_timestamps(self) -> List[float]:
+        n = max(0, round(self.duration * self.req_freq))
+        return [self.delay_start + i / self.req_freq for i in range(n)]
+
+
+@dataclasses.dataclass
+class BurstUser:
+    """Fires n_req simultaneous requests at one instant (reference
+    main.py:30-37)."""
+
+    n_req: int
+    time: float = 0.0
+    prompt_tokens: int = 500
+    response_tokens: int = 500
+
+    def get_timestamps(self) -> List[float]:
+        return [self.time] * self.n_req
