@@ -1,23 +1,25 @@
 """Test environment: force CPU backend with a virtual 8-device mesh.
 
-Must run before jax initializes its backend, hence env mutation at import
-time in conftest (pytest imports conftest before any test module).
-Multi-chip sharding tests (TP/EP/ring attention) run on these 8 virtual CPU
+Multi-chip sharding tests (TP/EP/ring attention) run on 8 virtual CPU
 devices; real-TPU behavior is exercised by bench.py and the driver's
 dryrun_multichip hook.
+
+This image boots every interpreter with JAX_PLATFORMS=axon and a
+sitecustomize that imports jax and registers the axon (TPU-tunnel) PJRT
+plugin before conftest runs, so setting JAX_PLATFORMS/XLA_FLAGS env vars
+here is too late — jax read them at its (sitecustomize-time) import.
+Backends initialize lazily though, so overriding via jax.config before
+any computation still works and avoids the slow/flaky tunnel dial.
 """
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-# XLA:CPU's oneDNN matmuls run in reduced precision by default (~1e-1 abs
-# error on standard-normal f32 inputs), which swamps parity tolerances.
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
-import jax  # noqa: E402  (after env mutation, which is the point)
+import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+# XLA:CPU's oneDNN matmuls run in reduced precision by default (~1e-1 abs
+# error on standard-normal f32 inputs), which swamps parity tolerances.
 jax.config.update("jax_default_matmul_precision", "highest")
