@@ -143,8 +143,11 @@ class ParallelConfig:
     """Device-mesh axes. Axis size 1 disables that axis.
 
     The mesh is (dp, tp, sp). TP shards attention heads and FFN hidden dim
-    with XLA all-reduce over ICI; EP (Mixtral) reuses the tp axis for experts;
-    SP (ring attention / sequence parallelism) shards the sequence dim.
+    with XLA all-reduce over ICI; EP (Mixtral) reuses the tp axis for experts
+    (parallel/shardings.py). SP shards the sequence dim for ring-attention
+    prefill (kernels/ring_attention.py). DP is replica-per-group serving:
+    the server runs one engine per dp group; a dp>1 mesh on a single engine
+    replicates compute without speedup.
     """
 
     dp: int = 1

@@ -96,7 +96,8 @@ class InferenceEngine:
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
                  params: Optional[dict] = None, seed: int = 0,
                  attn_backend: str = "dense",
-                 shard_fn: Optional[Callable[[dict], dict]] = None):
+                 shard_fn: Optional[Callable[[dict], dict]] = None,
+                 mesh: Optional[Any] = None):
         model_cfg.validate()
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
@@ -105,9 +106,26 @@ class InferenceEngine:
             params, _ = build_model(model_cfg, seed=seed)
         if shard_fn is not None:
             params = shard_fn(params)
+        self.mesh = mesh
+        kv_sh = None
+        if mesh is not None and attn_backend == "pallas":
+            # The Pallas paged-attention custom call has no GSPMD
+            # partitioning rule yet; under a sharded KV pool it would
+            # all-gather the whole pool per chip. Sharded decode uses the
+            # dense path until the kernel is shard_map-wrapped.
+            raise ValueError(
+                "attn_backend='pallas' is single-device only for now; "
+                "use the default dense path with mesh")
+        if mesh is not None:
+            # Declarative TP/EP: annotate weights + KV pool, let GSPMD place
+            # the ICI collectives. The jitted graphs pick the shardings up
+            # from their inputs; donated KV keeps its sharding step to step.
+            from tpu_inference.parallel import shardings as shd
+            params = shd.shard_params(params, model_cfg, mesh)
+            kv_sh = shd.kv_sharding(mesh)
         self.params = params
         self.attn_backend = attn_backend
-        self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg)
+        self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
         self.max_pages = engine_cfg.max_pages_per_seq
         self._base_key = jax.random.PRNGKey(seed)

@@ -46,11 +46,14 @@ class KVPages(NamedTuple):
 
 
 def alloc_kv_pages(model_cfg: ModelConfig, engine_cfg: EngineConfig,
-                   dtype=None) -> KVPages:
+                   dtype=None, sharding=None) -> KVPages:
+    """Allocate the pool; with ``sharding`` each chip materializes only its
+    shard (never the full replicated pool — at 70B scale that would OOM)."""
     shape = (model_cfg.n_layers, engine_cfg.num_pages, engine_cfg.page_size,
              model_cfg.n_kv_heads, model_cfg.head_dim)
     dtype = dtype or model_cfg.dtype
-    return KVPages(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+    return KVPages(k=zeros(), v=zeros())
 
 
 def slot_mapping(block_tables: jax.Array, positions: jax.Array,

@@ -1,0 +1,27 @@
+"""Parallelism: device mesh + NamedSharding specs (TP/EP/DP/SP).
+
+The reference has no distributed backend — its only cross-process hop is a
+client-side HTTP POST (reference: traffic_generator/main.py:257); server-side
+parallelism belonged to the external Ollama/vLLM deployment (SURVEY.md §2b).
+Here parallelism is first-class and TPU-native: a `jax.sharding.Mesh` over
+the slice, `NamedSharding` annotations on weights and KV pages, and XLA
+emitting the all-reduce/all-to-all collectives over ICI.
+"""
+
+from tpu_inference.parallel.mesh import build_mesh
+from tpu_inference.parallel.shardings import (
+    kv_sharding,
+    param_shardings,
+    param_specs,
+    shard_params,
+    validate_tp,
+)
+
+__all__ = [
+    "build_mesh",
+    "param_specs",
+    "param_shardings",
+    "shard_params",
+    "kv_sharding",
+    "validate_tp",
+]

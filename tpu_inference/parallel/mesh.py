@@ -1,0 +1,38 @@
+"""Device mesh construction.
+
+Axes (ParallelConfig): ``dp`` replicates the model for throughput, ``tp``
+shards attention heads / FFN hidden / experts with all-reduce (or all-to-all
+for MoE) over ICI, ``sp`` shards the sequence dim for ring attention.
+Any axis of size 1 is a no-op; the specs in shardings.py reference axis
+*names*, so the same annotations work at every mesh shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence  # noqa: F401 (Optional in annotations)
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_inference.config import ParallelConfig
+
+AXES = ("dp", "tp", "sp")
+
+
+def build_mesh(pcfg: ParallelConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Mesh over the first dp*tp*sp devices, axes ('dp', 'tp', 'sp').
+
+    On a real slice, `jax.devices()` order follows the physical torus, so
+    contiguous tp groups ride ICI neighbors; dp is the outermost (slowest)
+    axis, which is the standard layout for replica-over-slice serving.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = pcfg.n_devices
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh needs {n} devices (dp={pcfg.dp} tp={pcfg.tp} "
+            f"sp={pcfg.sp}); only {len(devices)} visible")
+    arr = np.asarray(devices[:n]).reshape(pcfg.dp, pcfg.tp, pcfg.sp)
+    return Mesh(arr, AXES)

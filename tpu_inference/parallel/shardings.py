@@ -1,0 +1,141 @@
+"""NamedSharding specs for model params and the paged KV pool.
+
+Megatron-style tensor parallelism expressed declaratively: annotate the
+weights, let GSPMD place the collectives.
+
+- QKV projections shard the *head* (output) dim; the attention output
+  projection shards its *input* dim — one all-reduce per attention block.
+- SwiGLU gate/up shard the hidden (f) dim; down shards its input — one
+  all-reduce per FFN.
+- Mixtral experts shard the *expert* dim over the same ``tp`` axis
+  (expert parallelism): the dispatch/combine einsums in
+  models/mixtral.py:moe_ffn become all-to-alls over ICI.
+- Embedding and lm_head shard the vocab dim (vocab-parallel logits).
+- KV pages shard the kv-head dim, which keeps the paged pool's per-chip
+  slice aligned with the head-sharded K/V projections — no resharding
+  between projection, cache write, and attention.
+
+The reference has no analogue of any of this (SURVEY.md §2b: parallelism was
+a property of its external server); the sharding design follows the
+jax-ml scaling-book recipe: pick a mesh, annotate, let XLA insert
+collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_inference.config import ModelConfig
+
+
+def _llama_specs(cfg: ModelConfig) -> dict:
+    specs = {
+        "embed": P("tp", None),
+        "blocks": {
+            "attn_norm": P(),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "ffn_norm": P(),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def _mixtral_specs(cfg: ModelConfig) -> dict:
+    return {
+        "embed": P("tp", None),
+        "blocks": {
+            "attn_norm": P(),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "ffn_norm": P(),
+            "w_router": P(),
+            # Expert parallelism: experts distributed over the tp axis.
+            "w_gate": P(None, "tp", None, None),
+            "w_up": P(None, "tp", None, None),
+            "w_down": P(None, "tp", None, None),
+        },
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def _gpt2_specs(cfg: ModelConfig) -> dict:
+    # w_qkv packs [q|k|v] along the output dim; with MHA (Hq == Hkv) each
+    # third is d_model wide, so a tp shard of the packed dim stays
+    # head-aligned after the split as long as tp divides n_heads.
+    return {
+        "embed": P("tp", None),
+        "pos_embed": P(),
+        "blocks": {
+            "ln1_w": P(), "ln1_b": P(),
+            "w_qkv": P(None, None, "tp"),
+            "b_qkv": P(None, "tp"),
+            "w_proj": P(None, "tp", None),
+            "b_proj": P(),
+            "ln2_w": P(), "ln2_b": P(),
+            "w_fc": P(None, None, "tp"),
+            "b_fc": P(None, "tp"),
+            "w_out": P(None, "tp", None),
+            "b_out": P(),
+        },
+        "ln_f_w": P(), "ln_f_b": P(),
+    }
+
+
+def validate_tp(cfg: ModelConfig, tp: int) -> None:
+    """Fail fast (with a named dimension) when tp can't evenly shard the
+    model, instead of an opaque GSPMD error deep inside engine init."""
+    checks = [
+        ("n_heads", cfg.n_heads),
+        ("n_kv_heads", cfg.n_kv_heads),
+        ("d_ff", cfg.d_ff),
+        ("vocab_size", cfg.vocab_size),
+    ]
+    if cfg.n_experts:
+        checks.append(("n_experts", cfg.n_experts))
+    for name, dim in checks:
+        if dim % tp != 0:
+            raise ValueError(
+                f"tp={tp} does not divide {name}={dim} for model "
+                f"{cfg.name!r}; choose tp from the divisors of {name}")
+
+
+def param_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpec pytree with the same structure as the family's params."""
+    fam = {"llama": _llama_specs, "mixtral": _mixtral_specs,
+           "gpt2": _gpt2_specs}[cfg.family]
+    return fam(cfg)
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Any:
+    validate_tp(cfg, mesh.shape.get("tp", 1))
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs(cfg),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
+    """Place a params pytree onto the mesh per `param_specs`."""
+    return jax.tree.map(jax.device_put, params, param_shardings(cfg, mesh))
+
+
+def kv_spec() -> P:
+    """KV pool [L, pages, page_size, Hkv, head_dim]: shard kv heads on tp."""
+    return P(None, None, None, "tp", None)
+
+
+def kv_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, kv_spec())
