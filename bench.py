@@ -1,0 +1,86 @@
+"""Benchmark: batched decode throughput through the serving engine.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: tokens/s of continuous-batching decode (batch=8) on a 1B-class
+Llama-shape model (TinyLlama-1.1B dims) with the paged KV cache — the
+engine's steady-state serving path. Baseline: the only decode-rate number
+recorded anywhere in the reference, Ollama serving `mistral` on the
+reference author's host at ~93 tok/s single-stream (BASELINE.md,
+reference notebooks/aiohttp_tracing.ipynb cell e01c6727 output).
+
+On non-TPU platforms (driver smoke runs) the model drops to test scale so
+the script stays fast; `vs_baseline` is only meaningful on TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_inference.config import EngineConfig, ModelConfig, tiny_llama
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+
+BASELINE_TOK_S = 93.0  # BASELINE.md: reference-side Ollama decode rate
+
+
+def bench_cfg(platform: str) -> ModelConfig:
+    if platform != "tpu":
+        return tiny_llama()
+    return ModelConfig(
+        name="llama-1b-bench", family="llama", vocab_size=32000, d_model=2048,
+        n_layers=22, n_heads=32, n_kv_heads=4, d_ff=5632, max_seq_len=2048,
+        rope_theta=10000.0, dtype=jnp.bfloat16,
+    )
+
+
+def main() -> None:
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bench_cfg(platform)
+    batch = 8
+    prompt_len = 120
+    decode_steps = 256 if on_tpu else 16
+    ecfg = EngineConfig(page_size=16, num_pages=512, max_pages_per_seq=32,
+                        max_batch_size=batch, prefill_buckets=(128,),
+                        max_new_tokens=decode_steps + 1)
+    print(f"[bench] platform={platform} model={cfg.name}", file=sys.stderr)
+    engine = InferenceEngine(cfg, ecfg)
+    t = engine.warmup()
+    print(f"[bench] warmup (XLA compile) {t:.1f}s", file=sys.stderr)
+
+    rng = np.random.default_rng(0)
+    for i in range(batch):
+        seq = Sequence(request_id=i,
+                       prompt_tokens=rng.integers(
+                           1, cfg.vocab_size, prompt_len).tolist(),
+                       max_new_tokens=decode_steps + 1)
+        engine.prefill(seq)
+
+    # Timed steady-state decode: full batch advances one token per step.
+    for _ in range(8):                       # un-timed ramp
+        engine.decode_step()
+    jax.block_until_ready(engine.kv.k)
+    t0 = time.perf_counter()
+    produced = 0
+    for _ in range(decode_steps):
+        produced += len(engine.decode_step())
+    jax.block_until_ready(engine.kv.k)
+    dt = time.perf_counter() - t0
+
+    tok_s = produced / dt
+    print(json.dumps({
+        "metric": "decode_tok_s_llama1b_bs8_paged",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,9 +145,10 @@ class ParallelConfig:
     The mesh is (dp, tp, sp). TP shards attention heads and FFN hidden dim
     with XLA all-reduce over ICI; EP (Mixtral) reuses the tp axis for experts
     (parallel/shardings.py). SP shards the sequence dim for ring-attention
-    prefill (kernels/ring_attention.py). DP is replica-per-group serving:
-    the server runs one engine per dp group; a dp>1 mesh on a single engine
-    replicates compute without speedup.
+    prefill. The server builds a mesh from this config when n_devices > 1
+    (server/http.py InferenceServer.__init__). A dp > 1 axis replicates
+    params/compute on a single engine (used by the driver dry run); true
+    replica-per-group serving is one server process per dp group.
     """
 
     dp: int = 1

@@ -102,20 +102,25 @@ class InferenceEngine:
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.mod = get_model_fns(model_cfg)
+        # Validate mesh/backend compatibility BEFORE materializing params —
+        # at 70B scale a post-init failure wastes minutes (or OOMs).
+        if mesh is not None:
+            if attn_backend == "pallas":
+                # The Pallas paged-attention custom call has no GSPMD
+                # partitioning rule yet; under a sharded KV pool it would
+                # all-gather the whole pool per chip. Sharded decode uses
+                # the dense path until the kernel is shard_map-wrapped.
+                raise ValueError(
+                    "attn_backend='pallas' is single-device only for now; "
+                    "use the default dense path with mesh")
+            from tpu_inference.parallel import shardings as _shd
+            _shd.validate_tp(model_cfg, mesh.shape.get("tp", 1))
         if params is None:
             params, _ = build_model(model_cfg, seed=seed)
         if shard_fn is not None:
             params = shard_fn(params)
         self.mesh = mesh
         kv_sh = None
-        if mesh is not None and attn_backend == "pallas":
-            # The Pallas paged-attention custom call has no GSPMD
-            # partitioning rule yet; under a sharded KV pool it would
-            # all-gather the whole pool per chip. Sharded decode uses the
-            # dense path until the kernel is shard_map-wrapped.
-            raise ValueError(
-                "attn_backend='pallas' is single-device only for now; "
-                "use the default dense path with mesh")
         if mesh is not None:
             # Declarative TP/EP: annotate weights + KV pool, let GSPMD place
             # the ICI collectives. The jitted graphs pick the shardings up

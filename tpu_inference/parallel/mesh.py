@@ -34,5 +34,8 @@ def build_mesh(pcfg: ParallelConfig,
         raise ValueError(
             f"mesh needs {n} devices (dp={pcfg.dp} tp={pcfg.tp} "
             f"sp={pcfg.sp}); only {len(devices)} visible")
-    arr = np.asarray(devices[:n]).reshape(pcfg.dp, pcfg.tp, pcfg.sp)
-    return Mesh(arr, AXES)
+    # tp is the chattiest axis (per-layer all-reduce), so make tp groups
+    # contiguous in device order (= ICI neighbors on a torus): lay devices
+    # out as (dp, sp, tp) then swap to the (dp, tp, sp) axis order.
+    arr = np.asarray(devices[:n]).reshape(pcfg.dp, pcfg.sp, pcfg.tp)
+    return Mesh(arr.transpose(0, 2, 1), AXES)

@@ -74,9 +74,12 @@ def _mixtral_specs(cfg: ModelConfig) -> dict:
 
 
 def _gpt2_specs(cfg: ModelConfig) -> dict:
-    # w_qkv packs [q|k|v] along the output dim; with MHA (Hq == Hkv) each
-    # third is d_model wide, so a tp shard of the packed dim stays
-    # head-aligned after the split as long as tp divides n_heads.
+    # w_qkv packs [q|k|v] along the output dim (3*d_model wide). A contiguous
+    # tp shard of the packed dim crosses the q/k/v boundaries unless tp is a
+    # multiple of 3, so GSPMD reshards around the split in gpt2._block —
+    # correct but costs extra collectives. gpt2 is the CPU-stub/parity model
+    # (BASELINE.json config 0), never the TP-serving flagship, so the simple
+    # packed sharding is kept.
     return {
         "embed": P("tp", None),
         "pos_embed": P(),

@@ -52,8 +52,13 @@ class InferenceServer:
                  engine: Optional[InferenceEngine] = None):
         self.cfg = cfg
         t0 = time.perf_counter()
+        mesh = None
+        if engine is None and cfg.parallel.n_devices > 1:
+            from tpu_inference.parallel.mesh import build_mesh
+
+            mesh = build_mesh(cfg.parallel)
         self.engine = engine or InferenceEngine(cfg.model, cfg.engine,
-                                                seed=cfg.seed)
+                                                seed=cfg.seed, mesh=mesh)
         self.tokenizer = build_tokenizer(cfg.server.tokenizer,
                                          vocab_size=cfg.model.vocab_size)
         self.load_duration_ns = int((time.perf_counter() - t0) * 1e9)
