@@ -45,10 +45,13 @@ def main() -> None:
     cfg = bench_cfg(platform)
     batch = 8
     prompt_len = 120
-    decode_steps = 256 if on_tpu else 16
+    k = 8                                    # fused decode steps per dispatch
+    timed_calls = 32 if on_tpu else 2
+    ramp_calls = 2
+    budget = (timed_calls + ramp_calls + 1) * k
     ecfg = EngineConfig(page_size=16, num_pages=512, max_pages_per_seq=32,
                         max_batch_size=batch, prefill_buckets=(128,),
-                        max_new_tokens=decode_steps + 1)
+                        decode_steps_per_call=k, max_new_tokens=budget)
     print(f"[bench] platform={platform} model={cfg.name}", file=sys.stderr)
     engine = InferenceEngine(cfg, ecfg)
     t = engine.warmup()
@@ -59,17 +62,17 @@ def main() -> None:
         seq = Sequence(request_id=i,
                        prompt_tokens=rng.integers(
                            1, cfg.vocab_size, prompt_len).tolist(),
-                       max_new_tokens=decode_steps + 1)
+                       max_new_tokens=budget)
         engine.prefill(seq)
 
-    # Timed steady-state decode: full batch advances one token per step.
-    for _ in range(8):                       # un-timed ramp
-        engine.decode_step()
+    # Timed steady-state decode: full batch, k fused steps per dispatch.
+    for _ in range(ramp_calls):              # un-timed ramp
+        engine.decode_steps()
     jax.block_until_ready(engine.kv.k)
     t0 = time.perf_counter()
     produced = 0
-    for _ in range(decode_steps):
-        produced += len(engine.decode_step())
+    for _ in range(timed_calls):
+        produced += sum(len(t) for t in engine.decode_steps().values())
     jax.block_until_ready(engine.kv.k)
     dt = time.perf_counter() - t0
 

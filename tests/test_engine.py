@@ -166,3 +166,71 @@ def test_sampling_oom_finish(setup):
         engine.decode_step()
     assert s.finish_reason == "oom"
     assert len(s.generated) >= 2   # kept generating until the boundary
+
+
+def test_decode_steps_matches_single_steps(setup):
+    """K fused decode steps == K sequential decode_step calls (greedy)."""
+    model_cfg, _, params, mod = setup
+    base = dict(page_size=8, num_pages=64, max_pages_per_seq=16,
+                max_batch_size=4, prefill_buckets=(16, 32, 64))
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 13, 26)]
+
+    e1 = InferenceEngine(model_cfg, cfgs.EngineConfig(
+        **base, decode_steps_per_call=1), params=params)
+    e2 = InferenceEngine(model_cfg, cfgs.EngineConfig(
+        **base, decode_steps_per_call=4), params=params)
+    got1 = e1.generate(prompts, max_new_tokens=11)   # not a multiple of K
+    got2 = e2.generate(prompts, max_new_tokens=11)
+    assert got1 == got2
+
+
+def test_decode_steps_eos_stops_lane(setup):
+    """A lane hitting EOS mid-scan stops; others keep generating."""
+    model_cfg, _, params, mod = setup
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=16,
+                             max_batch_size=4, prefill_buckets=(16,),
+                             decode_steps_per_call=8)
+    engine = InferenceEngine(model_cfg, ecfg, params=params)
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, 256, size=9).tolist()
+    # Find what greedy generates, then rerun with EOS = its 3rd token.
+    ref = reference_greedy(params, mod, model_cfg, prompt, 8)
+    eos = ref[2]
+    s = Sequence(request_id=0, prompt_tokens=prompt, max_new_tokens=8,
+                 eos_token_id=eos)
+    other = Sequence(request_id=1,
+                     prompt_tokens=rng.integers(0, 256, size=6).tolist(),
+                     max_new_tokens=8)
+    engine.prefill(s)
+    engine.prefill(other)
+    while engine.active_sequences():
+        engine.decode_steps()
+    if s.generated[0] == eos or (len(s.generated) > 1
+                                 and s.generated[1] == eos):
+        pytest.skip("EOS appeared before the scan — not the case under test")
+    assert s.finish_reason == "stop"
+    assert s.generated[-1] == eos
+    assert len(s.generated) == 3
+    assert len(other.generated) == 8
+    engine.release(s)
+    engine.release(other)
+    assert engine.allocator.num_free == ecfg.num_pages - 1
+
+
+def test_decode_steps_pool_pressure_partial_advance(setup):
+    """Under pool pressure a lane advances only as far as its page slack
+    instead of corrupting other sequences' pages."""
+    model_cfg, _, params, _ = setup
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=4, max_pages_per_seq=4,
+                             max_batch_size=2, prefill_buckets=(16,),
+                             decode_steps_per_call=8)
+    engine = InferenceEngine(model_cfg, ecfg, params=params)
+    s = Sequence(request_id=0, prompt_tokens=list(range(14)),
+                 max_new_tokens=64)
+    engine.prefill(s)           # 2 pages used; pool of 3 → 1 free
+    while engine.active_sequences():
+        engine.decode_steps()
+    assert s.finish_reason == "oom"
+    # Advanced to page slack (2 tokens) + one granted page (8 tokens).
+    assert len(s.generated) == 1 + 2 + 8

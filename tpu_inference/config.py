@@ -175,6 +175,11 @@ class EngineConfig:
     # XLA compiles a bounded number of prefill graphs.
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024)
     chunked_prefill_size: int = 0     # 0 = whole-prompt prefill
+    # Device-side decode steps fused per host call (lax.scan): each host
+    # round trip costs ~dispatch latency, so K steps per call multiply
+    # steady-state decode throughput by up to K. Streamed tokens are
+    # flushed every K steps (latency cost: K * per-step time).
+    decode_steps_per_call: int = 8
     # Sampling defaults (overridable per request).
     temperature: float = 0.0          # 0 => greedy
     top_k: int = 0                    # 0 => disabled

@@ -141,6 +141,8 @@ class InferenceEngine:
             partial(self._prefill_fn), donate_argnums=(1,))
         self._decode_jit = jax.jit(
             partial(self._decode_fn), donate_argnums=(1,))
+        self._decode_multi_jit = jax.jit(
+            partial(self._decode_multi_fn), donate_argnums=(1,))
 
     # ------------------------------------------------------------------
     # Device graphs (pure functions of arrays; jitted once per bucket/batch)
@@ -193,6 +195,51 @@ class InferenceEngine:
         toks = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
         return kv, toks, logits
 
+    def _decode_multi_fn(self, params, kv: KVPages, tokens, ctx_lens,
+                         block_tables, allowed, eos_ids, key, temperature,
+                         top_p):
+        """K fused decode steps under one dispatch (lax.scan on device).
+
+        Sampled tokens feed back into the next step without leaving HBM;
+        the host syncs once per K steps instead of per token, which is the
+        difference between dispatch-latency-bound and compute-bound decode
+        (SURVEY.md §7 hard part 3: host<->device overlap).
+
+        allowed: [B] int32 — steps each slot may advance this call (folds
+        budget, context cap, and page headroom). eos_ids: [B] int32, -1
+        when the request has no EOS. Returns (kv, out [K, B] int32) with
+        -1 in slots that produced nothing at that step.
+        """
+        cfg = self.model_cfg
+        ecfg = self.engine_cfg
+
+        def step(carry, s):
+            kv, tokens, ctx_lens, alive = carry
+            act = alive & (s < allowed)
+            positions = jnp.minimum(ctx_lens, ecfg.max_context - 1)[:, None]
+            attn = make_paged_attn(cfg, ecfg.page_size, block_tables,
+                                   positions, act[:, None],
+                                   q_offset=ctx_lens, kv_len=ctx_lens + 1,
+                                   attn_backend=self.attn_backend)
+            hidden, kv = self.mod.forward_hidden(params, cfg, tokens[:, None],
+                                                 positions, kv, attn)
+            logits = self.mod.unembed(params, cfg, hidden[:, 0])
+            sp = SamplingParams(temperature=temperature, top_p=top_p)
+            toks = sample(logits, jax.random.fold_in(key, s), sp,
+                          top_k=ecfg.top_k)
+            toks = jnp.where(act, toks, tokens)
+            out = jnp.where(act, toks, -1)
+            alive = alive & jnp.where(act, toks != eos_ids, True)
+            ctx_lens = ctx_lens + act.astype(jnp.int32)
+            return (kv, toks, ctx_lens, alive), out
+
+        k_steps = max(1, ecfg.decode_steps_per_call)
+        alive0 = jnp.ones(tokens.shape, bool)
+        (kv, _, _, _), outs = jax.lax.scan(
+            step, (kv, tokens, ctx_lens, alive0),
+            jnp.arange(k_steps, dtype=jnp.int32))
+        return kv, outs
+
     # ------------------------------------------------------------------
     # Host-side orchestration
     # ------------------------------------------------------------------
@@ -221,12 +268,23 @@ class InferenceEngine:
                 self.params, self.kv, toks, one, zero, jnp.asarray(bt),
                 self._next_key(), tz, tp)
         b = ecfg.max_batch_size
-        self.kv, _, _ = self._decode_jit(
-            self.params, self.kv, jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b, self.max_pages), jnp.int32),
-            jnp.zeros((b,), bool), self._next_key(),
-            jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
+        # Warm only the decode graph decode_steps() will dispatch — the
+        # other is dead in steady state and costs a full model compile.
+        if max(1, ecfg.decode_steps_per_call) == 1:
+            self.kv, _, _ = self._decode_jit(
+                self.params, self.kv, jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, self.max_pages), jnp.int32),
+                jnp.zeros((b,), bool), self._next_key(),
+                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
+        else:
+            self.kv, _ = self._decode_multi_jit(
+                self.params, self.kv, jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, self.max_pages), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.full((b,), -1, jnp.int32), self._next_key(),
+                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -320,6 +378,24 @@ class InferenceEngine:
     def active_sequences(self) -> List[Sequence]:
         return [s for s in self.slots if s is not None and not s.done]
 
+    def _stage_batch(self, active_seqs: List[Sequence]):
+        """Fill the per-slot host arrays shared by both decode entry points:
+        (tokens, ctx_lens, block_tables, temps, top_ps), all [B]-shaped."""
+        b = self.engine_cfg.max_batch_size
+        tokens = np.zeros((b,), np.int32)
+        ctx_lens = np.zeros((b,), np.int32)
+        bts = np.zeros((b, self.max_pages), np.int32)
+        temps = np.zeros((b,), np.float32)
+        top_ps = np.ones((b,), np.float32)
+        for seq in active_seqs:
+            i = seq.slot
+            tokens[i] = seq.last_token
+            ctx_lens[i] = seq.ctx_len
+            bts[i] = self._block_table_array(seq.pages)
+            temps[i] = seq.temperature
+            top_ps[i] = seq.top_p
+        return tokens, ctx_lens, bts, temps, top_ps
+
     def decode_step(self) -> Dict[int, int]:
         """One batched decode step. Returns {request_id: new_token} for the
         sequences that advanced."""
@@ -348,20 +424,10 @@ class InferenceEngine:
         if not active_seqs:
             return {}
 
-        tokens = np.zeros((b,), np.int32)
-        ctx_lens = np.zeros((b,), np.int32)
-        bts = np.zeros((b, self.max_pages), np.int32)
+        tokens, ctx_lens, bts, temps, top_ps = self._stage_batch(active_seqs)
         active = np.zeros((b,), bool)
-        temps = np.zeros((b,), np.float32)
-        top_ps = np.ones((b,), np.float32)
         for seq in active_seqs:
-            i = seq.slot
-            tokens[i] = seq.last_token
-            ctx_lens[i] = seq.ctx_len
-            bts[i] = self._block_table_array(seq.pages)
-            active[i] = True
-            temps[i] = seq.temperature
-            top_ps[i] = seq.top_p
+            active[seq.slot] = True
 
         self.kv, toks, _ = self._decode_jit(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
@@ -379,6 +445,90 @@ class InferenceEngine:
             self._maybe_finish(seq, tok)
             out[seq.request_id] = tok
         return out
+
+    def decode_steps(self) -> Dict[int, List[int]]:
+        """Up to ``decode_steps_per_call`` fused decode steps in ONE device
+        dispatch. Returns {request_id: [tokens generated, in order]}.
+
+        Per-sequence ``allowed`` folds the generation budget, the context
+        cap, and KV-page headroom, so the device never writes a slot the
+        host hasn't provisioned. EOS stops a lane on device; the host's
+        ``_maybe_finish`` stays the source of truth for finish state.
+        """
+        ecfg = self.engine_cfg
+        k_steps = max(1, ecfg.decode_steps_per_call)
+        if k_steps == 1:
+            return {rid: [tok] for rid, tok in self.decode_step().items()}
+        b = ecfg.max_batch_size
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return {}
+
+        allowed_by_slot: Dict[int, int] = {}
+        for seq in active_seqs:
+            budget = seq.max_new_tokens - len(seq.generated)
+            # From ctx c the host keeps at most max_context - 1 - c tokens
+            # (_maybe_finish caps at ctx + 1 >= max_context); granting more
+            # would waste a forward pass + KV write per capped sequence.
+            room = ecfg.max_context - 1 - seq.ctx_len
+            steps = max(0, min(k_steps, budget, room))
+            if steps > 0:
+                need = kvc.pages_needed(steps, ecfg.page_size,
+                                        already=seq.ctx_len)
+                if need > self.allocator.num_free:
+                    # Pool pressure: advance only as far as the slack in the
+                    # current last page plus the pages we can still grant.
+                    slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
+                    steps = min(steps, slack
+                                + self.allocator.num_free * ecfg.page_size)
+                    need = (kvc.pages_needed(steps, ecfg.page_size,
+                                             already=seq.ctx_len)
+                            if steps > 0 else 0)
+                if need > 0:
+                    seq.pages.extend(self.allocator.allocate(need))
+            if steps <= 0:
+                # No budget/room should have finished already; pool
+                # exhaustion with zero slack fails the sequence safely.
+                seq.done, seq.finish_reason = True, "oom"
+                seq.finish_time = time.perf_counter()
+                continue
+            allowed_by_slot[seq.slot] = steps
+        active_seqs = [s for s in active_seqs if not s.done]
+        if not active_seqs:
+            return {}
+
+        tokens, ctx_lens, bts, temps, top_ps = self._stage_batch(active_seqs)
+        allowed = np.zeros((b,), np.int32)
+        eos_ids = np.full((b,), -1, np.int32)
+        for seq in active_seqs:
+            allowed[seq.slot] = allowed_by_slot[seq.slot]
+            if seq.eos_token_id is not None:
+                eos_ids[seq.slot] = seq.eos_token_id
+
+        self.kv, outs = self._decode_multi_jit(
+            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
+            jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
+            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps))
+        outs = np.asarray(outs)                                 # [K, B]
+
+        result: Dict[int, List[int]] = {}
+        for seq in active_seqs:
+            got: List[int] = []
+            for s_idx in range(k_steps):
+                if seq.done:
+                    break
+                tok = int(outs[s_idx, seq.slot])
+                if tok < 0:
+                    break
+                seq.ctx_len += 1
+                seq.generated.append(tok)
+                if seq.first_token_time == 0.0:
+                    seq.first_token_time = time.perf_counter()
+                self._maybe_finish(seq, tok)
+                got.append(tok)
+            if got:
+                result[seq.request_id] = got
+        return result
 
     # ------------------------------------------------------------------
     # Convenience batch generation (tests, bench, config-1 path)
@@ -402,7 +552,7 @@ class InferenceEngine:
         while pending or self.active_sequences():
             while pending and self.free_slots() and self.can_admit(pending[0]):
                 self.prefill(pending.pop(0))
-            self.decode_step()
+            self.decode_steps()
             for s in [s for s in self.slots if s is not None and s.done]:
                 results[s.request_id] = s.generated
                 self.release(s)

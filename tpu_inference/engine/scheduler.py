@@ -199,7 +199,7 @@ class EngineScheduler:
                 continue
 
             try:
-                new_tokens = engine.decode_step()
+                new_tokens = engine.decode_steps()
             except Exception:  # noqa: BLE001 — keep the engine loop alive
                 import traceback
                 traceback.print_exc()
@@ -210,14 +210,16 @@ class EngineScheduler:
                 continue
             self.stats.steps += 1
             self.stats.batch_occupancy_sum += len(active)
-            self.stats.tokens_generated += len(new_tokens)
+            self.stats.tokens_generated += sum(
+                len(toks) for toks in new_tokens.values())
             in_use = (engine.engine_cfg.num_pages - 1) - engine.allocator.num_free
             self.stats.peak_pages_in_use = max(self.stats.peak_pages_in_use,
                                                in_use)
 
-            for rid, tok in new_tokens.items():
+            for rid, toks in new_tokens.items():
                 pending = self._callbacks.get(rid)
                 if pending is not None:
-                    pending.on_token(pending.seq, tok)
+                    for tok in toks:
+                        pending.on_token(pending.seq, tok)
             for s in [s for s in engine.slots if s is not None and s.done]:
                 self._finish(s)
