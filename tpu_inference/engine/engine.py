@@ -139,8 +139,6 @@ class InferenceEngine:
 
         self._prefill_jit = jax.jit(
             partial(self._prefill_fn), donate_argnums=(1,))
-        self._decode_jit = jax.jit(
-            partial(self._decode_fn), donate_argnums=(1,))
         self._decode_multi_jit = jax.jit(
             partial(self._decode_multi_fn), donate_argnums=(1,))
 
@@ -175,25 +173,6 @@ class InferenceEngine:
         sp = SamplingParams(temperature=temperature, top_p=top_p)
         tok = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
         return kv, tok, logits
-
-    def _decode_fn(self, params, kv: KVPages, tokens, ctx_lens, block_tables,
-                   active, key, temperature, top_p):
-        """One step for the whole decode batch. tokens/ctx_lens/active: [B]."""
-        cfg = self.model_cfg
-        b = tokens.shape[0]
-        positions = jnp.minimum(ctx_lens, self.engine_cfg.max_context - 1)
-        positions = positions[:, None]                            # [B, 1]
-        valid = active[:, None]                                   # [B, 1]
-        attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_tables,
-                               positions, valid, q_offset=ctx_lens,
-                               kv_len=ctx_lens + 1,
-                               attn_backend=self.attn_backend)
-        hidden, kv = self.mod.forward_hidden(params, cfg, tokens[:, None],
-                                             positions, kv, attn)
-        logits = self.mod.unembed(params, cfg, hidden[:, 0])      # [B, V]
-        sp = SamplingParams(temperature=temperature, top_p=top_p)
-        toks = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
-        return kv, toks, logits
 
     def _decode_multi_fn(self, params, kv: KVPages, tokens, ctx_lens,
                          block_tables, allowed, eos_ids, key, temperature,
@@ -268,23 +247,13 @@ class InferenceEngine:
                 self.params, self.kv, toks, one, zero, jnp.asarray(bt),
                 self._next_key(), tz, tp)
         b = ecfg.max_batch_size
-        # Warm only the decode graph decode_steps() will dispatch — the
-        # other is dead in steady state and costs a full model compile.
-        if max(1, ecfg.decode_steps_per_call) == 1:
-            self.kv, _, _ = self._decode_jit(
-                self.params, self.kv, jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b, self.max_pages), jnp.int32),
-                jnp.zeros((b,), bool), self._next_key(),
-                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
-        else:
-            self.kv, _ = self._decode_multi_jit(
-                self.params, self.kv, jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b, self.max_pages), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.full((b,), -1, jnp.int32), self._next_key(),
-                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
+        self.kv, _ = self._decode_multi_jit(
+            self.params, self.kv, jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b, self.max_pages), jnp.int32),
+            jnp.zeros((b,), jnp.int32),
+            jnp.full((b,), -1, jnp.int32), self._next_key(),
+            jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -397,56 +366,15 @@ class InferenceEngine:
         return tokens, ctx_lens, bts, temps, top_ps
 
     def decode_step(self) -> Dict[int, int]:
-        """One batched decode step. Returns {request_id: new_token} for the
-        sequences that advanced."""
-        ecfg = self.engine_cfg
-        b = ecfg.max_batch_size
-        active_seqs = self.active_sequences()
-        if not active_seqs:
-            return {}
+        """One batched decode step (single-step view of the fused graph:
+        ``allowed`` is capped at 1, so lanes advance exactly one token).
+        Returns {request_id: new_token}. Prefer decode_steps() in serving
+        loops — this exists for tests and fine-grained stepping."""
+        return {rid: toks[0]
+                for rid, toks in self.decode_steps(max_steps=1).items()}
 
-        # Grow block tables for sequences crossing a page boundary.
-        for seq in active_seqs:
-            if kvc.pages_needed(1, ecfg.page_size, already=seq.ctx_len) > 0:
-                if len(seq.pages) >= self.max_pages:
-                    seq.done, seq.finish_reason = True, "length"
-                    seq.finish_time = time.perf_counter()
-                    continue
-                if not self.allocator.can_allocate(1):
-                    # Pool exhausted mid-flight. The scheduler's admission
-                    # control makes this rare; fail this sequence safely
-                    # rather than corrupting others' pages.
-                    seq.done, seq.finish_reason = True, "oom"
-                    seq.finish_time = time.perf_counter()
-                    continue
-                seq.pages.extend(self.allocator.allocate(1))
-        active_seqs = [s for s in active_seqs if not s.done]
-        if not active_seqs:
-            return {}
-
-        tokens, ctx_lens, bts, temps, top_ps = self._stage_batch(active_seqs)
-        active = np.zeros((b,), bool)
-        for seq in active_seqs:
-            active[seq.slot] = True
-
-        self.kv, toks, _ = self._decode_jit(
-            self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
-            jnp.asarray(bts), jnp.asarray(active), self._next_key(),
-            jnp.asarray(temps), jnp.asarray(top_ps))
-        toks = np.asarray(toks)
-
-        out: Dict[int, int] = {}
-        for seq in active_seqs:
-            tok = int(toks[seq.slot])
-            seq.ctx_len += 1
-            seq.generated.append(tok)
-            if seq.first_token_time == 0.0:
-                seq.first_token_time = time.perf_counter()
-            self._maybe_finish(seq, tok)
-            out[seq.request_id] = tok
-        return out
-
-    def decode_steps(self) -> Dict[int, List[int]]:
+    def decode_steps(self, max_steps: Optional[int] = None
+                     ) -> Dict[int, List[int]]:
         """Up to ``decode_steps_per_call`` fused decode steps in ONE device
         dispatch. Returns {request_id: [tokens generated, in order]}.
 
@@ -454,11 +382,12 @@ class InferenceEngine:
         cap, and KV-page headroom, so the device never writes a slot the
         host hasn't provisioned. EOS stops a lane on device; the host's
         ``_maybe_finish`` stays the source of truth for finish state.
+        ``max_steps`` additionally caps every lane (decode_step uses 1).
         """
         ecfg = self.engine_cfg
         k_steps = max(1, ecfg.decode_steps_per_call)
-        if k_steps == 1:
-            return {rid: [tok] for rid, tok in self.decode_step().items()}
+        if max_steps is not None:
+            k_steps = min(k_steps, max_steps)
         b = ecfg.max_batch_size
         active_seqs = self.active_sequences()
         if not active_seqs:

@@ -1,0 +1,11 @@
+"""TPU kernels (Pallas/Mosaic) — the framework's native-performance tier.
+
+The reference repo contains no native code at all (SURVEY.md §2b: zero
+C++/Rust/CUDA components; the GPU kernels it relies on live inside its
+external Ollama server). Pallas kernels are the TPU-idiomatic equivalent
+of that missing tier: hand-scheduled HBM->VMEM pipelines for the ops XLA
+can't fuse well on its own (paged-KV attention), validated against the
+dense jnp reference paths in models/common.py.
+"""
+
+from tpu_inference.kernels.paged_attention import paged_attention  # noqa: F401

@@ -1,0 +1,132 @@
+"""Pallas paged-attention decode kernel (flash-style online softmax).
+
+One query token per sequence attends over its KV pages scattered through
+the HBM pool (engine/kv_cache.py). The dense fallback path first gathers
+a sequence's pages into a contiguous buffer ([B, max_pages*page, H, D])
+every layer, every step — a full extra HBM round trip of the KV working
+set. This kernel instead streams each page HBM->VMEM exactly once and
+folds it into running (max, sum, acc) online-softmax state, the standard
+TPU pattern for decode attention (vLLM's PagedAttention re-designed for
+Mosaic; reference has no analogue — SURVEY.md §2b).
+
+Mechanics:
+- ``PrefetchScalarGridSpec`` with the block table + kv lengths as scalar
+  prefetch: the KV BlockSpec's index_map reads ``block_tables[b, p]`` to
+  pick which physical page the pipeline DMAs next — the gather never
+  materializes.
+- Grid (B, MP), page index innermost; VMEM scratch (m, l, acc) carries
+  the online-softmax state across a sequence's pages and is flushed to
+  the output on the last page.
+- GQA folded in-kernel: q viewed [Hkv, n_rep, D], each KV head's page
+  serves its n_rep query heads via one MXU contraction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
+                   out_ref, m_ref, l_ref, acc_ref, *, page_size: int,
+                   scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_len_ref[b]
+    page_start = p * page_size
+
+    @pl.when(page_start < kv_len)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)                  # [Hkv, R, D]
+        k = k_ref[0].astype(jnp.float32)                  # [pg, Hkv, D]
+        v = v_ref[0].astype(jnp.float32)                  # [pg, Hkv, D]
+
+        # scores[h, r, t] = <q[h, r], k[t, h]> * scale
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale    # [Hkv, R, pg]
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=2)
+        s = jnp.where(pos < kv_len, s, NEG_INF)
+
+        m_prev = m_ref[:]                                  # [Hkv, R]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=2)                         # [Hkv, R]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new[:, :, None])                # [Hkv, R, pg]
+        # o[h, r, d] = sum_t pr[h, r, t] * v[t, h, d]
+        o = jax.lax.dot_general(
+            pr, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)            # [Hkv, R, D]
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(pr, axis=2)
+        acc_ref[:] = acc_ref[:] * alpha[:, :, None] + o
+
+    @pl.when(p == num_pages - 1)
+    def _flush():
+        denom = jnp.maximum(l_ref[:], 1e-20)[:, :, None]
+        out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    block_tables: jax.Array, kv_len: jax.Array,
+                    interpret: bool | None = None) -> jax.Array:
+    """Decode attention over the paged KV pool.
+
+    q:            [B, Hq, D]   (one query token per sequence)
+    k/v_pages:    [P, page_size, Hkv, D]  (one layer's pool)
+    block_tables: [B, MP] int32 physical page ids (0 = trash page)
+    kv_len:       [B] int32 valid tokens per sequence (incl. current)
+    Returns [B, Hq, D] in q.dtype.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, hq, d = q.shape
+    _, page_size, hkv, _ = k_pages.shape
+    n_rep = hq // hkv
+    mp = block_tables.shape[1]
+    scale = 1.0 / (d ** 0.5)
+
+    q_g = q.reshape(b, hkv, n_rep, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, kv_len
+        grid=(b, mp),
+        in_specs=[
+            pl.BlockSpec((1, hkv, n_rep, d), lambda i, p, bt, kl: (i, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, hkv, d),
+                         lambda i, p, bt, kl: (bt[i, p], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, hkv, d),
+                         lambda i, p, bt, kl: (bt[i, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, n_rep, d),
+                               lambda i, p, bt, kl: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, n_rep), jnp.float32),       # running max
+            pltpu.VMEM((hkv, n_rep), jnp.float32),       # running sum
+            pltpu.VMEM((hkv, n_rep, d), jnp.float32),    # running out
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, kv_len, q_g, k_pages, v_pages)
+    return out.reshape(b, hq, d)
