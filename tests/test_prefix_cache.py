@@ -1,0 +1,152 @@
+"""Prefix cache: KV page reuse must be invisible to generation output.
+
+The invariant under test: a request served with prefix-cache hits
+generates exactly the tokens it would generate cold — page sharing is an
+optimization, never a behavior change (BASELINE.json config 3 multi-turn
+target; the reference has no KV reuse, SURVEY.md §2b).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_inference import config as cfgs
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.kv_cache import PageAllocator
+from tpu_inference.engine.prefix_cache import PrefixCache, _chain_hashes
+from tpu_inference.models import build_model
+
+
+@pytest.fixture(scope="module")
+def setup():
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    params, mod = build_model(model_cfg, seed=0)
+    return model_cfg, params, mod
+
+
+def _ecfg(**kw):
+    base = dict(page_size=8, num_pages=64, max_pages_per_seq=16,
+                max_batch_size=4, prefill_buckets=(16, 32, 64),
+                decode_steps_per_call=4, enable_prefix_cache=True)
+    base.update(kw)
+    return cfgs.EngineConfig(**base)
+
+
+def test_chain_hash_full_pages_only():
+    hs = _chain_hashes(list(range(20)), 8)
+    assert len(hs) == 2                      # 20 tokens -> 2 full pages
+    # Chain property: same block after a different prefix hashes differently.
+    other = _chain_hashes(list(range(1, 21)), 8)
+    assert hs[0] != other[0] and hs[1] != other[1]
+    assert _chain_hashes(list(range(16)), 8)[:2] == hs[:2]
+
+
+def test_prefix_cache_unit():
+    alloc = PageAllocator(16)
+    cache = PrefixCache(alloc, page_size=4)
+    tokens = list(range(10))                 # 2 full pages + tail
+    pages = alloc.allocate(3)
+    assert cache.insert(tokens, pages) == 2
+    assert alloc.refcount(pages[0]) == 2     # seq + cache
+    alloc.free(pages)                        # seq done
+    assert cache.evictable == 2
+
+    got, n = cache.lookup(tokens)
+    assert got == pages[:2] and n == 8
+    assert alloc.refcount(pages[0]) == 2     # cache + new lookup ref
+    # max_tokens caps the match (engine recomputes the final token).
+    got2, n2 = cache.lookup(tokens, max_tokens=8)
+    assert n2 == 8 and len(got2) == 2
+    got3, n3 = cache.lookup(tokens, max_tokens=7)
+    assert n3 == 4 and len(got3) == 1
+    alloc.free(got + got2 + got3)
+
+    # Eviction frees only cache-held pages, LRU first.
+    freed = cache.evict(10)
+    assert freed == 2
+    assert alloc.num_free == 15
+    got, n = cache.lookup(tokens)
+    assert n == 0 and got == []
+
+
+def test_warm_request_matches_cold(setup):
+    model_cfg, params, _ = setup
+    prompt = np.random.default_rng(0).integers(0, 256, 37).tolist()
+
+    cold = InferenceEngine(model_cfg, _ecfg(enable_prefix_cache=False),
+                           params=params)
+    want = cold.generate([prompt], max_new_tokens=12)[0]
+
+    warm = InferenceEngine(model_cfg, _ecfg(), params=params)
+    first = warm.generate([prompt], max_new_tokens=12)[0]
+    assert first == want
+    assert warm.prefix_cache.stats()["entries"] > 0
+    # Second identical request hits the cache and still matches.
+    second = warm.generate([prompt], max_new_tokens=12)[0]
+    assert second == want
+    assert warm.prefix_cache.hits >= 1
+
+
+def test_multi_turn_conversation_reuse(setup):
+    """Turn 2 resends turn 1's history: its full pages must be reused."""
+    model_cfg, params, _ = setup
+    engine = InferenceEngine(model_cfg, _ecfg(), params=params)
+    rng = np.random.default_rng(1)
+    turn1 = rng.integers(0, 256, 20).tolist()
+    reply1 = engine.generate([turn1], max_new_tokens=8)[0]
+    history = turn1 + reply1[:-1] + [7, 7]   # user follow-up
+
+    s = Sequence(request_id=9, prompt_tokens=history, max_new_tokens=4)
+    engine.prefill(s)
+    # 20 + 7 in-KV tokens = 3 full pages of 8 cached.
+    assert s.cached_tokens == 24
+    while engine.active_sequences():
+        engine.decode_steps()
+    warm_out = list(s.generated)
+    engine.release(s)
+
+    cold = InferenceEngine(model_cfg, _ecfg(enable_prefix_cache=False),
+                           params=params)
+    assert warm_out == cold.generate([history], max_new_tokens=4)[0]
+
+
+def test_cache_eviction_under_pressure(setup):
+    """A big request evicts cached pages instead of failing admission."""
+    model_cfg, params, _ = setup
+    ecfg = _ecfg(num_pages=9, max_pages_per_seq=8, max_batch_size=1)
+    engine = InferenceEngine(model_cfg, ecfg, params=params)
+    p1 = list(range(100, 124))               # 3 pages
+    engine.generate([p1], max_new_tokens=8)  # finishes -> pages cached
+    assert engine.prefix_cache.evictable > 0
+
+    s = Sequence(request_id=1, prompt_tokens=list(range(40)),
+                 max_new_tokens=8)           # needs 5 pages for prefill
+    assert engine.can_admit(s)
+    engine.prefill(s)
+    while engine.active_sequences():
+        engine.decode_steps()
+    assert len(s.generated) == 8
+    engine.release(s)
+
+
+def test_shared_pages_never_written(setup):
+    """Running a warm request must not corrupt the cached prefix for a
+    concurrent cold request using the same pages."""
+    model_cfg, params, _ = setup
+    engine = InferenceEngine(model_cfg, _ecfg(), params=params)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 256, 16).tolist()   # exactly 2 full pages
+    base = engine.generate([prompt], max_new_tokens=10)[0]
+
+    # Two warm requests sharing the cached pages, decoding concurrently.
+    s1 = Sequence(request_id=1, prompt_tokens=prompt, max_new_tokens=10)
+    s2 = Sequence(request_id=2, prompt_tokens=prompt + [9],
+                  max_new_tokens=10)
+    engine.prefill(s1)
+    engine.prefill(s2)
+    assert s1.cached_tokens == 8             # page 2 is full but capped
+    assert s2.cached_tokens == 16
+    while engine.active_sequences():
+        engine.decode_steps()
+    assert s1.generated == base
+    engine.release(s1)
+    engine.release(s2)

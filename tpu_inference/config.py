@@ -187,6 +187,9 @@ class EngineConfig:
     max_new_tokens: int = 1024
     # Speculative decoding (0 = off).
     num_speculative_tokens: int = 0
+    # Prefix caching: finished sequences publish their full KV pages for
+    # reuse by later requests sharing the prefix (multi-turn chats).
+    enable_prefix_cache: bool = True
 
     @property
     def max_context(self) -> int:

@@ -76,6 +76,7 @@ class Sequence:
     slot: int = -1
     pages: List[int] = dataclasses.field(default_factory=list)
     ctx_len: int = 0                       # tokens currently in KV
+    cached_tokens: int = 0                 # prefix-cache hit length
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     finish_reason: str = ""
@@ -132,6 +133,11 @@ class InferenceEngine:
         self.attn_backend = attn_backend
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
+        self.prefix_cache = None
+        if engine_cfg.enable_prefix_cache:
+            from tpu_inference.engine.prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(self.allocator,
+                                            engine_cfg.page_size)
         self.max_pages = engine_cfg.max_pages_per_seq
         self._base_key = jax.random.PRNGKey(seed)
         self._step_count = 0
@@ -272,9 +278,23 @@ class InferenceEngine:
             self.engine_cfg.page_size)
         return min(need, self.max_pages)
 
+    def _free_plus_evictable(self) -> int:
+        n = self.allocator.num_free
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.evictable
+        return n
+
+    def _allocate_reclaiming(self, n: int) -> List[int]:
+        """Allocate n pages, evicting LRU prefix-cache pages on pressure —
+        cached pages are reclaimable capacity, never reserved memory."""
+        short = n - self.allocator.num_free
+        if short > 0 and self.prefix_cache is not None:
+            self.prefix_cache.evict(short)
+        return self.allocator.allocate(n)
+
     def can_admit(self, seq: Sequence) -> bool:
-        return bool(self.free_slots()) and self.allocator.can_allocate(
-            self._pages_reserved(seq))
+        return bool(self.free_slots()) and (
+            self._free_plus_evictable() >= self._pages_reserved(seq))
 
     def can_ever_admit(self, seq: Sequence) -> bool:
         """False if the request exceeds the pool even when fully idle."""
@@ -295,8 +315,19 @@ class InferenceEngine:
         # Keep the most recent tokens of over-long prompts (leave room for
         # at least one generated token).
         prompt = seq.prompt_tokens[-(ecfg.max_context - 1):]
-        n_pages = kvc.pages_needed(len(prompt), ecfg.page_size)
-        seq.pages = self.allocator.allocate(n_pages)
+        # Prefix-cache hit: reuse full pages of an identical prior prefix
+        # and skip their prefill compute. Always recompute at least the
+        # final prompt token — its logits seed the first sampled token.
+        shared: List[int] = []
+        if self.prefix_cache is not None:
+            shared, seq.cached_tokens = self.prefix_cache.lookup(
+                prompt, max_tokens=len(prompt) - 1)
+        n_new = kvc.pages_needed(len(prompt), ecfg.page_size) - len(shared)
+        try:
+            seq.pages = shared + self._allocate_reclaiming(n_new)
+        except MemoryError:
+            self.allocator.free(shared)
+            raise
         seq.slot = slot
         seq.prefill_start = time.perf_counter()
         bt = self._block_table_array(seq.pages)[None]
@@ -304,7 +335,7 @@ class InferenceEngine:
         # Chunked prefill: each chunk attends to itself + all cached tokens
         # (prefix_len). Only the final chunk's sampled token is kept.
         chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
-        offset = 0
+        offset = seq.cached_tokens
         tok = None
         while offset < len(prompt):
             chunk = prompt[offset:offset + chunk_cap]
@@ -338,7 +369,14 @@ class InferenceEngine:
             seq.finish_time = time.perf_counter()
 
     def release(self, seq: Sequence) -> None:
-        """Free a finished sequence's pages and slot."""
+        """Free a finished sequence's pages and slot, publishing its full
+        pages (prompt + generated history) to the prefix cache first so a
+        follow-up turn resending the conversation reuses them."""
+        if self.prefix_cache is not None and seq.pages:
+            # Same truncation prefill used, so tokens align with pages.
+            prompt = seq.prompt_tokens[-(self.engine_cfg.max_context - 1):]
+            in_kv = prompt + seq.generated[:-1]
+            self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages)
         self.allocator.free(seq.pages)
         seq.pages = []
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
@@ -404,17 +442,17 @@ class InferenceEngine:
             if steps > 0:
                 need = kvc.pages_needed(steps, ecfg.page_size,
                                         already=seq.ctx_len)
-                if need > self.allocator.num_free:
+                grantable = self._free_plus_evictable()
+                if need > grantable:
                     # Pool pressure: advance only as far as the slack in the
                     # current last page plus the pages we can still grant.
                     slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
-                    steps = min(steps, slack
-                                + self.allocator.num_free * ecfg.page_size)
+                    steps = min(steps, slack + grantable * ecfg.page_size)
                     need = (kvc.pages_needed(steps, ecfg.page_size,
                                              already=seq.ctx_len)
                             if steps > 0 else 0)
                 if need > 0:
-                    seq.pages.extend(self.allocator.allocate(need))
+                    seq.pages.extend(self._allocate_reclaiming(need))
             if steps <= 0:
                 # No budget/room should have finished already; pool
                 # exhaustion with zero slack fails the sequence safely.

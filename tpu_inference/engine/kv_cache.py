@@ -103,10 +103,29 @@ class PageAllocator:
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._refs = [0] * num_pages
+        self._cached = [False] * num_pages
+        # Pages held ONLY by the prefix cache (refs == 1 and cached):
+        # reclaimable capacity. Kept as an O(1) counter updated on the
+        # engine thread so metrics scrapes from other threads read a
+        # GIL-atomic int instead of iterating a mutating dict.
+        self.evictable_count = 0
 
     @property
     def num_free(self) -> int:
         return len(self._free)
+
+    def mark_cached(self, page: int) -> None:
+        """Flag a page as prefix-cache-held (cache owns one of its refs)."""
+        assert self._refs[page] > 0 and not self._cached[page]
+        self._cached[page] = True
+        if self._refs[page] == 1:
+            self.evictable_count += 1
+
+    def unmark_cached(self, page: int) -> None:
+        assert self._cached[page]
+        self._cached[page] = False
+        if self._refs[page] == 1:
+            self.evictable_count -= 1
 
     def can_allocate(self, n: int) -> bool:
         return len(self._free) >= n
@@ -123,7 +142,12 @@ class PageAllocator:
         """Increment refcount for a prefix-shared page."""
         assert self._refs[page] > 0
         self._refs[page] += 1
+        if self._cached[page] and self._refs[page] == 2:
+            self.evictable_count -= 1       # no longer sole-referenced
         return page
+
+    def refcount(self, page: int) -> int:
+        return self._refs[page]
 
     def free(self, pages: List[int]) -> None:
         for p in pages:
@@ -133,6 +157,8 @@ class PageAllocator:
             self._refs[p] -= 1
             if self._refs[p] == 0:
                 self._free.append(p)
+            elif self._refs[p] == 1 and self._cached[p]:
+                self.evictable_count += 1   # cache is now sole holder
 
 
 def pages_needed(n_tokens: int, page_size: int,

@@ -1,0 +1,146 @@
+"""Prefix cache: shared-prefix KV page reuse across requests.
+
+Multi-turn conversations resend the whole history each turn (the Ollama
+protocol the reference harness speaks is stateless — SURVEY.md §2c), so
+consecutive requests share long token prefixes. Pages holding those
+prefixes are immutable once full (decode appends only ever write the
+*current* page), which makes page-granular sharing safe with plain
+refcounts — no copy-on-write needed for inference (engine/kv_cache.py).
+
+Design:
+- Key = rolling blake2b chain hash over page-sized token blocks, so a hit
+  guarantees the *entire* prefix up to that page matches, not just that
+  one block.
+- The cache holds its own allocator reference on every inserted page
+  (PageAllocator.share); a sequence releasing its pages never invalidates
+  a cached copy, and eviction is just dropping the cache's reference.
+- LRU eviction, triggered by the engine when the free list runs dry —
+  cached-but-unused pages are reclaimable capacity, not reserved memory.
+- KV content depends only on absolute positions + token ids (RoPE is
+  absolute), so equal prefixes produce bit-identical pages; sharing is
+  exact, not approximate.
+
+The reference has no KV reuse of any kind (its server is external);
+BASELINE.json config 3 ("multi-turn conversations.json") is the
+acceptance target for this component.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_inference.engine.kv_cache import PageAllocator
+
+
+def _chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
+    """One digest per *full* page, each folding in all prior pages."""
+    out: List[bytes] = []
+    h = b""
+    for start in range(0, len(tokens) - len(tokens) % page_size, page_size):
+        block = tokens[start:start + page_size]
+        d = hashlib.blake2b(digest_size=16)
+        d.update(h)
+        d.update(b",".join(str(t).encode() for t in block))
+        h = d.digest()
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Maps prefix chain-hashes to physical KV pages."""
+
+    def __init__(self, allocator: PageAllocator, page_size: int):
+        self.allocator = allocator
+        self.page_size = page_size
+        # digest -> page id, LRU order (oldest first).
+        self._table: "OrderedDict[bytes, int]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+    @property
+    def evictable(self) -> int:
+        """Pages reclaimable right now (cache holds the only reference).
+        O(1): the allocator maintains the counter on the engine thread,
+        so metrics scrapes from other threads read a plain int."""
+        return self.allocator.evictable_count
+
+    # ------------------------------------------------------------- lookup
+
+    def lookup(self, tokens: Sequence[int],
+               max_tokens: Optional[int] = None) -> Tuple[List[int], int]:
+        """Longest cached prefix of ``tokens``.
+
+        Returns (shared_pages, n_cached_tokens); every returned page got a
+        fresh allocator reference (caller owns it and must free it).
+        ``max_tokens`` caps the match (the engine always re-computes at
+        least the prompt's final token to get logits).
+        """
+        limit = len(tokens) if max_tokens is None else max_tokens
+        pages: List[int] = []
+        for i, digest in enumerate(_chain_hashes(tokens, self.page_size)):
+            if (i + 1) * self.page_size > limit:
+                break
+            page = self._table.get(digest)
+            if page is None:
+                break
+            self._table.move_to_end(digest)
+            pages.append(page)
+        for p in pages:
+            self.allocator.share(p)
+        if pages:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages, len(pages) * self.page_size
+
+    # ------------------------------------------------------------- insert
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Publish a sequence's full pages. ``pages[i]`` must hold tokens
+        ``[i*page, (i+1)*page)`` of ``tokens``. Call while the caller still
+        owns the pages (the cache takes its own reference). Returns the
+        number of newly published pages."""
+        added = 0
+        for i, digest in enumerate(_chain_hashes(tokens, self.page_size)):
+            if i >= len(pages):
+                break
+            if digest in self._table:
+                self._table.move_to_end(digest)
+                continue
+            self._table[digest] = self.allocator.share(pages[i])
+            self.allocator.mark_cached(pages[i])
+            added += 1
+        return added
+
+    # ------------------------------------------------------------- evict
+
+    def evict(self, n_pages: int) -> int:
+        """Drop up to ``n_pages`` LRU entries whose page the cache alone
+        still references (releasing shared entries frees no memory, so
+        they are skipped). Returns pages actually freed."""
+        freed = 0
+        for digest in list(self._table):
+            if freed >= n_pages:
+                break
+            page = self._table[digest]
+            if self.allocator.refcount(page) == 1:
+                del self._table[digest]
+                self.allocator.unmark_cached(page)
+                self.allocator.free([page])
+                freed += 1
+        return freed
+
+    def clear(self) -> None:
+        for digest, page in list(self._table.items()):
+            self.allocator.unmark_cached(page)
+            self.allocator.free([page])
+        self._table.clear()
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._table), "evictable": self.evictable,
+                "hits": self.hits, "misses": self.misses}

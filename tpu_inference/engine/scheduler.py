@@ -40,6 +40,7 @@ class SchedulerStats:
     steps: int = 0
     prefills: int = 0
     tokens_generated: int = 0
+    tokens_prefix_cached: int = 0      # prompt tokens served from KV reuse
     requests_finished: int = 0
     requests_rejected: int = 0
     batch_occupancy_sum: float = 0.0
@@ -48,10 +49,11 @@ class SchedulerStats:
     def snapshot(self, engine: InferenceEngine) -> Dict:
         occ = (self.batch_occupancy_sum / self.steps) if self.steps else 0.0
         total = engine.engine_cfg.num_pages - 1
-        return {
+        out = {
             "steps": self.steps,
             "prefills": self.prefills,
             "tokens_generated": self.tokens_generated,
+            "tokens_prefix_cached": self.tokens_prefix_cached,
             "requests_finished": self.requests_finished,
             "requests_rejected": self.requests_rejected,
             "mean_batch_occupancy": occ,
@@ -59,6 +61,9 @@ class SchedulerStats:
             "kv_pages_in_use": total - engine.allocator.num_free,
             "peak_pages_in_use": self.peak_pages_in_use,
         }
+        if engine.prefix_cache is not None:
+            out["prefix_cache"] = engine.prefix_cache.stats()
+        return out
 
 
 @dataclasses.dataclass
@@ -168,6 +173,7 @@ class EngineScheduler:
                 continue
             self.stats.prefills += 1
             self.stats.tokens_generated += 1
+            self.stats.tokens_prefix_cached += seq.cached_tokens
             admitted += 1
             pending.on_token(seq, seq.generated[-1])
             if seq.done:
