@@ -1,0 +1,129 @@
+"""Speculative decoding: output must be exactly the target model's.
+
+The defining property of draft-verify rejection sampling: the emitted
+token stream is distributed exactly as the target model alone (greedy =
+token-for-token identical), regardless of draft quality. Draft quality
+only moves the acceptance rate / speed. (BASELINE.json config 4.)
+"""
+
+import numpy as np
+import pytest
+
+from tpu_inference import config as cfgs
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.models import build_model
+
+
+@pytest.fixture(scope="module")
+def models():
+    target_cfg = cfgs.tiny_llama(vocab_size=256)
+    draft_cfg = cfgs.ModelConfig(
+        name="draft", family="llama", vocab_size=256, d_model=64,
+        n_layers=1, n_heads=2, n_kv_heads=2, d_ff=128, max_seq_len=1024,
+        rope_theta=10000.0, dtype=target_cfg.dtype)
+    params, _ = build_model(target_cfg, seed=0)
+    draft_params, _ = build_model(draft_cfg, seed=9)
+    return target_cfg, params, draft_cfg, draft_params
+
+
+def _ecfg(gamma, **kw):
+    base = dict(page_size=8, num_pages=64, max_pages_per_seq=16,
+                max_batch_size=4, prefill_buckets=(16, 32, 64),
+                num_speculative_tokens=gamma)
+    base.update(kw)
+    return cfgs.EngineConfig(**base)
+
+
+def test_spec_greedy_matches_target(models):
+    """Greedy spec output == greedy plain output, any draft model."""
+    target_cfg, params, draft_cfg, draft_params = models
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 13, 22)]
+
+    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
+    want = plain.generate(prompts, max_new_tokens=15)
+
+    spec = InferenceEngine(target_cfg, _ecfg(3), params=params,
+                           draft_cfg=draft_cfg, draft_params=draft_params)
+    got = spec.generate(prompts, max_new_tokens=15)
+    assert got == want
+    assert spec.spec_drafted > 0
+
+
+def test_spec_perfect_draft_accepts_everything(models):
+    """Draft == target: every draft token accepted, gamma+1 tokens/round."""
+    target_cfg, params, _, _ = models
+    gamma = 3
+    spec = InferenceEngine(target_cfg, _ecfg(gamma), params=params,
+                           draft_cfg=target_cfg, draft_params=params)
+    prompt = list(range(40, 52))
+    out = spec.generate([prompt], max_new_tokens=12)[0]
+    assert len(out) == 12
+    assert spec.spec_accepted == spec.spec_drafted  # 100% acceptance
+
+    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
+    assert out == plain.generate([prompt], max_new_tokens=12)[0]
+
+
+def test_spec_eos_and_budget(models):
+    target_cfg, params, draft_cfg, draft_params = models
+    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
+    prompt = list(range(7))
+    ref = plain.generate([prompt], max_new_tokens=10)[0]
+    # EOS = a token whose FIRST occurrence is mid-stream (tiny random
+    # models repeat; picking ref[k] blindly could stop earlier).
+    k = max(i for i in range(len(ref)) if ref[i] not in ref[:i])
+    eos = ref[k]
+
+    spec = InferenceEngine(target_cfg, _ecfg(3), params=params,
+                           draft_cfg=draft_cfg, draft_params=draft_params)
+    s = Sequence(request_id=0, prompt_tokens=prompt, max_new_tokens=10,
+                 eos_token_id=eos)
+    spec.prefill(s)
+    while spec.active_sequences():
+        spec.decode_steps()
+    # Stream truncated exactly at EOS even when EOS landed mid-round.
+    assert s.generated == ref[:k + 1]
+    assert s.finish_reason == "stop"
+
+    s2 = Sequence(request_id=1, prompt_tokens=prompt, max_new_tokens=7)
+    spec.prefill(s2)
+    while spec.active_sequences():
+        spec.decode_steps()
+    assert len(s2.generated) == 7               # budget exact, no overshoot
+    assert s2.generated == ref[:7]
+    assert s2.finish_reason == "length"
+
+
+def test_spec_sampled_runs(models):
+    """Temperature sampling through spec: right count, valid ids."""
+    target_cfg, params, draft_cfg, draft_params = models
+    spec = InferenceEngine(target_cfg, _ecfg(2), params=params,
+                           draft_cfg=draft_cfg, draft_params=draft_params)
+    out = spec.generate([list(range(9))], max_new_tokens=20,
+                        temperature=0.8)[0]
+    assert len(out) == 20
+    assert all(0 <= t < 256 for t in out)
+
+
+def test_spec_continuous_batching_join(models):
+    """Sequences join mid-flight in spec mode without perturbing others."""
+    target_cfg, params, draft_cfg, draft_params = models
+    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
+    rng = np.random.default_rng(2)
+    p1 = rng.integers(0, 256, size=9).tolist()
+    p2 = rng.integers(0, 256, size=17).tolist()
+    w1 = plain.generate([p1], max_new_tokens=12)[0]
+    w2 = plain.generate([p2], max_new_tokens=8)[0]
+
+    spec = InferenceEngine(target_cfg, _ecfg(3), params=params,
+                           draft_cfg=draft_cfg, draft_params=draft_params)
+    s1 = Sequence(request_id=1, prompt_tokens=p1, max_new_tokens=12)
+    s2 = Sequence(request_id=2, prompt_tokens=p2, max_new_tokens=8)
+    spec.prefill(s1)
+    spec.decode_steps()
+    spec.prefill(s2)            # joins while s1 mid-generation
+    while spec.active_sequences():
+        spec.decode_steps()
+    assert s1.generated == w1
+    assert s2.generated == w2

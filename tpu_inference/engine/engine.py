@@ -98,7 +98,9 @@ class InferenceEngine:
                  params: Optional[dict] = None, seed: int = 0,
                  attn_backend: str = "dense",
                  shard_fn: Optional[Callable[[dict], dict]] = None,
-                 mesh: Optional[Any] = None):
+                 mesh: Optional[Any] = None,
+                 draft_cfg: Optional[ModelConfig] = None,
+                 draft_params: Optional[dict] = None):
         model_cfg.validate()
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
@@ -116,6 +118,8 @@ class InferenceEngine:
                     "use the default dense path with mesh")
             from tpu_inference.parallel import shardings as _shd
             _shd.validate_tp(model_cfg, mesh.shape.get("tp", 1))
+            if draft_cfg is not None:
+                _shd.validate_tp(draft_cfg, mesh.shape.get("tp", 1))
         if params is None:
             params, _ = build_model(model_cfg, seed=seed)
         if shard_fn is not None:
@@ -133,8 +137,14 @@ class InferenceEngine:
         self.attn_backend = attn_backend
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
+        spec_on = (draft_cfg is not None
+                   and engine_cfg.num_speculative_tokens > 0)
         self.prefix_cache = None
-        if engine_cfg.enable_prefix_cache:
+        # Prefix cache and spec are mutually exclusive for now: cached
+        # target pages have no draft-pool twin, and writing the draft
+        # prompt into shared page ids would corrupt other sequences'
+        # draft KV. (Safe combination = draft-side cache; future work.)
+        if engine_cfg.enable_prefix_cache and not spec_on:
             from tpu_inference.engine.prefix_cache import PrefixCache
             self.prefix_cache = PrefixCache(self.allocator,
                                             engine_cfg.page_size)
@@ -147,6 +157,35 @@ class InferenceEngine:
             partial(self._prefill_fn), donate_argnums=(1,))
         self._decode_multi_jit = jax.jit(
             partial(self._decode_multi_fn), donate_argnums=(1,))
+
+        # Speculative decoding (BASELINE.json config 4): a draft model with
+        # its own KV pool but the SAME page geometry + block tables, so one
+        # host-side ctx/page state serves both models.
+        self.spec_enabled = spec_on
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        if self.spec_enabled:
+            assert draft_cfg.vocab_size == model_cfg.vocab_size, \
+                "draft and target must share a tokenizer/vocab"
+            self.draft_cfg = draft_cfg
+            self.draft_mod = get_model_fns(draft_cfg)
+            if draft_params is None:
+                draft_params, _ = build_model(draft_cfg, seed=seed + 1)
+            if mesh is not None:
+                # Draft weights get the same mesh treatment as the target
+                # (divisibility was fail-fast-checked above); the draft
+                # pool reuses the tp-sharded kv layout.
+                from tpu_inference.parallel import shardings as _shd
+                draft_params = _shd.shard_params(draft_params, draft_cfg,
+                                                 mesh)
+            self.draft_params = draft_params
+            self.draft_kv = kvc.alloc_kv_pages(draft_cfg, engine_cfg,
+                                               sharding=kv_sh)
+            from tpu_inference.engine.speculative import spec_round
+            self._spec_jit = jax.jit(partial(spec_round, self),
+                                     donate_argnums=(2, 3))
+            self._draft_prefill_jit = jax.jit(
+                partial(self._draft_prefill_fn), donate_argnums=(1,))
 
     # ------------------------------------------------------------------
     # Device graphs (pure functions of arrays; jitted once per bucket/batch)
@@ -179,6 +218,23 @@ class InferenceEngine:
         sp = SamplingParams(temperature=temperature, top_p=top_p)
         tok = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
         return kv, tok, logits
+
+    def _draft_prefill_fn(self, draft_params, draft_kv: KVPages, tokens,
+                          prompt_len, prefix_len, block_table):
+        """Populate the draft model's KV for the prompt (no sampling).
+        Shapes mirror _prefill_fn; runs once per prefill chunk."""
+        cfg = self.draft_cfg
+        s = tokens.shape[1]
+        ar = jnp.arange(s)[None, :]
+        positions = prefix_len[:, None] + ar
+        valid = ar < prompt_len[:, None]
+        positions = jnp.minimum(positions, self.engine_cfg.max_context - 1)
+        attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_table,
+                               positions, valid, q_offset=prefix_len,
+                               kv_len=prefix_len + prompt_len)
+        _, draft_kv = self.draft_mod.forward_hidden(
+            draft_params, cfg, tokens, positions, draft_kv, attn)
+        return draft_kv
 
     def _decode_multi_fn(self, params, kv: KVPages, tokens, ctx_lens,
                          block_tables, allowed, eos_ids, key, temperature,
@@ -252,14 +308,28 @@ class InferenceEngine:
             self.kv, _, _ = self._prefill_jit(
                 self.params, self.kv, toks, one, zero, jnp.asarray(bt),
                 self._next_key(), tz, tp)
+            if self.spec_enabled:
+                self.draft_kv = self._draft_prefill_jit(
+                    self.draft_params, self.draft_kv, toks, one, zero,
+                    jnp.asarray(bt))
         b = ecfg.max_batch_size
-        self.kv, _ = self._decode_multi_jit(
-            self.params, self.kv, jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b, self.max_pages), jnp.int32),
-            jnp.zeros((b,), jnp.int32),
-            jnp.full((b,), -1, jnp.int32), self._next_key(),
-            jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
+        if self.spec_enabled:
+            out = self._spec_jit(
+                self.params, self.draft_params, self.kv, self.draft_kv,
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, self.max_pages), jnp.int32),
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+                self._next_key(), jnp.zeros((b,), jnp.float32),
+                jnp.ones((b,), jnp.float32))
+            self.kv, self.draft_kv = out.kv, out.draft_kv
+        else:
+            self.kv, _ = self._decode_multi_jit(
+                self.params, self.kv, jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, self.max_pages), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.full((b,), -1, jnp.int32), self._next_key(),
+                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -349,6 +419,12 @@ class InferenceEngine:
                 self._next_key(),
                 jnp.asarray([seq.temperature], np.float32),
                 jnp.asarray([seq.top_p], np.float32))
+            if self.spec_enabled:
+                # Mirror the chunk into the draft model's KV (same pages).
+                self.draft_kv = self._draft_prefill_jit(
+                    self.draft_params, self.draft_kv, jnp.asarray(toks),
+                    jnp.asarray([len(chunk)], np.int32),
+                    jnp.asarray([offset], np.int32), jnp.asarray(bt))
             offset += len(chunk)
         seq.ctx_len = len(prompt)
         first = int(tok[0])
@@ -422,6 +498,8 @@ class InferenceEngine:
         ``_maybe_finish`` stays the source of truth for finish state.
         ``max_steps`` additionally caps every lane (decode_step uses 1).
         """
+        if self.spec_enabled:
+            return self._spec_decode_steps(max_steps)
         ecfg = self.engine_cfg
         k_steps = max(1, ecfg.decode_steps_per_call)
         if max_steps is not None:
@@ -493,6 +571,89 @@ class InferenceEngine:
                     seq.first_token_time = time.perf_counter()
                 self._maybe_finish(seq, tok)
                 got.append(tok)
+            if got:
+                result[seq.request_id] = got
+        return result
+
+    def _spec_decode_steps(self, max_steps: Optional[int] = None
+                           ) -> Dict[int, List[int]]:
+        """One speculative round: draft proposes gamma tokens, target
+        verifies them in a single forward, rejection sampling keeps an
+        exact-distribution prefix. Emits 1..gamma+1 tokens per sequence.
+
+        No KV rollback on rejection: host ctx_len only advances over kept
+        tokens and attention masks the cache by kv_len, so rejected
+        positions are dead rows that later writes overwrite."""
+        ecfg = self.engine_cfg
+        gamma = ecfg.num_speculative_tokens
+        s_len = gamma + 1
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return {}
+
+        emit_by_slot: Dict[int, int] = {}
+        for seq in active_seqs:
+            budget = seq.max_new_tokens - len(seq.generated)
+            room = ecfg.max_context - 1 - seq.ctx_len
+            emit_cap = max(0, min(s_len, budget, room))
+            if max_steps is not None:
+                emit_cap = min(emit_cap, max_steps)
+            # The device writes KV for up to s_len positions; provision
+            # pages for what fits, clamp emissions to written capacity.
+            want = min(s_len, room)
+            need = kvc.pages_needed(want, ecfg.page_size,
+                                    already=seq.ctx_len)
+            if need > self.allocator.num_free:
+                slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
+                emit_cap = min(emit_cap,
+                               slack + self.allocator.num_free
+                               * ecfg.page_size)
+                need = min(need, self.allocator.num_free)
+            if emit_cap <= 0:
+                seq.done, seq.finish_reason = True, "oom"
+                seq.finish_time = time.perf_counter()
+                continue
+            if need > 0:
+                seq.pages.extend(self.allocator.allocate(need))
+            emit_by_slot[seq.slot] = emit_cap
+        active_seqs = [s for s in active_seqs if not s.done]
+        if not active_seqs:
+            return {}
+
+        b = ecfg.max_batch_size
+        tokens, ctx_lens, bts, temps, top_ps = self._stage_batch(active_seqs)
+        cap = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        for seq in active_seqs:
+            cap[seq.slot] = len(seq.pages) * ecfg.page_size
+            active[seq.slot] = True
+
+        out = self._spec_jit(
+            self.params, self.draft_params, self.kv, self.draft_kv,
+            jnp.asarray(tokens), jnp.asarray(ctx_lens), jnp.asarray(bts),
+            jnp.asarray(cap), jnp.asarray(active), self._next_key(),
+            jnp.asarray(temps), jnp.asarray(top_ps))
+        self.kv, self.draft_kv = out.kv, out.draft_kv
+        emitted = np.asarray(out.emitted)                   # [B, gamma+1]
+        n_acc = np.asarray(out.n_accepted)
+
+        result: Dict[int, List[int]] = {}
+        for seq in active_seqs:
+            got: List[int] = []
+            for j in range(s_len):
+                if seq.done or len(got) >= emit_by_slot[seq.slot]:
+                    break
+                tok = int(emitted[seq.slot, j])
+                if tok < 0:
+                    break
+                seq.ctx_len += 1
+                seq.generated.append(tok)
+                if seq.first_token_time == 0.0:
+                    seq.first_token_time = time.perf_counter()
+                self._maybe_finish(seq, tok)
+                got.append(tok)
+            self.spec_drafted += gamma
+            self.spec_accepted += int(n_acc[seq.slot])
             if got:
                 result[seq.request_id] = got
         return result

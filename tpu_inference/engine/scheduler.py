@@ -63,6 +63,10 @@ class SchedulerStats:
         }
         if engine.prefix_cache is not None:
             out["prefix_cache"] = engine.prefix_cache.stats()
+        if engine.spec_enabled:
+            d, a = engine.spec_drafted, engine.spec_accepted
+            out["speculative"] = {"drafted": d, "accepted": a,
+                                  "acceptance_rate": (a / d) if d else 0.0}
         return out
 
 

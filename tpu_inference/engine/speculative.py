@@ -1,0 +1,161 @@
+"""Speculative decoding: draft-model propose, target-model verify.
+
+One spec round per device dispatch (BASELINE.json config 4), all static
+shapes (SURVEY.md §7 hard part 6 — "variable acceptance lengths vs
+static shapes"):
+
+1. **Draft phase** — the small draft model runs ``gamma`` sequential
+   decode steps under ``lax.scan``, proposing d_1..d_gamma per slot and
+   recording its full probability rows (needed for exact rejection
+   sampling).
+2. **Verify phase** — the target model scores all gamma+1 positions in
+   ONE forward: inputs [last, d_1..d_gamma] at positions ctx..ctx+gamma.
+   This turns gamma sequential target steps into one MXU-friendly
+   batched-matmul pass — the entire speedup.
+3. **Accept phase** — standard rejection sampling (greedy degenerates to
+   exact argmax match): accept d_i with prob min(1, q_i(d_i)/p_i(d_i));
+   on first rejection emit a correction drawn from norm(max(q_i - p_i,
+   0)); if all accepted, emit a bonus token from q_{gamma+1}.
+
+Variable acceptance needs NO KV rollback in this engine: attention masks
+the cache by per-sequence ``kv_len`` (= host ctx_len), so KV rows written
+for rejected drafts are simply never attended to and get overwritten when
+real tokens reach those positions. Draft and target share block tables
+(the draft pool has identical page geometry), so the host tracks one
+ctx per sequence for both models.
+
+Sampling filters (temperature, top-k, top-p) are applied to BOTH the
+draft and target distributions before the q/p acceptance ratio, so spec
+mode samples from exactly the same filtered distribution as the plain
+decode path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SpecRoundOut(NamedTuple):
+    kv: object               # target KVPages
+    draft_kv: object         # draft KVPages
+    emitted: jax.Array       # [B, gamma+1] int32, -1 padded
+    n_accepted: jax.Array    # [B] int32 (drafts accepted, excl. bonus)
+
+
+def _probs(logits: jax.Array, temperature: jax.Array, top_p: jax.Array,
+           top_k: int) -> jax.Array:
+    """The engine's actual sampling distribution per row (temperature +
+    top-k + top-p filtered, renormalized); temperature<=0 = one-hot
+    argmax. Using the *filtered* distributions for both p and q keeps
+    rejection sampling exact w.r.t. what the non-spec path samples.
+    logits [B, V] f32; temperature/top_p [B]."""
+    from tpu_inference.engine.sampling import _apply_top_k, _apply_top_p
+
+    greedy = jax.nn.one_hot(jnp.argmax(logits, -1), logits.shape[-1],
+                            dtype=jnp.float32)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = _apply_top_p(_apply_top_k(logits / temp, top_k), top_p)
+    soft = jax.nn.softmax(scaled, axis=-1)
+    return jnp.where((temperature <= 0.0)[:, None], greedy, soft)
+
+
+def _sample_from(probs: jax.Array, key: jax.Array) -> jax.Array:
+    """Categorical over probability rows (works for one-hot too)."""
+    return jax.random.categorical(key, jnp.log(probs + 1e-30), axis=-1
+                                  ).astype(jnp.int32)
+
+
+def spec_round(engine, params, draft_params, kv, draft_kv, tokens, ctx_lens,
+               block_tables, cap, active, key, temperature, top_p):
+    """One propose/verify/accept round. Pure function of arrays; jitted by
+    the engine with both KV pools donated.
+
+    tokens [B] last sampled (unwritten) token; ctx_lens [B]; cap [B] =
+    provisioned token capacity per slot (writes at positions >= cap go to
+    the trash page); active [B] bool. Returns SpecRoundOut.
+    """
+    from tpu_inference.engine.engine import make_paged_attn
+
+    ecfg = engine.engine_cfg
+    gamma = ecfg.num_speculative_tokens
+    b = tokens.shape[0]
+    vocab = engine.model_cfg.vocab_size
+
+    # ---------------------------------------------------------- draft
+    def draft_step(carry, s):
+        dkv, tok, ctx = carry
+        positions = jnp.minimum(ctx, ecfg.max_context - 1)[:, None]
+        valid = active[:, None] & (positions < cap[:, None])
+        attn = make_paged_attn(engine.draft_cfg, ecfg.page_size,
+                               block_tables, positions, valid,
+                               q_offset=ctx, kv_len=ctx + 1)
+        hidden, dkv = engine.draft_mod.forward_hidden(
+            draft_params, engine.draft_cfg, tok[:, None], positions, dkv,
+            attn)
+        logits = engine.draft_mod.unembed(draft_params, engine.draft_cfg,
+                                          hidden[:, 0])
+        p_row = _probs(logits, temperature, top_p, ecfg.top_k)  # [B, V]
+        d = _sample_from(p_row, jax.random.fold_in(key, s))
+        return (dkv, d, ctx + 1), (d, p_row)
+
+    # gamma+1 steps: the extra step's *write* (input d_gamma at position
+    # ctx+gamma) is what matters — on a full accept that row becomes part
+    # of the permanent context and no later step revisits it; skipping it
+    # would leave a stale draft-KV row degrading acceptance forever after.
+    # Its sampled token/probs are discarded.
+    (draft_kv, _, _), (drafts, p_rows) = jax.lax.scan(
+        draft_step, (draft_kv, tokens, ctx_lens),
+        jnp.arange(gamma + 1, dtype=jnp.int32))
+    drafts = drafts.T[:, :gamma]                              # [B, gamma]
+    p_rows = p_rows.transpose(1, 0, 2)[:, :gamma]             # [B, gamma, V]
+
+    # ---------------------------------------------------------- verify
+    s_len = gamma + 1
+    tokens_in = jnp.concatenate([tokens[:, None], drafts], axis=1)
+    ar = jnp.arange(s_len, dtype=jnp.int32)[None, :]
+    positions = jnp.minimum(ctx_lens[:, None] + ar, ecfg.max_context - 1)
+    valid = active[:, None] & (positions < cap[:, None])
+    attn = make_paged_attn(engine.model_cfg, ecfg.page_size, block_tables,
+                           positions, valid, q_offset=ctx_lens,
+                           kv_len=ctx_lens + s_len)
+    hidden, kv = engine.mod.forward_hidden(params, engine.model_cfg,
+                                           tokens_in, positions, kv, attn)
+    logits_all = engine.mod.unembed(params, engine.model_cfg, hidden)
+    q_rows = jax.vmap(_probs, in_axes=(1, None, None, None), out_axes=1)(
+        logits_all, temperature, top_p, ecfg.top_k)           # [B, g+1, V]
+
+    # ---------------------------------------------------------- accept
+    d_idx = drafts[..., None]                                 # [B, g, 1]
+    q_d = jnp.take_along_axis(q_rows[:, :gamma], d_idx, -1)[..., 0]
+    p_d = jnp.take_along_axis(p_rows, d_idx, -1)[..., 0]      # [B, g]
+    u = jax.random.uniform(jax.random.fold_in(key, 7919), (b, gamma))
+    accept = u < q_d / jnp.maximum(p_d, 1e-30)                # [B, g]
+    acc_prefix = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+    n_acc = jnp.sum(acc_prefix, axis=1)                       # [B] 0..g
+
+    # Correction dist at the first rejected row; bonus row when n_acc==g.
+    row = jax.vmap(lambda q, i: q[i])(q_rows, n_acc)          # [B, V]
+    p_row_at = jax.vmap(lambda p, i: p[jnp.minimum(i, gamma - 1)])(
+        p_rows, n_acc)
+    resid = jnp.maximum(row - p_row_at, 0.0)
+    resid_sum = jnp.sum(resid, axis=-1, keepdims=True)
+    # Degenerate residual (q==p, e.g. both greedy-one-hot on the same
+    # token can't be rejected, but guard anyway) falls back to q.
+    corr_dist = jnp.where(resid_sum > 1e-12, resid / (resid_sum + 1e-30),
+                          row)
+    final_dist = jnp.where((n_acc == gamma)[:, None], row, corr_dist)
+    final_tok = _sample_from(final_dist, jax.random.fold_in(key, 104729))
+
+    # emitted[b] = accepted drafts ++ [final_tok] ++ -1 padding.
+    slot_idx = jnp.arange(s_len, dtype=jnp.int32)[None, :]    # [1, g+1]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    emitted = jnp.where(slot_idx < n_acc[:, None], drafts_pad, -1)
+    emitted = jnp.where(slot_idx == n_acc[:, None], final_tok[:, None],
+                        emitted)
+    emitted = jnp.where(active[:, None], emitted, -1)
+    return SpecRoundOut(kv=kv, draft_kv=draft_kv, emitted=emitted,
+                        n_accepted=jnp.where(active, n_acc, 0))

@@ -29,6 +29,11 @@ def main() -> None:
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-pages-per-seq", type=int, default=64,
                    help="max context = page-size * this")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree (devices in the mesh)")
+    p.add_argument("--draft-model", default=None, choices=sorted(PRESETS),
+                   help="enable speculative decoding with this draft preset")
+    p.add_argument("--num-speculative-tokens", type=int, default=4)
     p.add_argument("--no-warmup", action="store_true")
     args = p.parse_args()
 
@@ -36,10 +41,14 @@ def main() -> None:
 
     server = build_server(model=args.model, tokenizer=args.tokenizer,
                           checkpoint=args.checkpoint,
-                          warmup=not args.no_warmup,
+                          warmup=not args.no_warmup, tp=args.tp,
+                          draft_model=args.draft_model,
                           max_batch_size=args.max_batch_size,
                           num_pages=args.num_pages, page_size=args.page_size,
-                          max_pages_per_seq=args.max_pages_per_seq)
+                          max_pages_per_seq=args.max_pages_per_seq,
+                          num_speculative_tokens=(
+                              args.num_speculative_tokens
+                              if args.draft_model else 0))
     app = server.make_app()
     web.run_app(app, host=args.host, port=args.port)
 

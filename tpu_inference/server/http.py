@@ -241,21 +241,32 @@ class InferenceServer:
 
 def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                  checkpoint: Optional[str] = None, warmup: bool = True,
+                 tp: int = 1, draft_model: Optional[str] = None,
                  **engine_overrides) -> InferenceServer:
     """Convenience constructor used by CLI, tests, and benchmarks."""
-    from tpu_inference.config import EngineConfig, ServerConfig
+    from tpu_inference.config import EngineConfig, ParallelConfig, ServerConfig
 
     model_cfg = PRESETS[model]()
     engine_cfg = EngineConfig(**engine_overrides) if engine_overrides else EngineConfig()
     cfg = FrameworkConfig(model=model_cfg, engine=engine_cfg,
+                          parallel=ParallelConfig(tp=tp),
                           server=ServerConfig(model_name=model,
                                               tokenizer=tokenizer,
                                               warmup=warmup),
                           checkpoint_path=checkpoint)
+    draft_cfg = PRESETS[draft_model]() if draft_model else None
+    params = None
     if checkpoint:
         from tpu_inference.models import weights
 
         params = weights.load_checkpoint(model_cfg, checkpoint)
-        engine = InferenceEngine(model_cfg, engine_cfg, params=params)
+    if params is not None or draft_cfg is not None:
+        mesh = None
+        if cfg.parallel.n_devices > 1:
+            from tpu_inference.parallel.mesh import build_mesh
+
+            mesh = build_mesh(cfg.parallel)
+        engine = InferenceEngine(model_cfg, engine_cfg, params=params,
+                                 mesh=mesh, draft_cfg=draft_cfg)
         return InferenceServer(cfg, engine=engine)
     return InferenceServer(cfg)
