@@ -1,0 +1,183 @@
+"""End-to-end replay benchmark: BurstGPT trace -> in-process TPU server.
+
+The headline metric harness (BASELINE.md: "BurstGPT replay — tokens/s/chip,
+p50/p99 TTFT+TPOT"). Boots the Ollama-protocol server in a background
+thread, replays a trace through the vendored traffic generator (the
+reference's own benchmark client, unchanged protocol), and summarizes the
+per-request metrics the harness records.
+
+Usage:
+    python benchmarks/replay.py --model tiny-llama --max-trace 20
+    python benchmarks/replay.py --model llama-3-8b --tp 8 \
+        --trace data/BurstGPT_1.csv --out benchmarks/results/8b_tp8.json
+
+Timing semantics match the reference client (SURVEY.md §2c): TTFT =
+first streamed chunk relative to request start; headers are withheld by
+the server until the first token, so header-arrival ≈ TTFT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _percentiles(xs, ps=(50, 99)):
+    if not xs:
+        return {f"p{p}": None for p in ps}
+    return {f"p{p}": round(float(np.percentile(xs, p)), 4) for p in ps}
+
+
+def summarize(metrics: dict, n_chips: int = 1) -> dict:
+    """Reduce the harness's per-request dicts to the headline numbers."""
+    ok = {k: m for k, m in metrics.items() if m.get("success")}
+    ttft, tpot, e2e, tokens = [], [], [], 0
+    t_first, t_last = float("inf"), 0.0
+    for m in ok.values():
+        start = m["request_start_time"]
+        first = m["first_token_arrive_time"]
+        end = m["response_end_time"]
+        n_out = m.get("num_output_tokens") or 0
+        if first is not None and start is not None:
+            ttft.append(first - start)
+        if end is not None and start is not None:
+            e2e.append(end - start)
+        if end is not None and first is not None and n_out > 1:
+            tpot.append((end - first) / (n_out - 1))
+        tokens += n_out
+        if start is not None:
+            t_first = min(t_first, start)
+        if end is not None:
+            t_last = max(t_last, end)
+    wall = max(t_last - t_first, 1e-9)
+    return {
+        "requests": len(metrics),
+        "succeeded": len(ok),
+        "output_tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(tokens / wall, 2),
+        "tokens_per_s_per_chip": round(tokens / wall / max(n_chips, 1), 2),
+        "ttft_s": _percentiles(ttft),
+        "tpot_s": _percentiles(tpot),
+        "e2e_s": _percentiles(e2e),
+    }
+
+
+def start_server(args) -> tuple:
+    """Boot the server (with warmup) on a background event loop; returns
+    (port, stop_fn). Blocks until it accepts connections."""
+    import jax  # noqa: F401 (import before aiohttp threads)
+
+    from aiohttp import web
+
+    from tpu_inference.server.http import build_server
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    srv = build_server(
+        model=args.model, tokenizer=args.tokenizer, tp=args.tp,
+        draft_model=args.draft_model, checkpoint=args.checkpoint,
+        draft_checkpoint=args.draft_checkpoint,
+        warmup=not args.no_warmup,
+        max_batch_size=args.max_batch_size, num_pages=args.num_pages,
+        page_size=args.page_size, max_pages_per_seq=args.max_pages_per_seq,
+        decode_steps_per_call=args.decode_steps_per_call,
+        num_speculative_tokens=(args.num_speculative_tokens
+                                if args.draft_model else 0))
+    loop = asyncio.new_event_loop()
+    ready = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        app = srv.make_app()
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, name="bench-server", daemon=True)
+    t.start()
+    if not ready.wait(timeout=1800):
+        raise TimeoutError("server failed to start (warmup hang?)")
+
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=30)
+
+    return srv, port, stop
+
+
+def main() -> dict:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--tokenizer", default="byte")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--draft-model", default=None)
+    p.add_argument("--draft-checkpoint", default=None)
+    p.add_argument("--num-speculative-tokens", type=int, default=4)
+    p.add_argument("--trace", default="data/trace1.csv")
+    p.add_argument("--data", default="data/conversations.json")
+    p.add_argument("--max-trace", type=int, default=100)
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages-per-seq", type=int, default=64)
+    p.add_argument("--decode-steps-per-call", type=int, default=8)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--out", default=None, help="write summary JSON here")
+    args = p.parse_args()
+
+    from traffic_generator.data import DataLoader
+    from traffic_generator.generator import TrafficGenerator
+    from traffic_generator.metrics import MetricCollector
+    from traffic_generator.schedule import Scheduler
+
+    srv, port, stop = start_server(args)
+    try:
+        data = DataLoader.get_data_from_path(args.data)
+        schedule = Scheduler.get_schedule_from_trace(args.trace,
+                                                     args.max_trace)
+        collector = MetricCollector()
+        gen = TrafficGenerator(
+            data, schedule,
+            {"url": f"http://127.0.0.1:{port}/api/generate",
+             "model": args.model, "temperature": args.temperature,
+             "max_tokens": None, "stream": True},
+            collector)
+        t0 = time.perf_counter()
+        metrics = gen.start_profile()
+        replay_s = time.perf_counter() - t0
+        summary = summarize(metrics, n_chips=args.tp)
+        summary["replay_s"] = round(replay_s, 3)
+        summary["server_stats"] = srv.scheduler.stats.snapshot(srv.engine)
+    finally:
+        stop()
+
+    out = {"config": vars(args), "summary": summary}
+    print(json.dumps(summary, indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,9 @@ def main() -> None:
                    help="tensor-parallel degree (devices in the mesh)")
     p.add_argument("--draft-model", default=None, choices=sorted(PRESETS),
                    help="enable speculative decoding with this draft preset")
+    p.add_argument("--draft-checkpoint", default=None,
+                   help="HF safetensors dir for the draft model (required "
+                        "when --checkpoint is set)")
     p.add_argument("--num-speculative-tokens", type=int, default=4)
     p.add_argument("--no-warmup", action="store_true")
     args = p.parse_args()
@@ -43,6 +46,7 @@ def main() -> None:
                           checkpoint=args.checkpoint,
                           warmup=not args.no_warmup, tp=args.tp,
                           draft_model=args.draft_model,
+                          draft_checkpoint=args.draft_checkpoint,
                           max_batch_size=args.max_batch_size,
                           num_pages=args.num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,

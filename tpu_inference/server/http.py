@@ -242,6 +242,7 @@ class InferenceServer:
 def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                  checkpoint: Optional[str] = None, warmup: bool = True,
                  tp: int = 1, draft_model: Optional[str] = None,
+                 draft_checkpoint: Optional[str] = None,
                  **engine_overrides) -> InferenceServer:
     """Convenience constructor used by CLI, tests, and benchmarks."""
     from tpu_inference.config import EngineConfig, ParallelConfig, ServerConfig
@@ -255,11 +256,24 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                                               warmup=warmup),
                           checkpoint_path=checkpoint)
     draft_cfg = PRESETS[draft_model]() if draft_model else None
-    params = None
+    params = draft_params = None
     if checkpoint:
         from tpu_inference.models import weights
 
         params = weights.load_checkpoint(model_cfg, checkpoint)
+    if draft_cfg is not None:
+        if draft_checkpoint:
+            from tpu_inference.models import weights
+
+            draft_params = weights.load_checkpoint(draft_cfg,
+                                                   draft_checkpoint)
+        elif checkpoint:
+            # Trained target + random draft = ~zero acceptance: every
+            # round pays draft+verify to emit one token. Refuse loudly.
+            raise ValueError(
+                "--draft-model with --checkpoint requires "
+                "--draft-checkpoint: a random-weight draft makes "
+                "speculative decoding a pure slowdown")
     if params is not None or draft_cfg is not None:
         mesh = None
         if cfg.parallel.n_devices > 1:
@@ -267,6 +281,7 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
 
             mesh = build_mesh(cfg.parallel)
         engine = InferenceEngine(model_cfg, engine_cfg, params=params,
-                                 mesh=mesh, draft_cfg=draft_cfg)
+                                 mesh=mesh, draft_cfg=draft_cfg,
+                                 draft_params=draft_params)
         return InferenceServer(cfg, engine=engine)
     return InferenceServer(cfg)

@@ -52,6 +52,28 @@ class TrafficGenerator:
                         "num_predict": max_tokens},
         }
 
+    @staticmethod
+    def _count_tokens(tail: bytes, n_lines: int) -> int:
+        """Output-token count (additive metric field; the reference schema
+        is otherwise preserved). Prefer the server-reported ``eval_count``
+        from the terminal NDJSON record — line counting overcounts when a
+        multi-byte UTF-8 tail is flushed as an extra non-token line."""
+        import json as _json
+
+        for line in reversed(tail.split(b"\n")):
+            if not line.strip():
+                continue
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                break
+            if rec.get("done"):
+                n = rec.get("eval_count")
+                if isinstance(n, int):
+                    return n
+            break
+        return max(0, n_lines - 1)
+
     async def inference_call(self, session: aiohttp.ClientSession,
                              prompt: str, len_output: int, sleep_time: float,
                              query_id: int) -> None:
@@ -64,13 +86,19 @@ class TrafficGenerator:
                                        "collector": collector}) as resp:
                 resp.raise_for_status()
                 first = True
+                n_lines = 0
+                tail = b""
                 async for _chunk in resp.content:
                     if first:
                         collector.record(query_id, "first_token_arrive_time",
                                          collector.elapsed())
                         first = False
+                    n_lines += _chunk.count(b"\n")
+                    tail = (tail + _chunk)[-8192:]
                 collector.record(query_id, "response_end_time",
                                  collector.elapsed())
+                collector.record(query_id, "num_output_tokens",
+                                 self._count_tokens(tail, n_lines))
                 collector.record(query_id, "success", True)
                 print(f"[END] query {query_id}")
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:

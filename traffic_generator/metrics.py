@@ -45,6 +45,7 @@ class MetricCollector:
             "response_headers_received_time": None,
             "first_token_arrive_time": None,
             "response_end_time": None,
+            "num_output_tokens": None,
             "scheduled_start_time": scheduled_start,
             "success": None,
         }
