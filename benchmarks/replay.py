@@ -99,14 +99,20 @@ def start_server(args) -> tuple:
                                 if args.draft_model else 0))
     loop = asyncio.new_event_loop()
     ready = threading.Event()
+    boot_err: list = []
 
     def run():
         asyncio.set_event_loop(loop)
-        app = srv.make_app()
-        runner = web.AppRunner(app)
-        loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, "127.0.0.1", port)
-        loop.run_until_complete(site.start())
+        try:
+            app = srv.make_app()
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            loop.run_until_complete(site.start())
+        except BaseException as e:  # surface boot failures immediately
+            boot_err.append(e)
+            ready.set()
+            return
         ready.set()
         loop.run_forever()
 
@@ -114,6 +120,8 @@ def start_server(args) -> tuple:
     t.start()
     if not ready.wait(timeout=1800):
         raise TimeoutError("server failed to start (warmup hang?)")
+    if boot_err:
+        raise boot_err[0]
 
     def stop():
         loop.call_soon_threadsafe(loop.stop)

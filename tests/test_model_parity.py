@@ -126,3 +126,21 @@ def test_dense_attention_is_causal():
                             common.make_dense_attn())
     np.testing.assert_allclose(np.asarray(out1)[:, :-1],
                                np.asarray(out2)[:, :-1], atol=1e-6)
+
+
+def test_orbax_native_checkpoint_roundtrip(tmp_path):
+    """Orbax save/restore preserves the params pytree exactly."""
+    import numpy as np
+
+    from tpu_inference import config as cfgs
+    from tpu_inference.models import build_model
+    from tpu_inference.models.weights import load_native, save_native
+
+    cfg = cfgs.tiny_llama(vocab_size=128)
+    params, _ = build_model(cfg, seed=3)
+    path = str(tmp_path / "ckpt")
+    save_native(params, path)
+    restored = load_native(path, params)
+    import jax
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

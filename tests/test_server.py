@@ -142,3 +142,42 @@ def test_concurrent_requests_interleave(server):
         return bodies
 
     _run(server, go)
+
+
+def test_debug_requests_and_profile(server):
+    """Observability endpoints: request timelines + profiler control."""
+
+    async def scenario(client):
+        resp = await client.post("/api/generate", json={
+            "model": "m", "prompt": "observe me", "temperature": 0,
+            "max_tokens": 6, "stream": False})
+        assert resp.status == 200
+
+        resp = await client.get("/debug/requests")
+        timelines = await resp.json()
+        assert len(timelines) >= 1
+        t = timelines[-1]
+        assert t["output_tokens"] == 6
+        assert t["finish_reason"] == "length"
+        assert t["queue_wait_s"] >= 0 and t["decode_s"] >= 0
+        assert t["tpot_s"] > 0
+
+        resp = await client.get("/metrics")
+        stats = await resp.json()
+        assert stats["model_params"] > 0
+        assert stats["approx_flops_per_token"] == 2 * stats["model_params"]
+
+        import os
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            resp = await client.post("/debug/profile",
+                                     json={"action": "start", "dir": d})
+            assert resp.status == 200
+            resp = await client.post("/debug/profile",
+                                     json={"action": "stop"})
+            assert resp.status == 200
+            assert any(os.scandir(d))       # trace artifacts written
+        resp = await client.post("/debug/profile", json={"action": "bogus"})
+        assert resp.status == 400
+
+    _run(server, scenario)

@@ -134,6 +134,7 @@ class InferenceEngine:
             params = shd.shard_params(params, model_cfg, mesh)
             kv_sh = shd.kv_sharding(mesh)
         self.params = params
+        self.n_params = int(sum(x.size for x in jax.tree.leaves(params)))
         self.attn_backend = attn_backend
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)

@@ -60,6 +60,10 @@ class SchedulerStats:
             "kv_pages_total": total,
             "kv_pages_in_use": total - engine.allocator.num_free,
             "peak_pages_in_use": self.peak_pages_in_use,
+            "model_params": engine.n_params,
+            # ~2 FLOPs per param per decoded token; divide tokens/s by
+            # chip peak to get MFU.
+            "approx_flops_per_token": 2 * engine.n_params,
         }
         if engine.prefix_cache is not None:
             out["prefix_cache"] = engine.prefix_cache.stats()
@@ -87,6 +91,10 @@ class EngineScheduler:
         self.max_prefills_per_step = max_prefills_per_step
         self.idle_sleep_s = idle_sleep_s
         self.stats = SchedulerStats()
+        # Per-request event timeline ring (SURVEY.md §5 observability:
+        # "per-request event timeline: enqueue -> schedule -> prefill ->
+        # decode -> stream"). Read by /debug/requests.
+        self.recent: Deque[dict] = collections.deque(maxlen=256)
         self._waiting: Deque[_Pending] = collections.deque()
         self._callbacks: Dict[int, _Pending] = {}
         self._lock = threading.Lock()
@@ -189,8 +197,30 @@ class EngineScheduler:
             pending = self._callbacks.pop(seq.request_id, None)
         self.engine.release(seq)
         self.stats.requests_finished += 1
+        self.recent.append(self._timeline(seq))
         if pending is not None:
             pending.on_finish(seq)
+
+    @staticmethod
+    def _timeline(seq: Sequence) -> dict:
+        """Flatten one request's lifecycle into durations (seconds)."""
+        fin = seq.finish_time or time.perf_counter()
+        first = seq.first_token_time or fin
+        n_out = len(seq.generated)
+        return {
+            "request_id": seq.request_id,
+            "prompt_tokens": len(seq.prompt_tokens),
+            "cached_tokens": seq.cached_tokens,
+            "output_tokens": n_out,
+            "finish_reason": seq.finish_reason,
+            "queue_wait_s": round(max(0.0, (seq.prefill_start or fin)
+                                      - seq.enqueue_time), 6),
+            "prefill_s": round(max(0.0, first - (seq.prefill_start or first)),
+                               6),
+            "decode_s": round(max(0.0, fin - first), 6),
+            "tpot_s": round((fin - first) / (n_out - 1), 6)
+            if n_out > 1 else None,
+        }
 
     def run(self) -> None:
         engine = self.engine

@@ -138,6 +138,35 @@ def convert_state_dict(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
     return jax.tree.map(lambda a: jnp.asarray(a, dtype=cfg.dtype), params)
 
 
+def save_native(params: dict, path: str) -> None:
+    """Serialize a params pytree with Orbax (sharded-aware, resumable).
+
+    The TPU-native checkpoint tier (SURVEY.md §5 checkpoint/resume):
+    HF safetensors are the interchange format; Orbax is the fast path
+    for restart-after-failure, writing each shard from its owning host.
+    """
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params, force=True)
+
+
+def load_native(path: str, template: dict) -> dict:
+    """Restore an Orbax checkpoint. ``template`` is a pytree of arrays or
+    jax.ShapeDtypeStruct (optionally with shardings) giving the target
+    structure/placement — pass sharded abstract leaves to stream a 70B
+    checkpoint straight into its TP layout."""
+    import orbax.checkpoint as ocp
+
+    abstract = jax.tree.map(
+        lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                  sharding=getattr(x, "sharding", None)),
+        template)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), abstract)
+
+
 def load_checkpoint(cfg: ModelConfig, path: str,
                     shardings: Optional[dict] = None) -> dict:
     """Load a HF safetensors directory into a (optionally sharded) pytree.

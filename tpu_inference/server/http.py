@@ -74,6 +74,8 @@ class InferenceServer:
         app.router.add_get("/api/version", self.handle_version)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/debug/requests", self.handle_debug_requests)
+        app.router.add_post("/debug/profile", self.handle_profile)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -107,6 +109,58 @@ class InferenceServer:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.json_response(self.scheduler.stats.snapshot(self.engine))
+
+    async def handle_debug_requests(self, request: web.Request
+                                    ) -> web.Response:
+        """Per-request event timelines for the last <=256 finished
+        requests: queue wait, prefill, decode, TPOT (SURVEY.md §5)."""
+        try:
+            n = int(request.query.get("n", 50))
+        except ValueError:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "'n' must be an integer"}),
+                content_type="application/json")
+        if n <= 0:
+            return web.json_response([])
+        return web.json_response(list(self.scheduler.recent)[-n:])
+
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """Start/stop a jax.profiler trace (TensorBoard / Perfetto).
+
+        POST {"action": "start", "dir": "/tmp/jax-trace"} then
+        POST {"action": "stop"} after driving load; inspect with
+        tensorboard --logdir or ui.perfetto.dev.
+        """
+        import jax
+
+        try:
+            body = await request.json()
+            assert isinstance(body, dict)
+        except (json.JSONDecodeError, UnicodeDecodeError, AssertionError):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "body must be a JSON object"}),
+                content_type="application/json")
+        action = body.get("action")
+        if action == "start":
+            trace_dir = body.get("dir", "/tmp/jax-trace")
+            try:
+                jax.profiler.start_trace(trace_dir)
+            except RuntimeError as e:     # already started
+                return web.json_response({"error": str(e)}, status=409)
+            self._profile_dir = trace_dir
+            return web.json_response({"status": "tracing",
+                                      "dir": trace_dir})
+        if action == "stop":
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as e:
+                return web.json_response({"error": str(e)}, status=409)
+            return web.json_response(
+                {"status": "stopped",
+                 "dir": getattr(self, "_profile_dir", None)})
+        raise web.HTTPBadRequest(text=json.dumps(
+            {"error": "action must be 'start' or 'stop'"}),
+            content_type="application/json")
 
     async def handle_generate(self, request: web.Request) -> web.StreamResponse:
         recv_t = time.perf_counter()

@@ -53,25 +53,20 @@ class TrafficGenerator:
         }
 
     @staticmethod
-    def _count_tokens(tail: bytes, n_lines: int) -> int:
+    def _count_tokens(last_line: bytes, n_lines: int) -> int:
         """Output-token count (additive metric field; the reference schema
         is otherwise preserved). Prefer the server-reported ``eval_count``
         from the terminal NDJSON record — line counting overcounts when a
         multi-byte UTF-8 tail is flushed as an extra non-token line."""
         import json as _json
 
-        for line in reversed(tail.split(b"\n")):
-            if not line.strip():
-                continue
+        if last_line.strip():
             try:
-                rec = _json.loads(line)
+                rec = _json.loads(last_line)
             except ValueError:
-                break
-            if rec.get("done"):
-                n = rec.get("eval_count")
-                if isinstance(n, int):
-                    return n
-            break
+                rec = {}
+            if rec.get("done") and isinstance(rec.get("eval_count"), int):
+                return rec["eval_count"]
         return max(0, n_lines - 1)
 
     async def inference_call(self, session: aiohttp.ClientSession,
@@ -87,18 +82,28 @@ class TrafficGenerator:
                 resp.raise_for_status()
                 first = True
                 n_lines = 0
-                tail = b""
+                buf = b""
+                last_line = b""
                 async for _chunk in resp.content:
                     if first:
                         collector.record(query_id, "first_token_arrive_time",
                                          collector.elapsed())
                         first = False
                     n_lines += _chunk.count(b"\n")
-                    tail = (tail + _chunk)[-8192:]
+                    # Track the last COMPLETE line whole: the terminal
+                    # record carries the full `context` id list and can be
+                    # arbitrarily long, so a fixed-size tail would truncate
+                    # it on exactly the long requests being measured.
+                    buf += _chunk
+                    if b"\n" in buf:
+                        parts = buf.split(b"\n")
+                        last_line = parts[-2]
+                        buf = parts[-1]
                 collector.record(query_id, "response_end_time",
                                  collector.elapsed())
                 collector.record(query_id, "num_output_tokens",
-                                 self._count_tokens(tail, n_lines))
+                                 self._count_tokens(buf or last_line,
+                                                    n_lines))
                 collector.record(query_id, "success", True)
                 print(f"[END] query {query_id}")
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
