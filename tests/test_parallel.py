@@ -127,3 +127,60 @@ def test_dp_tp_mesh_shapes():
     assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
     with pytest.raises(ValueError):
         build_mesh(ParallelConfig(dp=4, tp=4))
+
+
+def test_hybrid_mesh_single_slice():
+    """build_hybrid_mesh == flat mesh layout when all devices share ICI."""
+    from tpu_inference import config as cfgs
+    from tpu_inference.parallel.multihost import build_hybrid_mesh
+
+    pcfg = cfgs.ParallelConfig(dp=2, tp=2, sp=2)
+    mesh = build_hybrid_mesh(pcfg)
+    assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+    # tp groups contiguous in device order (ICI neighbors).
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    assert ids[0, 0, 0] + 1 == ids[0, 1, 0]
+
+
+def test_hybrid_mesh_multi_slice_layout():
+    """dp splits across simulated slices; tp never straddles a slice."""
+    from tpu_inference import config as cfgs
+    from tpu_inference.parallel.multihost import build_hybrid_mesh
+
+    pcfg = cfgs.ParallelConfig(dp=2, tp=4, sp=1)
+    mesh = build_hybrid_mesh(pcfg, num_slices=2)
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    # Replica 0 = devices 0-3, replica 1 = devices 4-7: each tp group
+    # stays inside one "slice" of 4 contiguous devices.
+    assert set(ids[0].flat) == {0, 1, 2, 3}
+    assert set(ids[1].flat) == {4, 5, 6, 7}
+
+    with pytest.raises(ValueError, match="straddle"):
+        build_hybrid_mesh(cfgs.ParallelConfig(dp=1, tp=8), num_slices=2)
+
+
+def test_hybrid_mesh_runs_collectives():
+    """A psum over the hybrid mesh executes (XLA inserts the collective)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpu_inference import config as cfgs
+    from tpu_inference.parallel.multihost import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh(cfgs.ParallelConfig(dp=2, tp=2, sp=2),
+                             num_slices=2)
+    x = jnp.arange(8.0)
+    y = jax.jit(lambda v: v.sum(),
+                in_shardings=NamedSharding(mesh, P(("dp",))),
+                out_shardings=NamedSharding(mesh, P()))(x)
+    assert float(y) == 28.0
+
+
+def test_multihost_initialize_noop_single_process():
+    from tpu_inference import config as cfgs
+    from tpu_inference.parallel.multihost import (initialize,
+                                                  process_local_engine_role)
+    initialize()                      # must not raise on single process
+    from tpu_inference.parallel.mesh import build_mesh
+    role = process_local_engine_role(build_mesh(cfgs.ParallelConfig(tp=2)))
+    assert role["process_count"] == 1
+    assert role["local_devices_in_mesh"] == 2
+    assert role["hosts_frontend"] is True

@@ -181,3 +181,54 @@ def test_debug_requests_and_profile(server):
         assert resp.status == 400
 
     _run(server, scenario)
+
+
+def test_chat_endpoint(server):
+    """Ollama /api/chat: message records, counters, streaming + unary."""
+
+    async def scenario(client):
+        msgs = [{"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hi"}]
+        resp = await client.post("/api/chat", json={
+            "model": "m", "messages": msgs, "stream": False,
+            "options": {"num_predict": 6, "temperature": 0}})
+        assert resp.status == 200
+        rec = await resp.json()
+        assert rec["done"] and rec["message"]["role"] == "assistant"
+        assert "context" not in rec and "response" not in rec
+        assert rec["eval_count"] == 6
+
+        resp = await client.post("/api/chat", json={
+            "model": "m", "messages": msgs, "stream": True,
+            "options": {"num_predict": 6, "temperature": 0}})
+        lines = [json.loads(l) for l in (await resp.read()).splitlines() if l]
+        assert all("message" in l for l in lines)
+        assert lines[-1]["done"] and lines[-1]["eval_count"] == 6
+
+        resp = await client.post("/api/chat", json={"model": "m",
+                                                    "messages": []})
+        assert resp.status == 400
+
+    _run(server, scenario)
+
+
+def test_chaos_injection():
+    """chaos_failure_rate=1.0 rejects every request with 503."""
+    from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                      ServerConfig, tiny_llama)
+    from tpu_inference.server.http import InferenceServer
+
+    cfg = FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
+                            max_batch_size=2, prefill_buckets=(16,)),
+        server=ServerConfig(model_name="t", tokenizer="byte",
+                            chaos_failure_rate=1.0))
+    srv = InferenceServer(cfg)
+
+    async def scenario(client):
+        resp = await client.post("/api/generate", json={
+            "model": "m", "prompt": "x", "max_tokens": 2})
+        assert resp.status == 503
+
+    _run(srv, scenario)

@@ -217,6 +217,11 @@ class ServerConfig:
     # Hold HTTP headers until the first token is ready so client-side TTFT
     # (first streamed chunk) matches header-arrival time (SURVEY.md §2c).
     defer_headers_until_first_token: bool = True
+    # Fault injection (SURVEY.md §5 failure detection: "HTTP-stub chaos
+    # mode"): randomly reject this fraction of /api/generate requests with
+    # 503 and/or delay them, to test client resilience. Off in production.
+    chaos_failure_rate: float = 0.0
+    chaos_delay_s: float = 0.0
 
 
 @dataclasses.dataclass

@@ -1,0 +1,110 @@
+"""Multi-host (multi-slice) distributed runtime: ICI within a slice,
+DCN across slices.
+
+The reference's only "distributed backend" is client-side HTTP to an
+external server (SURVEY.md §2b: no NCCL/MPI/Gloo in-repo); the TPU-native
+equivalent is XLA collectives compiled over the hardware fabrics. This
+module owns the process-level setup those collectives need:
+
+- ``initialize()`` wraps ``jax.distributed.initialize`` — the JAX runtime
+  handshake that makes every host see the global device set (the moral
+  equivalent of NCCL rendezvous, but handled by the runtime; no
+  user-space transport code to write).
+- ``build_hybrid_mesh()`` lays out a mesh whose *inner* axes (tp, sp)
+  stay inside a slice (ICI: ~100s of GB/s, per-layer all-reduce lives
+  here) and whose *outer* axis (dp) spans slices over DCN (~10s of
+  GB/s — only replica-parallel traffic, which is zero in steady-state
+  serving). This is the scaling-book recipe: chatty axes innermost.
+
+Failure model (SURVEY.md §5): JAX's multi-controller runtime fails at
+initialization if any host is absent, and a host loss kills the job —
+recovery is restart + reload weights (models/weights.py Orbax/safetensors
+load streams shards directly to their owning hosts). The serving layer's
+per-request timeouts and OOM-safe admission handle request-level faults;
+process-level elasticity is restart-based, as is standard for TPU pods.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tpu_inference.config import ParallelConfig
+from tpu_inference.parallel.mesh import AXES
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime. No-ops on a single process.
+
+    On TPU pods the three arguments are discovered from the metadata
+    server automatically; pass them explicitly for CPU/GPU multi-process
+    or tests. Safe to call more than once.
+    """
+    if jax._src.distributed.global_state.client is not None:  # initialized
+        return
+    if (coordinator_address is None
+            and os.environ.get("JAX_COORDINATOR_ADDRESS") is None
+            and num_processes is None and jax.process_count() == 1):
+        return                      # single-process: nothing to set up
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def build_hybrid_mesh(pcfg: ParallelConfig,
+                      devices: Optional[Sequence[jax.Device]] = None,
+                      num_slices: Optional[int] = None) -> Mesh:
+    """Mesh over a multi-slice system: dp outermost over DCN, tp/sp
+    contiguous within each slice over ICI.
+
+    ``num_slices`` defaults to the device set's slice count (via
+    ``device.slice_index`` on multi-slice TPU; 1 elsewhere). Requires
+    dp % num_slices == 0 — replicas never straddle a DCN boundary, so
+    the per-layer tp all-reduces and sp ppermutes stay on ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = pcfg.n_devices
+    if len(devices) < n:
+        raise ValueError(f"mesh needs {n} devices; {len(devices)} visible")
+    devices = devices[:n]
+
+    if num_slices is None:
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        num_slices = len(slice_ids)
+    if num_slices > 1:
+        if pcfg.dp % num_slices != 0:
+            raise ValueError(
+                f"dp={pcfg.dp} must be a multiple of num_slices="
+                f"{num_slices}: a replica may not straddle DCN")
+        per_slice = n // num_slices
+        by_slice = sorted(devices,
+                          key=lambda d: (getattr(d, "slice_index", 0),
+                                         d.id))
+        # [slice, within-slice] -> (dp, tp, sp) with dp split as
+        # (slice, replica-within-slice) and tp innermost (ICI neighbors).
+        arr = np.asarray(by_slice).reshape(
+            num_slices, pcfg.dp // num_slices, pcfg.sp, pcfg.tp)
+        arr = arr.reshape(pcfg.dp, pcfg.sp, pcfg.tp)
+    else:
+        arr = np.asarray(devices).reshape(pcfg.dp, pcfg.sp, pcfg.tp)
+    return Mesh(arr.transpose(0, 2, 1), AXES)
+
+
+def process_local_engine_role(mesh: Mesh) -> dict:
+    """What this host contributes to the mesh (serving-topology info for
+    logs/metrics): local device count and whether it hosts mesh row 0
+    (the row whose host typically runs the HTTP frontend)."""
+    local = set(jax.local_devices())
+    flat = list(mesh.devices.flat)
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices_in_mesh": sum(1 for d in flat if d in local),
+        "hosts_frontend": flat[0] in local,
+    }

@@ -29,6 +29,7 @@ import asyncio
 import datetime
 import itertools
 import json
+import random
 import time
 from typing import Optional
 
@@ -70,6 +71,7 @@ class InferenceServer:
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/api/generate", self.handle_generate)
+        app.router.add_post("/api/chat", self.handle_chat)
         app.router.add_get("/api/tags", self.handle_tags)
         app.router.add_get("/api/version", self.handle_version)
         app.router.add_get("/healthz", self.handle_health)
@@ -162,13 +164,54 @@ class InferenceServer:
             {"error": "action must be 'start' or 'stop'"}),
             content_type="application/json")
 
-    async def handle_generate(self, request: web.Request) -> web.StreamResponse:
-        recv_t = time.perf_counter()
+    async def _chaos_gate(self) -> None:
+        """Fault injection for harness-resilience testing (off unless
+        ServerConfig.chaos_* set; SURVEY.md §5)."""
+        scfg = self.cfg.server
+        if scfg.chaos_delay_s > 0:
+            await asyncio.sleep(random.uniform(0, scfg.chaos_delay_s))
+        if scfg.chaos_failure_rate > 0:
+            if random.random() < scfg.chaos_failure_rate:
+                raise web.HTTPServiceUnavailable(text=json.dumps(
+                    {"error": "chaos: injected failure"}),
+                    content_type="application/json")
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        """Ollama ``/api/chat``: messages-based wrapper over the same
+        engine path (the reference's notebooks drive this via ChatOllama —
+        reference notebooks/request_demo.ipynb cell 4d5cf82f). Messages
+        are flattened to a plain-text transcript prompt; responses use the
+        ``message`` record shape instead of ``response``."""
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "invalid JSON body"}), content_type="application/json")
+        msgs = body.get("messages")
+        if (not isinstance(msgs, list) or not msgs
+                or not all(isinstance(m, dict) and "content" in m
+                           for m in msgs)):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "missing 'messages'"}),
+                content_type="application/json")
+        prompt = "\n".join(f"{m.get('role', 'user')}: {m['content']}"
+                           for m in msgs) + "\nassistant:"
+        body = dict(body)
+        body["prompt"] = prompt
+        return await self._generate_impl(request, body, chat=True)
+
+    async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "invalid JSON body"}), content_type="application/json")
+        return await self._generate_impl(request, body)
+
+    async def _generate_impl(self, request: web.Request, body: dict,
+                             chat: bool = False) -> web.StreamResponse:
+        recv_t = time.perf_counter()
+        await self._chaos_gate()
         prompt = body.get("prompt")
         if not isinstance(prompt, str):
             raise web.HTTPBadRequest(text=json.dumps(
@@ -204,9 +247,9 @@ class InferenceServer:
         try:
             if stream:
                 return await self._stream_response(request, queue, seq,
-                                                   model_name, recv_t)
+                                                   model_name, recv_t, chat)
             return await self._unary_response(request, queue, seq, model_name,
-                                              recv_t)
+                                              recv_t, chat)
         except asyncio.TimeoutError:
             # Request exceeded request_timeout_s: free the slot and pages.
             self.scheduler.cancel(rid)
@@ -218,14 +261,23 @@ class InferenceServer:
 
     # ------------------------------------------------------------- helpers
 
+    @staticmethod
+    def _token_line(model_name: str, chunk: str, chat: bool) -> dict:
+        line = {"model": model_name, "created_at": _now_iso(), "done": False}
+        if chat:
+            line["message"] = {"role": "assistant", "content": chunk}
+        else:
+            line["response"] = chunk
+        return line
+
     def _final_record(self, seq: Sequence, model_name: str,
-                      recv_t: float) -> dict:
+                      recv_t: float, chat: bool = False) -> dict:
         now = time.perf_counter()
         prompt_eval_ns = max(0, int((seq.first_token_time - seq.prefill_start)
                                     * 1e9)) if seq.first_token_time else 0
         finish = seq.finish_time or now
         eval_ns = max(0, int((finish - (seq.first_token_time or finish)) * 1e9))
-        return {
+        rec = {
             "model": model_name,
             "created_at": _now_iso(),
             "response": "",
@@ -239,10 +291,16 @@ class InferenceServer:
             "eval_count": len(seq.generated),
             "eval_duration": eval_ns,
         }
+        if chat:
+            # Ollama chat records use `message` and omit `context`.
+            del rec["response"], rec["context"]
+            rec["message"] = {"role": "assistant", "content": ""}
+        return rec
 
     async def _stream_response(self, request: web.Request, queue: asyncio.Queue,
                                seq: Sequence, model_name: str,
-                               recv_t: float) -> web.StreamResponse:
+                               recv_t: float, chat: bool = False
+                               ) -> web.StreamResponse:
         resp = web.StreamResponse(status=200, headers={
             "Content-Type": "application/x-ndjson"})
         resp.enable_chunked_encoding()
@@ -258,8 +316,7 @@ class InferenceServer:
                     # First token ready -> now send headers (TTFT contract).
                     await resp.prepare(request)
                     prepared = True
-                line = {"model": model_name, "created_at": _now_iso(),
-                        "response": chunk, "done": False}
+                line = self._token_line(model_name, chunk, chat)
                 await resp.write(json.dumps(line).encode() + b"\n")
             else:
                 if not prepared:
@@ -267,17 +324,17 @@ class InferenceServer:
                     prepared = True
                 tail = decoder.flush()
                 if tail:
-                    await resp.write(json.dumps(
-                        {"model": model_name, "created_at": _now_iso(),
-                         "response": tail, "done": False}).encode() + b"\n")
-                final = self._final_record(payload, model_name, recv_t)
+                    await resp.write(json.dumps(self._token_line(
+                        model_name, tail, chat)).encode() + b"\n")
+                final = self._final_record(payload, model_name, recv_t, chat)
                 await resp.write(json.dumps(final).encode() + b"\n")
                 await resp.write_eof()
                 return resp
 
     async def _unary_response(self, request: web.Request, queue: asyncio.Queue,
                               seq: Sequence, model_name: str,
-                              recv_t: float) -> web.Response:
+                              recv_t: float, chat: bool = False
+                              ) -> web.Response:
         tokens = []
         timeout = self.cfg.server.request_timeout_s
         while True:
@@ -285,11 +342,15 @@ class InferenceServer:
             if kind == "token":
                 tokens.append(payload)
             else:
-                final = self._final_record(payload, model_name, recv_t)
+                final = self._final_record(payload, model_name, recv_t, chat)
                 # Strip EOS from the visible text.
                 vis = [t for t in tokens
                        if t != self.tokenizer.eos_token_id]
-                final["response"] = self.tokenizer.decode(vis)
+                text = self.tokenizer.decode(vis)
+                if chat:
+                    final["message"] = {"role": "assistant", "content": text}
+                else:
+                    final["response"] = text
                 return web.json_response(final)
 
 
