@@ -175,6 +175,10 @@ class EngineConfig:
     # XLA compiles a bounded number of prefill graphs.
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024)
     chunked_prefill_size: int = 0     # 0 = whole-prompt prefill
+    # Decode attention backend: "auto" picks the Pallas paged-attention
+    # kernel (kernels/paged_attention.py) on real TPU and the dense
+    # gather path elsewhere; "pallas"/"dense" force one.
+    attn_backend: str = "auto"
     # Device-side decode steps fused per host call (lax.scan): each host
     # round trip costs ~dispatch latency, so K steps per call multiply
     # steady-state decode throughput by up to K. Streamed tokens are
@@ -217,6 +221,12 @@ class ServerConfig:
     # Hold HTTP headers until the first token is ready so client-side TTFT
     # (first streamed chunk) matches header-arrival time (SURVEY.md §2c).
     defer_headers_until_first_token: bool = True
+    # Debug/observability endpoints (/debug/requests, /debug/profile) are
+    # unauthenticated introspection; off unless explicitly enabled
+    # (CLI --debug). The profiler writes only under profile_dir — the
+    # client never chooses the path.
+    enable_debug: bool = False
+    profile_dir: str = "/tmp/jax-trace"
     # Fault injection (SURVEY.md §5 failure detection: "HTTP-stub chaos
     # mode"): randomly reject this fraction of /api/generate requests with
     # 503 and/or delay them, to test client resilience. Off in production.

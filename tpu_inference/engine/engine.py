@@ -39,21 +39,40 @@ from tpu_inference.models.registry import build_model, get_model_fns
 def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
                     positions: jax.Array, valid: jax.Array,
                     q_offset: jax.Array, kv_len: jax.Array,
-                    attn_backend: str = "dense"):
+                    attn_backend: str = "dense", mesh: Optional[Any] = None):
     """AttentionFn that writes new K/V into the paged pool then attends.
 
     block_tables [B, MP]; positions/valid [B, S]; q_offset/kv_len [B].
+
+    With a mesh, the Pallas decode kernel is shard_map-wrapped over the
+    ``tp`` axis: q shards on the query-head dim and the KV pool on the
+    kv-head dim (parallel/shardings.py keeps them aligned), so each chip
+    streams only its own head shard's pages — attention output is
+    head-local and needs no collective; the following wo matmul's
+    all-reduce (placed by GSPMD) combines chips as usual.
     """
     from tpu_inference.models.common import dense_causal_attention
+
+    def _pallas_decode(q1, kv: KVPages, layer_idx):
+        from tpu_inference.kernels.paged_attention import paged_attention
+        if mesh is None:
+            return paged_attention(q1, kv.k[layer_idx], kv.v[layer_idx],
+                                   block_tables, kv_len)
+        from jax.sharding import PartitionSpec as P
+        head_p = P(None, "tp", None)                   # q/out [B, H*, D]
+        pool_p = P(None, None, "tp", None)             # [P, pg, Hkv, D]
+        return jax.shard_map(
+            lambda q_, k_, v_, bt_, kl_: paged_attention(q_, k_, v_, bt_, kl_),
+            mesh=mesh,
+            in_specs=(head_p, pool_p, pool_p, P(), P()),
+            out_specs=head_p, check_vma=False,
+        )(q1, kv.k[layer_idx], kv.v[layer_idx], block_tables, kv_len)
 
     def attn(layer_idx, q, k, v, kv: KVPages):
         slots = kvc.slot_mapping(block_tables, positions, valid, page_size)
         kv = kvc.write_kv(kv, layer_idx, k, v, slots)
         if attn_backend == "pallas" and q.shape[1] == 1:
-            from tpu_inference.kernels.paged_attention import paged_attention
-            out = paged_attention(q[:, 0], kv.k[layer_idx], kv.v[layer_idx],
-                                  block_tables, kv_len)
-            return out[:, None], kv
+            return _pallas_decode(q[:, 0], kv, layer_idx)[:, None], kv
         k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
         out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,
                                      kv_len=kv_len)
@@ -71,6 +90,8 @@ class Sequence:
     max_new_tokens: int
     temperature: float = 0.0
     top_p: float = 1.0
+    top_k: Optional[int] = None            # None = engine default
+    seed: Optional[int] = None             # None = engine-global key stream
     eos_token_id: Optional[int] = None
     # Filled by the engine:
     slot: int = -1
@@ -96,7 +117,7 @@ class InferenceEngine:
 
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
                  params: Optional[dict] = None, seed: int = 0,
-                 attn_backend: str = "dense",
+                 attn_backend: Optional[str] = None,
                  shard_fn: Optional[Callable[[dict], dict]] = None,
                  mesh: Optional[Any] = None,
                  draft_cfg: Optional[ModelConfig] = None,
@@ -105,17 +126,21 @@ class InferenceEngine:
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.mod = get_model_fns(model_cfg)
-        # Validate mesh/backend compatibility BEFORE materializing params —
+        # Resolve the decode-attention backend: constructor arg wins, then
+        # EngineConfig; "auto" = the Pallas paged kernel on real TPU, the
+        # dense gather path elsewhere (interpret-mode Pallas on CPU is far
+        # slower than XLA's fused gather+attention, so tests opt in
+        # explicitly).
+        backend = attn_backend or engine_cfg.attn_backend
+        if backend == "auto":
+            backend = ("pallas" if jax.default_backend() == "tpu"
+                       else "dense")
+        if backend not in ("dense", "pallas"):
+            raise ValueError(f"unknown attn_backend {backend!r}; "
+                             "expected 'auto', 'dense' or 'pallas'")
+        # Validate mesh compatibility BEFORE materializing params —
         # at 70B scale a post-init failure wastes minutes (or OOMs).
         if mesh is not None:
-            if attn_backend == "pallas":
-                # The Pallas paged-attention custom call has no GSPMD
-                # partitioning rule yet; under a sharded KV pool it would
-                # all-gather the whole pool per chip. Sharded decode uses
-                # the dense path until the kernel is shard_map-wrapped.
-                raise ValueError(
-                    "attn_backend='pallas' is single-device only for now; "
-                    "use the default dense path with mesh")
             from tpu_inference.parallel import shardings as _shd
             _shd.validate_tp(model_cfg, mesh.shape.get("tp", 1))
             if draft_cfg is not None:
@@ -135,7 +160,7 @@ class InferenceEngine:
             kv_sh = shd.kv_sharding(mesh)
         self.params = params
         self.n_params = int(sum(x.size for x in jax.tree.leaves(params)))
-        self.attn_backend = attn_backend
+        self.attn_backend = backend
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
         spec_on = (draft_cfg is not None
@@ -193,7 +218,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _prefill_fn(self, params, kv: KVPages, tokens, prompt_len, prefix_len,
-                    block_table, key, temperature, top_p):
+                    block_table, key, temperature, top_p, top_k, seed):
         """One sequence, tokens [1, S_bucket] right-padded.
 
         prefix_len > 0 means ``prefix_len`` tokens are already cached in this
@@ -216,8 +241,9 @@ class InferenceEngine:
             hidden, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]                                                  # [1, D]
         logits = self.mod.unembed(params, cfg, last)             # [1, V]
-        sp = SamplingParams(temperature=temperature, top_p=top_p)
-        tok = sample(logits, key, sp, top_k=self.engine_cfg.top_k)
+        sp = SamplingParams(temperature=temperature, top_p=top_p,
+                            top_k=top_k, seed=seed)
+        tok = sample(logits, key, sp, ctx=total_len)
         return kv, tok, logits
 
     def _draft_prefill_fn(self, draft_params, draft_kv: KVPages, tokens,
@@ -239,7 +265,7 @@ class InferenceEngine:
 
     def _decode_multi_fn(self, params, kv: KVPages, tokens, ctx_lens,
                          block_tables, allowed, eos_ids, key, temperature,
-                         top_p):
+                         top_p, top_k, seed):
         """K fused decode steps under one dispatch (lax.scan on device).
 
         Sampled tokens feed back into the next step without leaving HBM;
@@ -262,13 +288,18 @@ class InferenceEngine:
             attn = make_paged_attn(cfg, ecfg.page_size, block_tables,
                                    positions, act[:, None],
                                    q_offset=ctx_lens, kv_len=ctx_lens + 1,
-                                   attn_backend=self.attn_backend)
+                                   attn_backend=self.attn_backend,
+                                   mesh=self.mesh)
             hidden, kv = self.mod.forward_hidden(params, cfg, tokens[:, None],
                                                  positions, kv, attn)
             logits = self.mod.unembed(params, cfg, hidden[:, 0])
-            sp = SamplingParams(temperature=temperature, top_p=top_p)
+            sp = SamplingParams(temperature=temperature, top_p=top_p,
+                                top_k=top_k, seed=seed)
+            # The token being sampled will sit at absolute index ctx+1
+            # (the current input token occupies ctx) — the seeded-stream
+            # position that makes per-request seeds scheduling-invariant.
             toks = sample(logits, jax.random.fold_in(key, s), sp,
-                          top_k=ecfg.top_k)
+                          ctx=ctx_lens + 1)
             toks = jnp.where(act, toks, tokens)
             out = jnp.where(act, toks, -1)
             alive = alive & jnp.where(act, toks != eos_ids, True)
@@ -302,13 +333,15 @@ class InferenceEngine:
         zero = jnp.asarray([0], np.int32)
         tz = jnp.asarray([0.0], np.float32)
         tp = jnp.asarray([1.0], np.float32)
+        tk = jnp.asarray([0], np.int32)
+        sd = jnp.asarray([-1], np.int32)
         for bucket in ecfg.prefill_buckets:
             if bucket > ecfg.max_context:
                 continue
             toks = jnp.zeros((1, bucket), jnp.int32)
             self.kv, _, _ = self._prefill_jit(
                 self.params, self.kv, toks, one, zero, jnp.asarray(bt),
-                self._next_key(), tz, tp)
+                self._next_key(), tz, tp, tk, sd)
             if self.spec_enabled:
                 self.draft_kv = self._draft_prefill_jit(
                     self.draft_params, self.draft_kv, toks, one, zero,
@@ -321,7 +354,7 @@ class InferenceEngine:
                 jnp.zeros((b, self.max_pages), jnp.int32),
                 jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
                 self._next_key(), jnp.zeros((b,), jnp.float32),
-                jnp.ones((b,), jnp.float32))
+                jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32))
             self.kv, self.draft_kv = out.kv, out.draft_kv
         else:
             self.kv, _ = self._decode_multi_jit(
@@ -330,7 +363,8 @@ class InferenceEngine:
                 jnp.zeros((b, self.max_pages), jnp.int32),
                 jnp.zeros((b,), jnp.int32),
                 jnp.full((b,), -1, jnp.int32), self._next_key(),
-                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32))
+                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32),
+                jnp.zeros((b,), jnp.int32), jnp.full((b,), -1, jnp.int32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -408,6 +442,7 @@ class InferenceEngine:
         chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
         offset = seq.cached_tokens
         tok = None
+        top_k, rseed = self._sampling_arrays(seq)
         while offset < len(prompt):
             chunk = prompt[offset:offset + chunk_cap]
             bucket = ecfg.bucket_for(len(chunk))
@@ -419,7 +454,9 @@ class InferenceEngine:
                 jnp.asarray([offset], np.int32), jnp.asarray(bt),
                 self._next_key(),
                 jnp.asarray([seq.temperature], np.float32),
-                jnp.asarray([seq.top_p], np.float32))
+                jnp.asarray([seq.top_p], np.float32),
+                jnp.asarray([top_k], np.int32),
+                jnp.asarray([rseed], np.int32))
             if self.spec_enabled:
                 # Mirror the chunk into the draft model's KV (same pages).
                 self.draft_kv = self._draft_prefill_jit(
@@ -462,15 +499,32 @@ class InferenceEngine:
     def active_sequences(self) -> List[Sequence]:
         return [s for s in self.slots if s is not None and not s.done]
 
+    def _sampling_arrays(self, seq: Sequence):
+        """(top_k, seed) for one sequence, with engine defaults applied.
+
+        Negative seeds mean "no seed" (the llama.cpp/Ollama -1 convention),
+        mapping to the engine-global key stream; values are clamped into
+        int32 range for the device arrays."""
+        top_k = self.engine_cfg.top_k if seq.top_k is None else seq.top_k
+        top_k = max(0, min(int(top_k), 2**31 - 1))
+        if seq.seed is None or seq.seed < 0:
+            seed = -1
+        else:
+            seed = int(seq.seed) & 0x7FFFFFFF
+        return top_k, seed
+
     def _stage_batch(self, active_seqs: List[Sequence]):
         """Fill the per-slot host arrays shared by both decode entry points:
-        (tokens, ctx_lens, block_tables, temps, top_ps), all [B]-shaped."""
+        (tokens, ctx_lens, block_tables, temps, top_ps, top_ks, seeds),
+        all [B]-shaped."""
         b = self.engine_cfg.max_batch_size
         tokens = np.zeros((b,), np.int32)
         ctx_lens = np.zeros((b,), np.int32)
         bts = np.zeros((b, self.max_pages), np.int32)
         temps = np.zeros((b,), np.float32)
         top_ps = np.ones((b,), np.float32)
+        top_ks = np.zeros((b,), np.int32)
+        seeds = np.full((b,), -1, np.int32)
         for seq in active_seqs:
             i = seq.slot
             tokens[i] = seq.last_token
@@ -478,7 +532,8 @@ class InferenceEngine:
             bts[i] = self._block_table_array(seq.pages)
             temps[i] = seq.temperature
             top_ps[i] = seq.top_p
-        return tokens, ctx_lens, bts, temps, top_ps
+            top_ks[i], seeds[i] = self._sampling_arrays(seq)
+        return tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds
 
     def decode_step(self) -> Dict[int, int]:
         """One batched decode step (single-step view of the fused graph:
@@ -543,7 +598,8 @@ class InferenceEngine:
         if not active_seqs:
             return {}
 
-        tokens, ctx_lens, bts, temps, top_ps = self._stage_batch(active_seqs)
+        (tokens, ctx_lens, bts, temps, top_ps,
+         top_ks, seeds) = self._stage_batch(active_seqs)
         allowed = np.zeros((b,), np.int32)
         eos_ids = np.full((b,), -1, np.int32)
         for seq in active_seqs:
@@ -554,7 +610,8 @@ class InferenceEngine:
         self.kv, outs = self._decode_multi_jit(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
-            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps))
+            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.asarray(top_ks), jnp.asarray(seeds))
         outs = np.asarray(outs)                                 # [K, B]
 
         result: Dict[int, List[int]] = {}
@@ -622,18 +679,23 @@ class InferenceEngine:
             return {}
 
         b = ecfg.max_batch_size
-        tokens, ctx_lens, bts, temps, top_ps = self._stage_batch(active_seqs)
+        (tokens, ctx_lens, bts, temps, top_ps,
+         top_ks, _seeds) = self._stage_batch(active_seqs)
         cap = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         for seq in active_seqs:
             cap[seq.slot] = len(seq.pages) * ecfg.page_size
             active[seq.slot] = True
 
+        # Per-request seeds are not plumbed into spec rounds (the rejection
+        # sampler consumes randomness at a data-dependent rate, so a
+        # position-keyed stream would not reproduce anyway); spec uses the
+        # engine-global key.
         out = self._spec_jit(
             self.params, self.draft_params, self.kv, self.draft_kv,
             jnp.asarray(tokens), jnp.asarray(ctx_lens), jnp.asarray(bts),
             jnp.asarray(cap), jnp.asarray(active), self._next_key(),
-            jnp.asarray(temps), jnp.asarray(top_ps))
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks))
         self.kv, self.draft_kv = out.kv, out.draft_kv
         emitted = np.asarray(out.emitted)                   # [B, gamma+1]
         n_acc = np.asarray(out.n_accepted)
@@ -653,8 +715,13 @@ class InferenceEngine:
                     seq.first_token_time = time.perf_counter()
                 self._maybe_finish(seq, tok)
                 got.append(tok)
-            self.spec_drafted += gamma
-            self.spec_accepted += int(n_acc[seq.slot])
+            # Acceptance-rate accounting: count only draft positions the
+            # host could actually emit (emit_cap can truncate a round when
+            # budget/context run out), and clamp accepted to that window —
+            # otherwise capped rounds overcount and the rate drifts.
+            drafted = min(gamma, emit_by_slot[seq.slot])
+            self.spec_drafted += drafted
+            self.spec_accepted += min(int(n_acc[seq.slot]), drafted)
             if got:
                 result[seq.request_id] = got
         return result

@@ -52,12 +52,14 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
     @pl.when(page_start < kv_len)
     def _accumulate():
         q = q_ref[0].astype(jnp.float32)                  # [Hkv, R, D]
-        k = k_ref[0].astype(jnp.float32)                  # [pg, Hkv, D]
-        v = v_ref[0].astype(jnp.float32)                  # [pg, Hkv, D]
+        # Mosaic requires dot_general batch dims at matching positions, so
+        # bring the kv-head dim to the front before the batched contractions.
+        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
+        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
 
-        # scores[h, r, t] = <q[h, r], k[t, h]> * scale
+        # scores[h, r, t] = <q[h, r], k[h, t]> * scale
         s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (1,))),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale    # [Hkv, R, pg]
         pos = page_start + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=2)
@@ -69,9 +71,9 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         pr = jnp.exp(s - m_new[:, :, None])                # [Hkv, R, pg]
-        # o[h, r, d] = sum_t pr[h, r, t] * v[t, h, d]
+        # o[h, r, d] = sum_t pr[h, r, t] * v[h, t, d]
         o = jax.lax.dot_general(
-            pr, v, (((2,), (0,)), ((0,), (1,))),
+            pr, v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)            # [Hkv, R, D]
         m_ref[:] = m_new
         l_ref[:] = l_prev * alpha + jnp.sum(pr, axis=2)

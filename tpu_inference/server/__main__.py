@@ -31,6 +31,10 @@ def main() -> None:
                    help="max context = page-size * this")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (devices in the mesh)")
+    p.add_argument("--attn-backend", default="auto",
+                   choices=("auto", "dense", "pallas"),
+                   help="decode attention: Pallas paged kernel (TPU) or "
+                        "dense gather; auto = pallas on TPU")
     p.add_argument("--draft-model", default=None, choices=sorted(PRESETS),
                    help="enable speculative decoding with this draft preset")
     p.add_argument("--draft-checkpoint", default=None,
@@ -38,6 +42,9 @@ def main() -> None:
                         "when --checkpoint is set)")
     p.add_argument("--num-speculative-tokens", type=int, default=4)
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--debug", action="store_true",
+                   help="expose the unauthenticated /debug/* endpoints "
+                        "(request timelines, profiler control)")
     args = p.parse_args()
 
     from tpu_inference.server.http import build_server
@@ -47,6 +54,8 @@ def main() -> None:
                           warmup=not args.no_warmup, tp=args.tp,
                           draft_model=args.draft_model,
                           draft_checkpoint=args.draft_checkpoint,
+                          enable_debug=args.debug,
+                          attn_backend=args.attn_backend,
                           max_batch_size=args.max_batch_size,
                           num_pages=args.num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
