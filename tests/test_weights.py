@@ -1,0 +1,141 @@
+"""Streaming safetensors loader vs the in-memory converter oracle.
+
+``convert_state_dict`` (exercised against HF in test_model_parity.py) is
+the correctness reference; ``load_checkpoint`` must produce the identical
+pytree while reading from a sharded on-disk checkpoint — unsharded, and
+streamed directly into a TP layout via make_array_from_callback.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_inference import config as cfgs
+from tpu_inference.models import weights
+
+safetensors = pytest.importorskip("safetensors")
+from safetensors.numpy import save_file  # noqa: E402
+
+
+def _random_llama_sd(cfg, rng):
+    d, f, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    hq, hkv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    sd = {"model.embed_tokens.weight": rng.standard_normal((v, d)),
+          "model.norm.weight": rng.standard_normal((d,)),
+          "lm_head.weight": rng.standard_normal((v, d))}
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        sd.update({
+            p + "input_layernorm.weight": rng.standard_normal((d,)),
+            p + "self_attn.q_proj.weight": rng.standard_normal((hq * hd, d)),
+            p + "self_attn.k_proj.weight": rng.standard_normal((hkv * hd, d)),
+            p + "self_attn.v_proj.weight": rng.standard_normal((hkv * hd, d)),
+            p + "self_attn.o_proj.weight": rng.standard_normal((d, hq * hd)),
+            p + "post_attention_layernorm.weight": rng.standard_normal((d,)),
+            p + "mlp.gate_proj.weight": rng.standard_normal((f, d)),
+            p + "mlp.up_proj.weight": rng.standard_normal((f, d)),
+            p + "mlp.down_proj.weight": rng.standard_normal((d, f)),
+        })
+    return {k: a.astype(np.float32) for k, a in sd.items()}
+
+
+def _write_sharded(sd, path, n_shards=3):
+    """Split a state dict across n_shards files + an HF index.json."""
+    keys = sorted(sd)
+    weight_map = {}
+    for s in range(n_shards):
+        part = {k: sd[k] for k in keys[s::n_shards]}
+        fname = f"model-{s:05d}-of-{n_shards:05d}.safetensors"
+        save_file(part, os.path.join(path, fname))
+        weight_map.update({k: fname for k in part})
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+
+
+def _assert_tree_equal(got, want):
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a, np.float32), np.asarray(b, np.float32)), got, want)
+
+
+def test_load_checkpoint_matches_converter(tmp_path):
+    cfg = cfgs.tiny_llama(vocab_size=128)
+    sd = _random_llama_sd(cfg, np.random.default_rng(0))
+    _write_sharded(sd, str(tmp_path))
+
+    want = weights.convert_state_dict(cfg, sd)
+    got = weights.load_checkpoint(cfg, str(tmp_path))
+    _assert_tree_equal(got, want)
+
+
+def test_load_checkpoint_streams_into_tp_layout(tmp_path):
+    """Sharded load: every leaf lands with its TP NamedSharding and the
+    assembled global values equal the unsharded oracle."""
+    from tpu_inference.parallel import shardings as shd
+    from tpu_inference.parallel.mesh import build_mesh
+
+    cfg = cfgs.tiny_llama(vocab_size=128)
+    sd = _random_llama_sd(cfg, np.random.default_rng(1))
+    _write_sharded(sd, str(tmp_path))
+
+    mesh = build_mesh(cfgs.ParallelConfig(tp=2))
+    shardings = shd.param_shardings(cfg, mesh)
+    got = weights.load_checkpoint(cfg, str(tmp_path), shardings=shardings)
+
+    want = weights.convert_state_dict(cfg, sd)
+    _assert_tree_equal(got, want)
+    jax.tree.map(lambda a, s: (a.sharding == s or
+                               pytest.fail(f"{a.sharding} != {s}")),
+                 got, shardings)
+
+
+def test_load_checkpoint_no_index_single_file(tmp_path):
+    """Directories without index.json (single-file checkpoints) scan files."""
+    cfg = cfgs.tiny_llama(vocab_size=128)
+    sd = _random_llama_sd(cfg, np.random.default_rng(2))
+    save_file(sd, os.path.join(str(tmp_path), "model.safetensors"))
+
+    got = weights.load_checkpoint(cfg, str(tmp_path))
+    _assert_tree_equal(got, weights.convert_state_dict(cfg, sd))
+
+
+def test_load_checkpoint_gpt2_and_mixtral(tmp_path):
+    """Conv1D (no transpose) and nested expert stacking plans."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    gcfg = cfgs.tiny_gpt2(vocab_size=96)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=gcfg.vocab_size, n_positions=gcfg.max_seq_len,
+        n_embd=gcfg.d_model, n_layer=gcfg.n_layers, n_head=gcfg.n_heads,
+        n_inner=gcfg.d_ff)
+    torch.manual_seed(0)
+    sd = {k: v.numpy() for k, v in
+          transformers.GPT2LMHeadModel(hf_cfg).state_dict().items()
+          if not k.endswith(".attn.masked_bias")
+          and not k.endswith(".attn.bias") and k != "lm_head.weight"}
+    gdir = tmp_path / "gpt2"
+    gdir.mkdir()
+    _write_sharded(sd, str(gdir), n_shards=2)
+    got = weights.load_checkpoint(gcfg, str(gdir))
+    _assert_tree_equal(got, weights.convert_state_dict(gcfg, sd))
+
+    mcfg = cfgs.tiny_mixtral(vocab_size=96)
+    hf_m = transformers.MixtralConfig(
+        vocab_size=mcfg.vocab_size, hidden_size=mcfg.d_model,
+        intermediate_size=mcfg.d_ff, num_hidden_layers=mcfg.n_layers,
+        num_attention_heads=mcfg.n_heads, num_key_value_heads=mcfg.n_kv_heads,
+        num_local_experts=mcfg.n_experts,
+        num_experts_per_tok=mcfg.n_experts_per_tok, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    msd = {k: v.numpy() for k, v in
+           transformers.MixtralForCausalLM(hf_m).state_dict().items()}
+    mdir = tmp_path / "mixtral"
+    mdir.mkdir()
+    _write_sharded(msd, str(mdir), n_shards=2)
+    got = weights.load_checkpoint(mcfg, str(mdir))
+    _assert_tree_equal(got, weights.convert_state_dict(mcfg, msd))

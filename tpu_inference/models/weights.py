@@ -167,29 +167,212 @@ def load_native(path: str, template: dict) -> dict:
         return ckptr.restore(os.path.abspath(path), abstract)
 
 
+# ---------------------------------------------------------------------------
+# Streaming safetensors loader.
+#
+# The naive path (read every shard into one host dict, convert, device_put)
+# peaks at ~2x model size in host RAM and, with shardings, additionally
+# materializes every leaf unsharded on device 0 before GSPMD resharding —
+# a host-OOM at 70B. Instead each param leaf is described by a *plan*
+# (which HF tensors it stacks, whether they transpose) and assembled
+# through ``jax.make_array_from_callback``: JAX asks for exactly the
+# index slab each local device owns, and the callback reads only that
+# slab from the memory-mapped safetensors files. Host transient memory
+# = one device's shard of one leaf; nothing unsharded ever materializes.
+# ---------------------------------------------------------------------------
+
+
+class _CheckpointFiles:
+    """Key -> memory-mapped safetensors file mapping over a HF dir."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open  # deferred: optional dependency
+
+        self._safe_open = safe_open
+        self.path = path
+        self._handles: Dict[str, Any] = {}
+        self.key_to_file: Dict[str, str] = {}
+        index_path = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self.key_to_file = json.load(f)["weight_map"]
+        else:
+            for fname in sorted(os.listdir(path)):
+                if fname.endswith(".safetensors"):
+                    with safe_open(os.path.join(path, fname),
+                                   framework="np") as f:
+                        for k in f.keys():
+                            self.key_to_file[k] = fname
+
+    def keys(self):
+        return self.key_to_file.keys()
+
+    def get_slice(self, key: str):
+        fname = self.key_to_file[key]
+        h = self._handles.get(fname)
+        if h is None:
+            h = self._safe_open(os.path.join(self.path, fname),
+                                framework="np")
+            self._handles[fname] = h
+        return h.get_slice(key)
+
+
+# A leaf plan is (keys, transpose): ``keys`` is one HF tensor name, or a
+# (nested) list of names stacked along leading axes (layers, then experts);
+# ``transpose`` swaps the trailing 2 dims (HF Linear [out,in] -> [in,out]).
+_Plan = tuple
+
+
+def _plan_llama(cfg: ModelConfig, have) -> dict:
+    L = cfg.n_layers
+    p = "model.layers.{}."
+
+    def lk(s):
+        return [p.format(i) + s for i in range(L)]
+
+    plan = {
+        "embed": ("model.embed_tokens.weight", False),
+        "blocks": {
+            "attn_norm": (lk("input_layernorm.weight"), False),
+            "wq": (lk("self_attn.q_proj.weight"), True),
+            "wk": (lk("self_attn.k_proj.weight"), True),
+            "wv": (lk("self_attn.v_proj.weight"), True),
+            "wo": (lk("self_attn.o_proj.weight"), True),
+            "ffn_norm": (lk("post_attention_layernorm.weight"), False),
+            "w_gate": (lk("mlp.gate_proj.weight"), True),
+            "w_up": (lk("mlp.up_proj.weight"), True),
+            "w_down": (lk("mlp.down_proj.weight"), True),
+        },
+        "final_norm": ("model.norm.weight", False),
+    }
+    if not cfg.tie_embeddings:
+        head = ("lm_head.weight" if "lm_head.weight" in have
+                else "model.embed_tokens.weight")
+        plan["lm_head"] = (head, True)
+    return plan
+
+
+def _plan_gpt2(cfg: ModelConfig, have) -> dict:
+    L = cfg.n_layers
+    pre = "transformer." if any(k.startswith("transformer.") for k in have) \
+        else ""
+    p = pre + "h.{}."
+
+    def lk(s):
+        return [p.format(i) + s for i in range(L)]
+
+    return {
+        "embed": (pre + "wte.weight", False),
+        "pos_embed": (pre + "wpe.weight", False),
+        "blocks": {
+            "ln1_w": (lk("ln_1.weight"), False),
+            "ln1_b": (lk("ln_1.bias"), False),
+            "w_qkv": (lk("attn.c_attn.weight"), False),  # Conv1D: [in,out]
+            "b_qkv": (lk("attn.c_attn.bias"), False),
+            "w_proj": (lk("attn.c_proj.weight"), False),
+            "b_proj": (lk("attn.c_proj.bias"), False),
+            "ln2_w": (lk("ln_2.weight"), False),
+            "ln2_b": (lk("ln_2.bias"), False),
+            "w_fc": (lk("mlp.c_fc.weight"), False),
+            "b_fc": (lk("mlp.c_fc.bias"), False),
+            "w_out": (lk("mlp.c_proj.weight"), False),
+            "b_out": (lk("mlp.c_proj.bias"), False),
+        },
+        "ln_f_w": (pre + "ln_f.weight", False),
+        "ln_f_b": (pre + "ln_f.bias", False),
+    }
+
+
+def _plan_mixtral(cfg: ModelConfig, have) -> dict:
+    L, E = cfg.n_layers, cfg.n_experts
+    p = "model.layers.{}."
+
+    def lk(s):
+        return [p.format(i) + s for i in range(L)]
+
+    def ek(w):
+        # HF Mixtral: w1 = gate, w2 = down, w3 = up.
+        return [[f"model.layers.{i}.block_sparse_moe.experts.{e}.{w}.weight"
+                 for e in range(E)] for i in range(L)]
+
+    return {
+        "embed": ("model.embed_tokens.weight", False),
+        "blocks": {
+            "attn_norm": (lk("input_layernorm.weight"), False),
+            "wq": (lk("self_attn.q_proj.weight"), True),
+            "wk": (lk("self_attn.k_proj.weight"), True),
+            "wv": (lk("self_attn.v_proj.weight"), True),
+            "wo": (lk("self_attn.o_proj.weight"), True),
+            "ffn_norm": (lk("post_attention_layernorm.weight"), False),
+            "w_router": (lk("block_sparse_moe.gate.weight"), True),
+            "w_gate": (ek("w1"), True),
+            "w_up": (ek("w3"), True),
+            "w_down": (ek("w2"), True),
+        },
+        "final_norm": ("model.norm.weight", False),
+        "lm_head": ("lm_head.weight", True),
+    }
+
+
+_PLANNERS = {"llama": _plan_llama, "gpt2": _plan_gpt2,
+             "mixtral": _plan_mixtral}
+
+
+def _base_shape(files: _CheckpointFiles, keys, transpose: bool) -> tuple:
+    """Global shape of a leaf: stacked leading axes + (transposed) base."""
+    stack = []
+    while isinstance(keys, list):
+        stack.append(len(keys))
+        keys = keys[0]
+    base = tuple(files.get_slice(keys).get_shape())
+    if transpose:
+        base = base[:-2] + (base[-1], base[-2])
+    return tuple(stack) + base
+
+
+def _read_slab(files: _CheckpointFiles, keys, transpose: bool,
+               index: tuple) -> np.ndarray:
+    """Read the sub-array ``leaf[index]`` touching only the needed bytes."""
+    if isinstance(keys, list):
+        rng = range(len(keys))[index[0]]
+        parts = [_read_slab(files, keys[i], transpose, index[1:])
+                 for i in rng]
+        return np.stack(parts)
+    sl = files.get_slice(keys)
+    if transpose:
+        index = index[:-2] + (index[-1], index[-2])
+        return np.asarray(sl[index]).swapaxes(-1, -2)
+    return np.asarray(sl[index])
+
+
 def load_checkpoint(cfg: ModelConfig, path: str,
                     shardings: Optional[dict] = None) -> dict:
     """Load a HF safetensors directory into a (optionally sharded) pytree.
 
     ``shardings``: pytree matching the params structure with
-    ``jax.sharding.Sharding`` leaves; arrays are device_put per-leaf so large
-    checkpoints stream to their final layout shard by shard.
+    ``jax.sharding.Sharding`` leaves. Each leaf streams straight from the
+    memory-mapped files into its device layout: with shardings, every chip
+    reads only its own slab and no unsharded copy ever exists on host or
+    device (the ADVICE r1 70B-host-OOM fix).
     """
-    from safetensors import safe_open  # deferred: optional dependency
+    files = _CheckpointFiles(path)
+    plan = _PLANNERS[cfg.family](cfg, set(files.keys()))
+    dtype = cfg.dtype
 
-    index_path = os.path.join(path, "model.safetensors.index.json")
-    sd: Dict[str, np.ndarray] = {}
-    if os.path.exists(index_path):
-        with open(index_path) as f:
-            weight_map = json.load(f)["weight_map"]
-        shards = sorted(set(weight_map.values()))
-    else:
-        shards = [f for f in os.listdir(path) if f.endswith(".safetensors")]
-    for shard in shards:
-        with safe_open(os.path.join(path, shard), framework="np") as f:
-            for key in f.keys():
-                sd[key] = f.get_tensor(key)
-    params = convert_state_dict(cfg, sd)
-    if shardings is not None:
-        params = jax.tree.map(jax.device_put, params, shardings)
-    return params
+    def build(leaf_plan: _Plan, sharding=None):
+        keys, transpose = leaf_plan
+        shape = _base_shape(files, keys, transpose)
+        full = tuple(slice(0, s) for s in shape)
+
+        def read(index=full):
+            index = tuple(slice(*i.indices(s)) for i, s in zip(index, shape))
+            return _read_slab(files, keys, transpose, index).astype(dtype)
+
+        if sharding is None:
+            return jnp.asarray(read())
+        return jax.make_array_from_callback(shape, sharding, read)
+
+    is_plan_leaf = lambda x: isinstance(x, tuple)  # noqa: E731
+    if shardings is None:
+        return jax.tree.map(build, plan, is_leaf=is_plan_leaf)
+    return jax.tree.map(build, plan, shardings, is_leaf=is_plan_leaf)
