@@ -102,6 +102,12 @@ def test_pages_needed():
     assert kvc.pages_needed(0, 8) == 0
 
 
+def _sp(b, **kw):
+    base = SamplingParams.greedy(b)._asdict()
+    base.update({k: jnp.asarray(v) for k, v in kw.items()})
+    return SamplingParams(**base)
+
+
 def test_sampling_modes():
     key = jax.random.PRNGKey(0)
     logits = jnp.asarray(np.array([[0.0, 5.0, 1.0, -2.0],
@@ -111,22 +117,46 @@ def test_sampling_modes():
     toks = sample(logits, key, sp)
     assert toks.tolist() == [1, 0]
     # Temperature sampling with top_k=1 degenerates to greedy.
-    sp = SamplingParams(temperature=jnp.ones((2,)), top_p=jnp.ones((2,)))
-    toks = sample(logits, key, sp, top_k=1)
+    sp = _sp(2, temperature=jnp.ones((2,)), top_k=jnp.ones((2,), jnp.int32))
+    toks = sample(logits, key, sp)
     assert toks.tolist() == [1, 0]
+    # Per-row top_k: row 0 restricted to its argmax, row 1 unrestricted
+    # at huge temperature still yields a valid token.
+    sp = _sp(2, temperature=jnp.full((2,), 100.0),
+             top_k=jnp.asarray([1, 0], jnp.int32))
+    assert sample(logits, key, sp).tolist()[0] == 1
     # top_p tiny keeps only the argmax.
-    sp = SamplingParams(temperature=jnp.ones((2,)),
-                        top_p=jnp.full((2,), 1e-6))
+    sp = _sp(2, temperature=jnp.ones((2,)), top_p=jnp.full((2,), 1e-6))
     toks = sample(logits, key, sp)
     assert toks.tolist() == [1, 0]
     # High temperature covers the support (statistical sanity).
-    sp = SamplingParams(temperature=jnp.full((16,), 100.0),
-                        top_p=jnp.ones((16,)))
+    sp = _sp(16, temperature=jnp.full((16,), 100.0))
     wide = jnp.zeros((16, 4))
     seen = set()
     for i in range(20):
         seen.update(sample(wide, jax.random.PRNGKey(i), sp).tolist())
     assert seen == {0, 1, 2, 3}
+
+
+def test_sampling_seeded_reproducible():
+    """seed >= 0 rows depend only on (seed, ctx) — not the engine key or
+    batch position; seed < 0 rows follow the engine key."""
+    wide = jnp.zeros((2, 64))
+    ctx = jnp.asarray([7, 7], jnp.int32)
+    sp = _sp(2, temperature=jnp.ones((2,)),
+             seed=jnp.asarray([42, -1], jnp.int32))
+    a = sample(wide, jax.random.PRNGKey(0), sp, ctx=ctx)
+    b = sample(wide, jax.random.PRNGKey(999), sp, ctx=ctx)
+    assert a[0] == b[0]                     # seeded row: key-independent
+    # Same seed in a different slot gives the same token at the same ctx.
+    sp_swapped = _sp(2, temperature=jnp.ones((2,)),
+                     seed=jnp.asarray([-1, 42], jnp.int32))
+    c = sample(wide, jax.random.PRNGKey(0), sp_swapped, ctx=ctx)
+    assert c[1] == a[0]
+    # Unseeded rows vary with the engine key (statistically).
+    outs = {int(sample(wide, jax.random.PRNGKey(i), sp, ctx=ctx)[1])
+            for i in range(10)}
+    assert len(outs) > 1
 
 
 def test_chunked_prefill_long_prompt(setup):

@@ -18,12 +18,18 @@ FINAL_FIELDS = {"model", "created_at", "response", "done", "done_reason",
 
 
 @pytest.fixture(scope="module")
-def server():
+def profile_dir(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("jax-trace"))
+
+
+@pytest.fixture(scope="module")
+def server(profile_dir):
     cfg = FrameworkConfig(
         model=tiny_llama(vocab_size=512),
         engine=EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=8,
                             max_batch_size=4, prefill_buckets=(16, 32, 64)),
-        server=ServerConfig(model_name="tiny-llama", tokenizer="byte"))
+        server=ServerConfig(model_name="tiny-llama", tokenizer="byte",
+                            enable_debug=True, profile_dir=profile_dir))
     return InferenceServer(cfg)
 
 
@@ -103,13 +109,127 @@ def test_greedy_is_deterministic(server):
     _run(server, go)
 
 
+def test_options_seed_reproducible(server):
+    """options.seed makes temperature sampling reproducible across
+    requests (and across different engine key states)."""
+    async def go(client):
+        outs = []
+        for _ in range(2):
+            resp = await client.post("/api/generate", json={
+                "prompt": "seeded run", "stream": False, "max_tokens": 8,
+                "options": {"temperature": 1.0, "seed": 1234}})
+            outs.append((await resp.json())["context"])
+        assert outs[0] == outs[1]
+        # Different seed should (overwhelmingly) differ.
+        resp = await client.post("/api/generate", json={
+            "prompt": "seeded run", "stream": False, "max_tokens": 8,
+            "options": {"temperature": 1.0, "seed": 99}})
+        other = (await resp.json())["context"]
+        assert other != outs[0]
+
+    _run(server, go)
+
+
+def test_options_top_k_one_is_greedy(server):
+    """top_k=1 at high temperature degenerates to the greedy tokens."""
+    async def go(client):
+        greedy = await (await client.post("/api/generate", json={
+            "prompt": "topk probe", "stream": False, "max_tokens": 6,
+            "temperature": 0.0})).json()
+        topk1 = await (await client.post("/api/generate", json={
+            "prompt": "topk probe", "stream": False, "max_tokens": 6,
+            "options": {"temperature": 5.0, "top_k": 1}})).json()
+        assert topk1["context"] == greedy["context"]
+
+    _run(server, go)
+
+
+def test_stop_sequences(server):
+    """options.stop truncates the response before the stop string, ends
+    the request with done_reason=stop, in both unary and streaming."""
+    async def go(client):
+        # Discover the greedy continuation, then stop on a substring of it.
+        base = await (await client.post("/api/generate", json={
+            "prompt": "stop probe", "stream": False, "max_tokens": 12,
+            "temperature": 0.0})).json()
+        text = base["response"]
+        assert len(text) >= 3
+        stop_s = text[2:4]
+
+        unary = await (await client.post("/api/generate", json={
+            "prompt": "stop probe", "stream": False, "max_tokens": 12,
+            "temperature": 0.0, "options": {"stop": [stop_s]}})).json()
+        assert unary["done_reason"] == "stop"
+        assert unary["response"] == text[:text.find(stop_s)]
+        assert stop_s not in unary["response"]
+
+        resp = await client.post("/api/generate", json={
+            "prompt": "stop probe", "stream": True, "max_tokens": 12,
+            "temperature": 0.0, "options": {"stop": stop_s}})
+        lines = [json.loads(l) for l in (await resp.read()).splitlines()]
+        assert lines[-1]["done"] and lines[-1]["done_reason"] == "stop"
+        streamed = "".join(l.get("response", "") for l in lines[:-1])
+        assert streamed == text[:text.find(stop_s)]
+
+    _run(server, go)
+
+
+def test_stop_matcher_unit():
+    from tpu_inference.server.tokenizer import StopMatcher
+
+    m = StopMatcher(["END"])
+    assert m.push("hello ") == ("hello ", False)
+    assert m.push("E") == ("", False)           # possible prefix: hold
+    assert m.push("X") == ("EX", False)         # disambiguated: release
+    out, stopped = m.push("abcENDxyz")
+    assert (out, stopped) == ("abc", True)
+
+    m = StopMatcher(["END"])                     # split across pushes
+    assert m.push("aE") == ("a", False)
+    assert m.push("N") == ("", False)
+    assert m.push("D tail") == ("", True)
+
+    m = StopMatcher([])
+    assert m.push("anything") == ("anything", False)
+
+
 def test_bad_requests(server):
     async def go(client):
         r1 = await client.post("/api/generate", data=b"{not json")
         assert r1.status == 400
         r2 = await client.post("/api/generate", json={"model": "x"})
         assert r2.status == 400
+        # Malformed sampling options -> structured 400, not a 500.
+        r3 = await client.post("/api/generate", json={
+            "prompt": "x", "options": {"stop": 5}})
+        assert r3.status == 400
+        r4 = await client.post("/api/generate", json={
+            "prompt": "x", "options": {"top_k": "lots"}})
+        assert r4.status == 400
+        r5 = await client.post("/api/generate", json={
+            "prompt": "x", "options": "fast"})
+        assert r5.status == 400
         return r1, r2
+
+    _run(server, go)
+
+
+def test_seed_edge_values(server):
+    """64-bit seeds are accepted (clamped into int32 on device) and
+    seed=-1 means unseeded (requests differ across retries)."""
+    async def go(client):
+        big = {"prompt": "edge", "stream": False, "max_tokens": 6,
+               "options": {"temperature": 1.0, "seed": 2**40 + 123}}
+        a = await (await client.post("/api/generate", json=big)).json()
+        b = await (await client.post("/api/generate", json=big)).json()
+        assert a["done"] and a["context"] == b["context"]
+        outs = set()
+        for _ in range(4):
+            r = await (await client.post("/api/generate", json={
+                "prompt": "edge", "stream": False, "max_tokens": 6,
+                "options": {"temperature": 5.0, "seed": -1}})).json()
+            outs.add(tuple(r["context"]))
+        assert len(outs) > 1
 
     _run(server, go)
 
@@ -144,7 +264,7 @@ def test_concurrent_requests_interleave(server):
     _run(server, go)
 
 
-def test_debug_requests_and_profile(server):
+def test_debug_requests_and_profile(server, profile_dir):
     """Observability endpoints: request timelines + profiler control."""
 
     async def scenario(client):
@@ -168,19 +288,38 @@ def test_debug_requests_and_profile(server):
         assert stats["approx_flops_per_token"] == 2 * stats["model_params"]
 
         import os
-        import tempfile
-        with tempfile.TemporaryDirectory() as d:
-            resp = await client.post("/debug/profile",
-                                     json={"action": "start", "dir": d})
-            assert resp.status == 200
-            resp = await client.post("/debug/profile",
-                                     json={"action": "stop"})
-            assert resp.status == 200
-            assert any(os.scandir(d))       # trace artifacts written
+        # Client-supplied "dir" is ignored: traces land only in the
+        # server-configured profile_dir (unauthenticated endpoint must
+        # not take filesystem paths from the wire).
+        resp = await client.post("/debug/profile",
+                                 json={"action": "start", "dir": "/etc"})
+        assert resp.status == 200
+        assert (await resp.json())["dir"] == profile_dir
+        resp = await client.post("/debug/profile", json={"action": "stop"})
+        assert resp.status == 200
+        assert any(os.scandir(profile_dir))     # trace artifacts written
         resp = await client.post("/debug/profile", json={"action": "bogus"})
         assert resp.status == 400
 
     _run(server, scenario)
+
+
+def test_debug_disabled_by_default():
+    """Without enable_debug the /debug routes are not registered."""
+    cfg = FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
+                            max_batch_size=2, prefill_buckets=(16,)),
+        server=ServerConfig(model_name="t", tokenizer="byte"))
+    srv = InferenceServer(cfg)
+
+    async def scenario(client):
+        assert (await client.get("/debug/requests")).status == 404
+        assert (await client.post("/debug/profile",
+                                  json={"action": "start"})).status == 404
+        assert (await client.get("/healthz")).status == 200
+
+    _run(srv, scenario)
 
 
 def test_chat_endpoint(server):

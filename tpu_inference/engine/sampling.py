@@ -1,15 +1,22 @@
 """Token sampling, jit-safe and batched.
 
 All control flow is data-parallel (`jnp.where` over the batch), so one
-compiled graph serves any mix of greedy / temperature / top-k / top-p
-requests in the same decode batch — no per-request recompiles (XLA static
-shapes, SURVEY.md §7 hard part 2). top_k is a static graph parameter
-(lax.top_k needs a static k); the server buckets requests by it.
+compiled graph serves any mix of greedy / temperature / top-k / top-p /
+seeded requests in the same decode batch — no per-request recompiles (XLA
+static shapes, SURVEY.md §7 hard part 2). top_k is a per-row *dynamic*
+value: instead of `lax.top_k` (which needs a static k), the row is sorted
+once and thresholded at its k-th largest logit, which also serves the
+top-p filter — one sort, both filters, any per-request mix.
+
+Per-request determinism: a row with ``seed >= 0`` draws from a key stream
+derived only from (seed, absolute token position), so regeneration with
+the same seed reproduces the same tokens regardless of batch placement
+or scheduling; rows with ``seed < 0`` use the engine-global key stream.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -20,49 +27,76 @@ class SamplingParams(NamedTuple):
 
     temperature: jax.Array   # f32; <= 0 means greedy
     top_p: jax.Array         # f32 in (0, 1]; 1 disables
+    top_k: jax.Array         # int32; <= 0 disables
+    seed: jax.Array          # int32; < 0 = engine-global key stream
 
     @staticmethod
     def greedy(batch: int) -> "SamplingParams":
         return SamplingParams(temperature=jnp.zeros((batch,), jnp.float32),
-                              top_p=jnp.ones((batch,), jnp.float32))
+                              top_p=jnp.ones((batch,), jnp.float32),
+                              top_k=jnp.zeros((batch,), jnp.int32),
+                              seed=jnp.full((batch,), -1, jnp.int32))
 
 
-def _apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
-    """Keep the top_k logits per row, -inf the rest. Static k."""
-    if top_k <= 0:
-        return logits
-    kth = jax.lax.top_k(logits, top_k)[0][..., -1:]          # [B, 1]
-    return jnp.where(logits < kth, -jnp.inf, logits)
+def apply_filters(logits: jax.Array, top_k, top_p: jax.Array) -> jax.Array:
+    """Sequential top-k then top-p (nucleus) filtering, ONE [B, V] sort.
 
-
-def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
-    """Nucleus filtering. top_p: [B]. Keeps the smallest prefix of the
-    probability-sorted vocab whose mass reaches top_p (always >= 1 token)."""
-    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]      # desc
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    # Token i is kept if the cumulative mass *before* it is < top_p.
-    keep_sorted = (cum - sorted_probs) < top_p[:, None]
+    ``top_k``: static int or [B] int32; <= 0 disables that row's k filter.
+    ``top_p``: [B] f32; mass is measured over the top-k *survivors*
+    (renormalized), matching the sequential HF processor semantics.
+    Always keeps >= 1 token per row.
+    """
+    b, v = logits.shape
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    rank = jnp.arange(v)[None, :]
+    keep_k = (k[:, None] <= 0) | (rank < k[:, None])
+    sorted_f = jnp.where(keep_k, sorted_desc, -jnp.inf)
+    probs = jax.nn.softmax(sorted_f, axis=-1)     # renormalized post-top-k
+    cum = jnp.cumsum(probs, axis=-1)
+    # Sorted token i is kept if the cumulative mass *before* it is < top_p.
+    keep = keep_k & ((cum - probs) < top_p[:, None])
     # Per-row logit threshold = smallest kept logit.
-    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+    thresh = jnp.min(jnp.where(keep, sorted_f, jnp.inf),
                      axis=-1, keepdims=True)
     return jnp.where(logits < thresh, -jnp.inf, logits)
 
 
+def _row_keys(key: jax.Array, seed: jax.Array, ctx: jax.Array) -> jax.Array:
+    """One PRNG key per batch row.
+
+    seed >= 0: key = fold(fold(PRNGKey(0), seed), ctx) — a function of the
+    request seed and the absolute position only (reproducible across
+    batches/restarts). seed < 0: fold the engine-global step key by row.
+    """
+    b = seed.shape[0]
+    glob = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(b, dtype=jnp.int32))
+    base = jax.random.PRNGKey(0)
+    seeded = jax.vmap(lambda s, c: jax.random.fold_in(
+        jax.random.fold_in(base, jnp.maximum(s, 0)), c))(seed, ctx)
+    return jnp.where((seed >= 0)[:, None], seeded, glob)
+
+
 def sample(logits: jax.Array, key: jax.Array, params: SamplingParams,
-           top_k: int = 0) -> jax.Array:
+           ctx: Optional[jax.Array] = None) -> jax.Array:
     """logits: [B, V] f32 -> token ids [B] int32.
 
     Greedy rows (temperature <= 0) and sampled rows coexist in one batch.
+    ``ctx``: [B] int32 absolute position of the token being sampled
+    (keys per-request seeded streams; defaults to 0s).
     """
     b = logits.shape[0]
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temp = jnp.maximum(params.temperature, 1e-6)[:, None]
-    scaled = logits / temp
-    scaled = _apply_top_k(scaled, top_k)
-    scaled = _apply_top_p(scaled, params.top_p)
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    scaled = apply_filters(logits / temp, params.top_k, params.top_p)
+    if ctx is None:
+        ctx = jnp.zeros((b,), jnp.int32)
+    keys = _row_keys(key, params.seed, ctx)
+    sampled = jax.vmap(
+        lambda k_, l: jax.random.categorical(k_, l))(keys, scaled)
+    sampled = sampled.astype(jnp.int32)
 
     return jnp.where(params.temperature <= 0.0, greedy_tok, sampled)
 

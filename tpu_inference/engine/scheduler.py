@@ -197,9 +197,18 @@ class EngineScheduler:
             pending = self._callbacks.pop(seq.request_id, None)
         self.engine.release(seq)
         self.stats.requests_finished += 1
-        self.recent.append(self._timeline(seq))
+        with self._lock:
+            self.recent.append(self._timeline(seq))
         if pending is not None:
             pending.on_finish(seq)
+
+    def recent_snapshot(self, n: int) -> List[dict]:
+        """Thread-safe copy of the last ``n`` request timelines (the deque
+        is appended from the engine thread; iterating it unlocked from an
+        HTTP handler would race a concurrent append)."""
+        with self._lock:
+            items = list(self.recent)
+        return items[-n:]
 
     @staticmethod
     def _timeline(seq: Sequence) -> dict:

@@ -46,18 +46,18 @@ class SpecRoundOut(NamedTuple):
 
 
 def _probs(logits: jax.Array, temperature: jax.Array, top_p: jax.Array,
-           top_k: int) -> jax.Array:
+           top_k: jax.Array) -> jax.Array:
     """The engine's actual sampling distribution per row (temperature +
     top-k + top-p filtered, renormalized); temperature<=0 = one-hot
     argmax. Using the *filtered* distributions for both p and q keeps
     rejection sampling exact w.r.t. what the non-spec path samples.
-    logits [B, V] f32; temperature/top_p [B]."""
-    from tpu_inference.engine.sampling import _apply_top_k, _apply_top_p
+    logits [B, V] f32; temperature/top_p [B]; top_k [B] int32."""
+    from tpu_inference.engine.sampling import apply_filters
 
     greedy = jax.nn.one_hot(jnp.argmax(logits, -1), logits.shape[-1],
                             dtype=jnp.float32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = _apply_top_p(_apply_top_k(logits / temp, top_k), top_p)
+    scaled = apply_filters(logits / temp, top_k, top_p)
     soft = jax.nn.softmax(scaled, axis=-1)
     return jnp.where((temperature <= 0.0)[:, None], greedy, soft)
 
@@ -69,7 +69,7 @@ def _sample_from(probs: jax.Array, key: jax.Array) -> jax.Array:
 
 
 def spec_round(engine, params, draft_params, kv, draft_kv, tokens, ctx_lens,
-               block_tables, cap, active, key, temperature, top_p):
+               block_tables, cap, active, key, temperature, top_p, top_k):
     """One propose/verify/accept round. Pure function of arrays; jitted by
     the engine with both KV pools donated.
 
@@ -97,7 +97,7 @@ def spec_round(engine, params, draft_params, kv, draft_kv, tokens, ctx_lens,
             attn)
         logits = engine.draft_mod.unembed(draft_params, engine.draft_cfg,
                                           hidden[:, 0])
-        p_row = _probs(logits, temperature, top_p, ecfg.top_k)  # [B, V]
+        p_row = _probs(logits, temperature, top_p, top_k)       # [B, V]
         d = _sample_from(p_row, jax.random.fold_in(key, s))
         return (dkv, d, ctx + 1), (d, p_row)
 
@@ -125,7 +125,7 @@ def spec_round(engine, params, draft_params, kv, draft_kv, tokens, ctx_lens,
                                            tokens_in, positions, kv, attn)
     logits_all = engine.mod.unembed(params, engine.model_cfg, hidden)
     q_rows = jax.vmap(_probs, in_axes=(1, None, None, None), out_axes=1)(
-        logits_all, temperature, top_p, ecfg.top_k)           # [B, g+1, V]
+        logits_all, temperature, top_p, top_k)                # [B, g+1, V]
 
     # ---------------------------------------------------------- accept
     d_idx = drafts[..., None]                                 # [B, g, 1]

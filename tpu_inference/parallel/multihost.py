@@ -46,7 +46,7 @@ def initialize(coordinator_address: Optional[str] = None,
     server automatically; pass them explicitly for CPU/GPU multi-process
     or tests. Safe to call more than once.
     """
-    if jax._src.distributed.global_state.client is not None:  # initialized
+    if jax.distributed.is_initialized():
         return
     if (coordinator_address is None
             and os.environ.get("JAX_COORDINATOR_ADDRESS") is None

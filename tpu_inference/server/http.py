@@ -38,7 +38,8 @@ from aiohttp import web
 from tpu_inference.config import FrameworkConfig, PRESETS
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.scheduler import EngineScheduler
-from tpu_inference.server.tokenizer import IncrementalDecoder, build_tokenizer
+from tpu_inference.server.tokenizer import (IncrementalDecoder, StopMatcher,
+                                            build_tokenizer)
 
 
 def _now_iso() -> str:
@@ -76,8 +77,9 @@ class InferenceServer:
         app.router.add_get("/api/version", self.handle_version)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
-        app.router.add_get("/debug/requests", self.handle_debug_requests)
-        app.router.add_post("/debug/profile", self.handle_profile)
+        if self.cfg.server.enable_debug:
+            app.router.add_get("/debug/requests", self.handle_debug_requests)
+            app.router.add_post("/debug/profile", self.handle_profile)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -124,14 +126,15 @@ class InferenceServer:
                 content_type="application/json")
         if n <= 0:
             return web.json_response([])
-        return web.json_response(list(self.scheduler.recent)[-n:])
+        return web.json_response(self.scheduler.recent_snapshot(n))
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """Start/stop a jax.profiler trace (TensorBoard / Perfetto).
 
-        POST {"action": "start", "dir": "/tmp/jax-trace"} then
-        POST {"action": "stop"} after driving load; inspect with
-        tensorboard --logdir or ui.perfetto.dev.
+        POST {"action": "start"} then {"action": "stop"} after driving
+        load; inspect with tensorboard --logdir or ui.perfetto.dev.
+        Traces always land in ServerConfig.profile_dir — the client
+        cannot choose a filesystem path.
         """
         import jax
 
@@ -144,7 +147,7 @@ class InferenceServer:
                 content_type="application/json")
         action = body.get("action")
         if action == "start":
-            trace_dir = body.get("dir", "/tmp/jax-trace")
+            trace_dir = self.cfg.server.profile_dir
             try:
                 jax.profiler.start_trace(trace_dir)
             except RuntimeError as e:     # already started
@@ -218,13 +221,35 @@ class InferenceServer:
                 {"error": "missing 'prompt'"}), content_type="application/json")
 
         opts = body.get("options") or {}
+        if not isinstance(opts, dict):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "'options' must be an object"}),
+                content_type="application/json")
         ecfg = self.cfg.engine
-        temperature = float(opts.get("temperature",
-                                     body.get("temperature", ecfg.temperature)))
-        max_tokens = int(opts.get("num_predict",
-                                  body.get("max_tokens", ecfg.max_new_tokens)))
-        max_tokens = max(1, min(max_tokens, ecfg.max_context - 1))
-        top_p = float(opts.get("top_p", body.get("top_p", ecfg.top_p)))
+        try:
+            temperature = float(opts.get(
+                "temperature", body.get("temperature", ecfg.temperature)))
+            max_tokens = int(opts.get(
+                "num_predict", body.get("max_tokens", ecfg.max_new_tokens)))
+            max_tokens = max(1, min(max_tokens, ecfg.max_context - 1))
+            top_p = float(opts.get("top_p", body.get("top_p", ecfg.top_p)))
+            top_k = opts.get("top_k", body.get("top_k"))
+            top_k = int(top_k) if top_k is not None else None
+            seed = opts.get("seed", body.get("seed"))
+            seed = int(seed) if seed is not None else None
+            stop = opts.get("stop", body.get("stop"))
+            if stop is None:
+                stop = []
+            elif isinstance(stop, str):
+                stop = [stop]
+            elif not (isinstance(stop, list)
+                      and all(isinstance(s, str) for s in stop)):
+                raise ValueError("'stop' must be a string or list of strings")
+            stop = [s for s in stop if s]
+        except (TypeError, ValueError) as e:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": f"invalid sampling options: {e}"}),
+                content_type="application/json")
         stream = bool(body.get("stream", True))
         model_name = body.get("model") or self.cfg.server.model_name
 
@@ -232,7 +257,8 @@ class InferenceServer:
         rid = next(self._ids)
         seq = Sequence(request_id=rid, prompt_tokens=prompt_ids,
                        max_new_tokens=max_tokens, temperature=temperature,
-                       top_p=top_p, eos_token_id=self.tokenizer.eos_token_id)
+                       top_p=top_p, top_k=top_k, seed=seed,
+                       eos_token_id=self.tokenizer.eos_token_id)
 
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
@@ -247,9 +273,10 @@ class InferenceServer:
         try:
             if stream:
                 return await self._stream_response(request, queue, seq,
-                                                   model_name, recv_t, chat)
+                                                   model_name, recv_t, chat,
+                                                   stop)
             return await self._unary_response(request, queue, seq, model_name,
-                                              recv_t, chat)
+                                              recv_t, chat, stop)
         except asyncio.TimeoutError:
             # Request exceeded request_timeout_s: free the slot and pages.
             self.scheduler.cancel(rid)
@@ -299,65 +326,99 @@ class InferenceServer:
 
     async def _stream_response(self, request: web.Request, queue: asyncio.Queue,
                                seq: Sequence, model_name: str,
-                               recv_t: float, chat: bool = False
+                               recv_t: float, chat: bool = False,
+                               stop: Optional[list] = None
                                ) -> web.StreamResponse:
         resp = web.StreamResponse(status=200, headers={
             "Content-Type": "application/x-ndjson"})
         resp.enable_chunked_encoding()
         decoder = IncrementalDecoder(self.tokenizer)
+        matcher = StopMatcher(stop or [])
         prepared = False
         timeout = self.cfg.server.request_timeout_s
+
+        async def write_line(text: str) -> None:
+            await resp.write(json.dumps(self._token_line(
+                model_name, text, chat)).encode() + b"\n")
+
+        async def finish(stopped: bool) -> web.StreamResponse:
+            final = self._final_record(seq, model_name, recv_t, chat)
+            if stopped:
+                final["done_reason"] = "stop"
+            await resp.write(json.dumps(final).encode() + b"\n")
+            await resp.write_eof()
+            return resp
 
         while True:
             kind, payload = await asyncio.wait_for(queue.get(), timeout)
             if kind == "token":
-                chunk = decoder.push(payload)
+                emit, stopped = matcher.push(decoder.push(payload))
                 if not prepared:
                     # First token ready -> now send headers (TTFT contract).
                     await resp.prepare(request)
                     prepared = True
-                line = self._token_line(model_name, chunk, chat)
-                await resp.write(json.dumps(line).encode() + b"\n")
+                if stopped:
+                    # A stop sequence completed: cut the stream here and
+                    # cancel the rest of the generation (never emit the
+                    # stop string itself).
+                    if emit:
+                        await write_line(emit)
+                    self.scheduler.cancel(seq.request_id)
+                    return await finish(stopped=True)
+                await write_line(emit)
             else:
                 if not prepared:
                     await resp.prepare(request)
                     prepared = True
-                tail = decoder.flush()
+                tail, stopped = matcher.push(decoder.flush())
+                if not stopped:
+                    tail += matcher.flush()
                 if tail:
-                    await resp.write(json.dumps(self._token_line(
-                        model_name, tail, chat)).encode() + b"\n")
-                final = self._final_record(payload, model_name, recv_t, chat)
-                await resp.write(json.dumps(final).encode() + b"\n")
-                await resp.write_eof()
-                return resp
+                    await write_line(tail)
+                return await finish(stopped)
 
     async def _unary_response(self, request: web.Request, queue: asyncio.Queue,
                               seq: Sequence, model_name: str,
-                              recv_t: float, chat: bool = False
+                              recv_t: float, chat: bool = False,
+                              stop: Optional[list] = None
                               ) -> web.Response:
-        tokens = []
+        decoder = IncrementalDecoder(self.tokenizer)
+        matcher = StopMatcher(stop or [])
+        parts: list = []
         timeout = self.cfg.server.request_timeout_s
+
+        def respond(payload, stopped: bool) -> web.Response:
+            final = self._final_record(payload, model_name, recv_t, chat)
+            if stopped:
+                final["done_reason"] = "stop"
+            text = "".join(parts)
+            if chat:
+                final["message"] = {"role": "assistant", "content": text}
+            else:
+                final["response"] = text
+            return web.json_response(final)
+
         while True:
             kind, payload = await asyncio.wait_for(queue.get(), timeout)
             if kind == "token":
-                tokens.append(payload)
+                emit, stopped = matcher.push(decoder.push(payload))
+                parts.append(emit)
+                if stopped:
+                    self.scheduler.cancel(seq.request_id)
+                    return respond(seq, stopped=True)
             else:
-                final = self._final_record(payload, model_name, recv_t, chat)
-                # Strip EOS from the visible text.
-                vis = [t for t in tokens
-                       if t != self.tokenizer.eos_token_id]
-                text = self.tokenizer.decode(vis)
-                if chat:
-                    final["message"] = {"role": "assistant", "content": text}
-                else:
-                    final["response"] = text
-                return web.json_response(final)
+                tail, stopped = matcher.push(decoder.flush())
+                parts.append(tail)
+                if not stopped:
+                    parts.append(matcher.flush())
+                return respond(payload, stopped)
 
 
 def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                  checkpoint: Optional[str] = None, warmup: bool = True,
                  tp: int = 1, draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None,
+                 enable_debug: bool = False,
                  **engine_overrides) -> InferenceServer:
     """Convenience constructor used by CLI, tests, and benchmarks."""
     from tpu_inference.config import EngineConfig, ParallelConfig, ServerConfig
@@ -368,20 +429,35 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                           parallel=ParallelConfig(tp=tp),
                           server=ServerConfig(model_name=model,
                                               tokenizer=tokenizer,
-                                              warmup=warmup),
+                                              warmup=warmup,
+                                              enable_debug=enable_debug),
                           checkpoint_path=checkpoint)
     draft_cfg = PRESETS[draft_model]() if draft_model else None
     params = draft_params = None
-    if checkpoint:
+    mesh = None
+    if cfg.parallel.n_devices > 1:
+        # Build the mesh BEFORE loading weights so checkpoints stream
+        # shard-by-shard straight into their TP layout — never an
+        # unsharded copy on host or device 0 (host-OOM at 70B scale).
+        from tpu_inference.parallel.mesh import build_mesh
+
+        mesh = build_mesh(cfg.parallel)
+
+    def _load(mcfg, path):
         from tpu_inference.models import weights
 
-        params = weights.load_checkpoint(model_cfg, checkpoint)
+        shardings = None
+        if mesh is not None:
+            from tpu_inference.parallel import shardings as shd
+
+            shardings = shd.param_shardings(mcfg, mesh)
+        return weights.load_checkpoint(mcfg, path, shardings=shardings)
+
+    if checkpoint:
+        params = _load(model_cfg, checkpoint)
     if draft_cfg is not None:
         if draft_checkpoint:
-            from tpu_inference.models import weights
-
-            draft_params = weights.load_checkpoint(draft_cfg,
-                                                   draft_checkpoint)
+            draft_params = _load(draft_cfg, draft_checkpoint)
         elif checkpoint:
             # Trained target + random draft = ~zero acceptance: every
             # round pays draft+verify to emit one token. Refuse loudly.
@@ -389,12 +465,7 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                 "--draft-model with --checkpoint requires "
                 "--draft-checkpoint: a random-weight draft makes "
                 "speculative decoding a pure slowdown")
-    if params is not None or draft_cfg is not None:
-        mesh = None
-        if cfg.parallel.n_devices > 1:
-            from tpu_inference.parallel.mesh import build_mesh
-
-            mesh = build_mesh(cfg.parallel)
+    if params is not None or draft_cfg is not None or mesh is not None:
         engine = InferenceEngine(model_cfg, engine_cfg, params=params,
                                  mesh=mesh, draft_cfg=draft_cfg,
                                  draft_params=draft_params)

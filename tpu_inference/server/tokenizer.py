@@ -95,6 +95,43 @@ class IncrementalDecoder:
         return text
 
 
+class StopMatcher:
+    """Scans a text stream for stop sequences spanning chunk boundaries.
+
+    ``push(chunk)`` returns (text safe to emit, stopped). Text that could
+    be the prefix of a stop string is held back until disambiguated, so a
+    stop sequence split across streamed tokens is still caught and the
+    stop string itself is never emitted (Ollama ``options.stop``).
+    """
+
+    def __init__(self, stops: List[str]):
+        self.stops = [s for s in stops if s]
+        self._buf = ""
+
+    def push(self, text: str) -> tuple:
+        if not self.stops:
+            return text, False
+        self._buf += text
+        cut = min((i for i in (self._buf.find(s) for s in self.stops)
+                   if i >= 0), default=-1)
+        if cut >= 0:
+            out, self._buf = self._buf[:cut], ""
+            return out, True
+        hold = 0
+        for s in self.stops:
+            for n in range(min(len(s) - 1, len(self._buf)), hold, -1):
+                if self._buf.endswith(s[:n]):
+                    hold = n
+                    break
+        out = self._buf[:len(self._buf) - hold]
+        self._buf = self._buf[len(self._buf) - hold:]
+        return out, False
+
+    def flush(self) -> str:
+        out, self._buf = self._buf, ""
+        return out
+
+
 def build_tokenizer(spec: str, vocab_size: int = 512) -> Tokenizer:
     """'byte' -> ByteTokenizer; anything else is a local HF tokenizer path."""
     if spec == "byte":
