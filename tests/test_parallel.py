@@ -122,6 +122,25 @@ def test_ep_engine_generate_matches_unsharded():
     assert got == want
 
 
+def test_sp_engine_ring_prefill_matches_unsharded():
+    """Serving prefill through ring attention (sp=4, composed with tp=2)
+    produces the same greedy tokens as the single-device engine, including
+    prompts long enough to span several sequence shards."""
+    cfg = tp_llama_cfg()
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=8,
+                        max_batch_size=4, prefill_buckets=(16, 32))
+    prompts = [list(range(1, 29)), [7, 8, 9], list(range(100, 117))]
+
+    base = InferenceEngine(cfg, ecfg, seed=0)
+    want = base.generate(prompts, max_new_tokens=8)
+
+    mesh = build_mesh(ParallelConfig(tp=2, sp=4))
+    eng = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+    assert eng.sp == 4
+    got = eng.generate(prompts, max_new_tokens=8)
+    assert got == want
+
+
 def test_dp_tp_mesh_shapes():
     mesh = build_mesh(ParallelConfig(dp=2, tp=2, sp=2))
     assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}

@@ -1,0 +1,94 @@
+"""End-to-end: a real-format HF checkpoint dir (sharded safetensors +
+config.json + trained BPE tokenizer.json) served through the full stack —
+config_from_hf -> streaming loader -> HFTokenizer -> HTTP contract.
+
+This is the serving path a user coming from the reference exercises: point
+the server at a model directory, no hand-written preset (reference bar:
+its external endpoint served `mistral` end-to-end, logs/log.json)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_inference.config import ModelConfig
+
+pytest.importorskip("torch")
+pytest.importorskip("transformers")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def real_dir(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("real-model"))
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks/make_real_model.py"),
+         "--out", out, "--size", "tiny", "--vocab-size", "1024",
+         "--data", os.path.join(REPO, "data/conversations.json")],
+        check=True, cwd=REPO, capture_output=True)
+    return out
+
+
+def test_config_from_hf(real_dir):
+    from tpu_inference.models.weights import config_from_hf
+
+    cfg = config_from_hf(real_dir)
+    assert isinstance(cfg, ModelConfig)
+    assert cfg.family == "llama" and cfg.d_model == 128
+    assert cfg.vocab_size % 128 == 0
+
+
+def test_hf_tokenizer_roundtrip(real_dir):
+    from tpu_inference.server.tokenizer import (HFTokenizer,
+                                                IncrementalDecoder)
+
+    tok = HFTokenizer(real_dir)
+    text = "Hello there, how is the weather today? éèê"
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_token_id
+    assert tok.decode(ids) == text
+    # Incremental decoding re-assembles the same text chunkwise.
+    dec = IncrementalDecoder(tok)
+    streamed = "".join(dec.push(i) for i in ids) + dec.flush()
+    assert streamed == text
+
+
+def test_serve_hf_checkpoint_dir(real_dir):
+    """build_server(model=<dir>, tokenizer='auto') serves the checkpoint
+    with real text in/out and the Ollama wire contract."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpu_inference.server.http import build_server
+
+    srv = build_server(model=real_dir, tokenizer="auto",
+                       page_size=8, num_pages=128, max_pages_per_seq=8,
+                       max_batch_size=2, prefill_buckets=(16, 32))
+    assert srv.engine.model_cfg.family == "llama"
+    assert srv.tokenizer.__class__.__name__ == "HFTokenizer"
+
+    async def go():
+        app = srv.make_app()
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/api/generate", json={
+                "model": "real", "prompt": "How many users", "stream": False,
+                "max_tokens": 8, "temperature": 0.0})
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["done"] and body["eval_count"] >= 1
+            assert isinstance(body["response"], str)
+            # Weight check: params came from the checkpoint files, not
+            # random init — compare one leaf against the safetensors dir.
+            from tpu_inference.models.weights import (_CheckpointFiles,
+                                                      config_from_hf)
+            files = _CheckpointFiles(real_dir)
+            want = np.asarray(
+                files.get_slice("model.norm.weight")[:]).astype(np.float32)
+            got = np.asarray(srv.engine.params["final_norm"], np.float32)
+            np.testing.assert_array_equal(got, want)
+
+    asyncio.run(go())

@@ -1,5 +1,7 @@
 """Byte tokenizer + incremental UTF-8-safe stream decoding."""
 
+import pytest
+
 from tpu_inference.server.tokenizer import (ByteTokenizer, IncrementalDecoder,
                                             build_tokenizer)
 
@@ -36,3 +38,44 @@ def test_build_tokenizer_byte():
     tok = build_tokenizer("byte", vocab_size=512)
     assert tok.vocab_size == 512
     assert tok.eos_token_id == 257
+
+
+def test_incremental_decoder_metaspace_spacing(tmp_path):
+    """SentencePiece/Metaspace pieces ("▁the" -> " the" in context) must
+    keep their inter-word spacing under incremental decoding — decoding
+    tokens independently drops every space (the Llama-family failure)."""
+    import json
+
+    pytest.importorskip("transformers")
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    tok = tokenizers.Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace()
+    tok.decoder = decoders.Metaspace()
+    trainer = trainers.BpeTrainer(vocab_size=400,
+                                  special_tokens=["<s>", "</s>"])
+    tok.train_from_iterator(
+        ["hello world how is the weather today",
+         "the quick brown fox jumps over the lazy dog"] * 20, trainer)
+    tok.save(str(tmp_path / "tokenizer.json"))
+    with open(tmp_path / "tokenizer_config.json", "w") as f:
+        json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                   "bos_token": "<s>", "eos_token": "</s>"}, f)
+
+    from tpu_inference.server.tokenizer import HFTokenizer
+
+    hf = HFTokenizer(str(tmp_path))
+    text = "hello world how is the weather"
+    ids = hf.encode(text)
+    assert " " in hf.decode(ids)
+    dec = IncrementalDecoder(hf)
+    streamed = "".join(dec.push(i) for i in ids) + dec.flush()
+    assert streamed == hf.decode(ids) == text
+    # Seeded with a prompt tail, the first generated piece keeps its
+    # leading space relative to the prompt.
+    prompt = hf.encode("hello world", add_bos=False)
+    dec = IncrementalDecoder(hf, prompt_tail=prompt)
+    cont = hf.encode(" how is", add_bos=False)
+    streamed = "".join(dec.push(i) for i in cont) + dec.flush()
+    assert streamed == " how is"

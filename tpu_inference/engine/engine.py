@@ -39,7 +39,8 @@ from tpu_inference.models.registry import build_model, get_model_fns
 def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
                     positions: jax.Array, valid: jax.Array,
                     q_offset: jax.Array, kv_len: jax.Array,
-                    attn_backend: str = "dense", mesh: Optional[Any] = None):
+                    attn_backend: str = "dense", mesh: Optional[Any] = None,
+                    sp_ring: bool = False):
     """AttentionFn that writes new K/V into the paged pool then attends.
 
     block_tables [B, MP]; positions/valid [B, S]; q_offset/kv_len [B].
@@ -50,8 +51,27 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
     streams only its own head shard's pages — attention output is
     head-local and needs no collective; the following wo matmul's
     all-reduce (placed by GSPMD) combines chips as usual.
+
+    ``sp_ring``: sequence-parallel prefill — the chunk's self-attention
+    runs as ring attention over the mesh's ``sp`` axis (q/k/v sequence-
+    sharded, K/V shards rotating by ppermute over ICI), composed with tp
+    head sharding. Valid only for a fresh full-prompt chunk (no cached
+    prefix); the engine routes eligible prefills here.
     """
     from tpu_inference.models.common import dense_causal_attention
+
+    def _ring_prefill(q, k, v):
+        from functools import partial as _partial
+
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_inference.kernels.ring_attention import ring_attention_local
+
+        spec = P(None, "sp", "tp", None)       # [B, S, H, D]: seq × heads
+        return jax.shard_map(
+            _partial(ring_attention_local, axis_name="sp"),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
 
     def _pallas_decode(q1, kv: KVPages, layer_idx):
         from tpu_inference.kernels.paged_attention import paged_attention
@@ -73,6 +93,10 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
         kv = kvc.write_kv(kv, layer_idx, k, v, slots)
         if attn_backend == "pallas" and q.shape[1] == 1:
             return _pallas_decode(q[:, 0], kv, layer_idx)[:, None], kv
+        if sp_ring and q.shape[1] > 1:
+            # Fresh full-prompt chunk: attention is pure self-attention
+            # over (q, k, v) — no need to read back through the pool.
+            return _ring_prefill(q, k, v), kv
         k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
         out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,
                                      kv_len=kv_len)
@@ -183,6 +207,12 @@ class InferenceEngine:
             partial(self._prefill_fn), donate_argnums=(1,))
         self._decode_multi_jit = jax.jit(
             partial(self._decode_multi_fn), donate_argnums=(1,))
+        # Sequence-parallel prefill (ring attention over the sp axis) for
+        # fresh full-prompt chunks on an sp>1 mesh.
+        self.sp = 1 if mesh is None else int(mesh.shape.get("sp", 1))
+        if self.sp > 1:
+            self._prefill_sp_jit = jax.jit(
+                partial(self._prefill_fn, sp_ring=True), donate_argnums=(1,))
 
         # Speculative decoding (BASELINE.json config 4): a draft model with
         # its own KV pool but the SAME page geometry + block tables, so one
@@ -218,7 +248,8 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _prefill_fn(self, params, kv: KVPages, tokens, prompt_len, prefix_len,
-                    block_table, key, temperature, top_p, top_k, seed):
+                    block_table, key, temperature, top_p, top_k, seed,
+                    sp_ring: bool = False):
         """One sequence, tokens [1, S_bucket] right-padded.
 
         prefix_len > 0 means ``prefix_len`` tokens are already cached in this
@@ -234,7 +265,8 @@ class InferenceEngine:
         positions = jnp.minimum(positions, self.engine_cfg.max_context - 1)
         attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_table,
                                positions, valid, q_offset=prefix_len,
-                               kv_len=total_len)
+                               kv_len=total_len, mesh=self.mesh,
+                               sp_ring=sp_ring)
         hidden, kv = self.mod.forward_hidden(params, cfg, tokens, positions,
                                              kv, attn)
         last = jnp.take_along_axis(
@@ -342,6 +374,10 @@ class InferenceEngine:
             self.kv, _, _ = self._prefill_jit(
                 self.params, self.kv, toks, one, zero, jnp.asarray(bt),
                 self._next_key(), tz, tp, tk, sd)
+            if self.sp > 1 and bucket % self.sp == 0:
+                self.kv, _, _ = self._prefill_sp_jit(
+                    self.params, self.kv, toks, one, zero, jnp.asarray(bt),
+                    self._next_key(), tz, tp, tk, sd)
             if self.spec_enabled:
                 self.draft_kv = self._draft_prefill_jit(
                     self.draft_params, self.draft_kv, toks, one, zero,
@@ -448,7 +484,13 @@ class InferenceEngine:
             bucket = ecfg.bucket_for(len(chunk))
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :len(chunk)] = chunk
-            self.kv, tok, _ = self._prefill_jit(
+            # Ring-attention prefill for fresh single-chunk prompts on an
+            # sp>1 mesh (self-attention only — no cached prefix to read).
+            use_sp = (self.sp > 1 and offset == 0
+                      and len(chunk) == len(prompt)
+                      and bucket % self.sp == 0)
+            prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
+            self.kv, tok, _ = prefill(
                 self.params, self.kv, jnp.asarray(toks),
                 jnp.asarray([len(chunk)], np.int32),
                 jnp.asarray([offset], np.int32), jnp.asarray(bt),

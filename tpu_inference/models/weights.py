@@ -167,6 +167,58 @@ def load_native(path: str, template: dict) -> dict:
         return ckptr.restore(os.path.abspath(path), abstract)
 
 
+def config_from_hf(path: str) -> ModelConfig:
+    """Build a ModelConfig from a HF checkpoint directory's config.json.
+
+    The reference's workflow is "point the server at a model and serve it"
+    (Ollama pulls by name); the equivalent here is pointing at a local HF
+    directory — architecture hyperparameters come from the checkpoint, not
+    from a hand-maintained preset. Supports llama, mixtral and gpt2.
+    """
+    import jax.numpy as jnp
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    model_type = hf.get("model_type", "llama")
+    name = os.path.basename(os.path.normpath(path))
+    torch_dtype = hf.get("torch_dtype", "bfloat16")
+    dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.bfloat16,
+             "float32": jnp.float32}.get(torch_dtype, jnp.bfloat16)
+    if torch_dtype not in ("bfloat16", "float32"):
+        import sys
+        print(f"[config_from_hf] {name}: torch_dtype={torch_dtype!r} served "
+              "as bfloat16 (TPU-native; fp16 loses 2 mantissa bits — pass "
+              "an explicit ModelConfig with dtype=float32 for a lossless "
+              "load)", file=sys.stderr)
+    if model_type == "gpt2":
+        d = hf["n_embd"]
+        return ModelConfig(
+            name=name, family="gpt2", vocab_size=hf["vocab_size"],
+            d_model=d, n_layers=hf["n_layer"], n_heads=hf["n_head"],
+            n_kv_heads=hf["n_head"], d_ff=hf.get("n_inner") or 4 * d,
+            max_seq_len=hf.get("n_positions", 1024),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            use_learned_pos=True, use_bias=True, tie_embeddings=True,
+            dtype=dtype)
+    if model_type not in ("llama", "mixtral", "mistral"):
+        raise ValueError(f"unsupported model_type {model_type!r} in "
+                         f"{path}/config.json")
+    heads = hf["num_attention_heads"]
+    return ModelConfig(
+        name=name, family="mixtral" if model_type == "mixtral" else "llama",
+        vocab_size=hf["vocab_size"], d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"], n_heads=heads,
+        n_kv_heads=hf.get("num_key_value_heads", heads),
+        d_ff=hf["intermediate_size"],
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        norm_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        n_experts=hf.get("num_local_experts", 0),
+        n_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        dtype=dtype)
+
+
 # ---------------------------------------------------------------------------
 # Streaming safetensors loader.
 #

@@ -17,9 +17,13 @@ from tpu_inference.config import PRESETS
 def main() -> None:
     p = argparse.ArgumentParser(description="TPU-native LLM inference server "
                                             "(Ollama-protocol endpoint)")
-    p.add_argument("--model", default="tiny-llama", choices=sorted(PRESETS))
+    p.add_argument("--model", default="tiny-llama",
+                   help=f"preset ({', '.join(sorted(PRESETS))}), a HF "
+                        "checkpoint dir (config.json read for the "
+                        "architecture), or 'auto' with --checkpoint")
     p.add_argument("--tokenizer", default="byte",
-                   help="'byte' or path to a local HF tokenizer dir")
+                   help="'byte', a local HF tokenizer dir, or 'auto' "
+                        "(= the checkpoint dir's tokenizer when present)")
     p.add_argument("--checkpoint", default=None,
                    help="HF safetensors directory (random init if omitted)")
     p.add_argument("--host", default="127.0.0.1")
@@ -31,12 +35,16 @@ def main() -> None:
                    help="max context = page-size * this")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (devices in the mesh)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel degree: ring-attention prefill "
+                        "over this many devices (long prompts)")
     p.add_argument("--attn-backend", default="auto",
                    choices=("auto", "dense", "pallas"),
                    help="decode attention: Pallas paged kernel (TPU) or "
                         "dense gather; auto = pallas on TPU")
-    p.add_argument("--draft-model", default=None, choices=sorted(PRESETS),
-                   help="enable speculative decoding with this draft preset")
+    p.add_argument("--draft-model", default=None,
+                   help="enable speculative decoding with this draft "
+                        "preset or HF checkpoint dir")
     p.add_argument("--draft-checkpoint", default=None,
                    help="HF safetensors dir for the draft model (required "
                         "when --checkpoint is set)")
@@ -51,7 +59,7 @@ def main() -> None:
 
     server = build_server(model=args.model, tokenizer=args.tokenizer,
                           checkpoint=args.checkpoint,
-                          warmup=not args.no_warmup, tp=args.tp,
+                          warmup=not args.no_warmup, tp=args.tp, sp=args.sp,
                           draft_model=args.draft_model,
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,

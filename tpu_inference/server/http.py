@@ -332,8 +332,10 @@ class InferenceServer:
         resp = web.StreamResponse(status=200, headers={
             "Content-Type": "application/x-ndjson"})
         resp.enable_chunked_encoding()
-        decoder = IncrementalDecoder(self.tokenizer)
+        decoder = IncrementalDecoder(self.tokenizer,
+                                     prompt_tail=seq.prompt_tokens[-8:])
         matcher = StopMatcher(stop or [])
+        consumed: list = []            # token ids delivered to THIS handler
         prepared = False
         timeout = self.cfg.server.request_timeout_s
 
@@ -344,7 +346,14 @@ class InferenceServer:
         async def finish(stopped: bool) -> web.StreamResponse:
             final = self._final_record(seq, model_name, recv_t, chat)
             if stopped:
+                # The engine thread may still be appending to
+                # seq.generated until the cancel lands; report only what
+                # this handler consumed so context/eval_count are
+                # deterministic and never include post-stop tokens.
                 final["done_reason"] = "stop"
+                final["eval_count"] = len(consumed)
+                if "context" in final:
+                    final["context"] = list(seq.prompt_tokens) + consumed
             await resp.write(json.dumps(final).encode() + b"\n")
             await resp.write_eof()
             return resp
@@ -352,6 +361,7 @@ class InferenceServer:
         while True:
             kind, payload = await asyncio.wait_for(queue.get(), timeout)
             if kind == "token":
+                consumed.append(payload)
                 emit, stopped = matcher.push(decoder.push(payload))
                 if not prepared:
                     # First token ready -> now send headers (TTFT contract).
@@ -382,15 +392,22 @@ class InferenceServer:
                               recv_t: float, chat: bool = False,
                               stop: Optional[list] = None
                               ) -> web.Response:
-        decoder = IncrementalDecoder(self.tokenizer)
+        decoder = IncrementalDecoder(self.tokenizer,
+                                     prompt_tail=seq.prompt_tokens[-8:])
         matcher = StopMatcher(stop or [])
         parts: list = []
+        consumed: list = []            # token ids delivered to THIS handler
         timeout = self.cfg.server.request_timeout_s
 
         def respond(payload, stopped: bool) -> web.Response:
             final = self._final_record(payload, model_name, recv_t, chat)
             if stopped:
+                # Snapshot only handler-consumed tokens (the engine thread
+                # may append more before the cancel lands).
                 final["done_reason"] = "stop"
+                final["eval_count"] = len(consumed)
+                if "context" in final:
+                    final["context"] = list(seq.prompt_tokens) + consumed
             text = "".join(parts)
             if chat:
                 final["message"] = {"role": "assistant", "content": text}
@@ -401,6 +418,7 @@ class InferenceServer:
         while True:
             kind, payload = await asyncio.wait_for(queue.get(), timeout)
             if kind == "token":
+                consumed.append(payload)
                 emit, stopped = matcher.push(decoder.push(payload))
                 parts.append(emit)
                 if stopped:
@@ -416,23 +434,54 @@ class InferenceServer:
 
 def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                  checkpoint: Optional[str] = None, warmup: bool = True,
-                 tp: int = 1, draft_model: Optional[str] = None,
+                 tp: int = 1, sp: int = 1,
+                 draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None,
                  enable_debug: bool = False,
                  **engine_overrides) -> InferenceServer:
-    """Convenience constructor used by CLI, tests, and benchmarks."""
+    """Convenience constructor used by CLI, tests, and benchmarks.
+
+    ``model``/``draft_model`` accept a preset name, a path to a HF
+    checkpoint directory (architecture read from its config.json), or
+    "auto" with ``checkpoint`` set. ``tokenizer="auto"`` uses the
+    checkpoint directory's tokenizer files when present, else bytes.
+    """
+    import os
+
     from tpu_inference.config import EngineConfig, ParallelConfig, ServerConfig
 
-    model_cfg = PRESETS[model]()
+    def resolve(name, ckpt):
+        """(model_cfg, checkpoint_path) from a preset name or HF dir."""
+        if name in PRESETS:
+            return PRESETS[name](), ckpt
+        from tpu_inference.models import weights
+
+        src = ckpt if (name == "auto" and ckpt) else name
+        if not (isinstance(src, str)
+                and os.path.exists(os.path.join(src, "config.json"))):
+            raise ValueError(
+                f"unknown model {name!r}: not a preset "
+                f"({', '.join(sorted(PRESETS))}) and not a HF checkpoint "
+                f"directory with a config.json")
+        return weights.config_from_hf(src), (ckpt or src)
+
+    model_cfg, checkpoint = resolve(model, checkpoint)
+    if tokenizer == "auto":
+        has_tok = checkpoint and any(
+            os.path.exists(os.path.join(checkpoint, f))
+            for f in ("tokenizer.json", "tokenizer_config.json"))
+        tokenizer = checkpoint if has_tok else "byte"
     engine_cfg = EngineConfig(**engine_overrides) if engine_overrides else EngineConfig()
     cfg = FrameworkConfig(model=model_cfg, engine=engine_cfg,
-                          parallel=ParallelConfig(tp=tp),
+                          parallel=ParallelConfig(tp=tp, sp=sp),
                           server=ServerConfig(model_name=model,
                                               tokenizer=tokenizer,
                                               warmup=warmup,
                                               enable_debug=enable_debug),
                           checkpoint_path=checkpoint)
-    draft_cfg = PRESETS[draft_model]() if draft_model else None
+    draft_cfg = None
+    if draft_model:
+        draft_cfg, draft_checkpoint = resolve(draft_model, draft_checkpoint)
     params = draft_params = None
     mesh = None
     if cfg.parallel.n_devices > 1:

@@ -67,32 +67,42 @@ class HFTokenizer:
 
 
 class IncrementalDecoder:
-    """Streams token ids -> text chunks without emitting broken UTF-8 or
-    partial multi-token glyphs. One instance per in-flight request.
+    """Streams token ids -> text chunks. One instance per request.
 
-    Only the undecodable tail is buffered and re-decoded (the HF
-    ``TextStreamer`` strategy), so per-token cost is O(holdback), not
-    O(tokens generated). When the pending decode ends in a replacement
-    char the bytes may be an incomplete multi-byte sequence the next token
-    completes — hold them back; otherwise emit and reset.
+    Decoding each token independently is wrong for non-concatenative
+    tokenizers (SentencePiece/Metaspace pieces like "▁the" decode to
+    "the" alone but " the" in context), so this keeps a sliding window:
+    re-decode from the previous emit point and yield only the text
+    delta (the vLLM detokenizer offset scheme). The window resets on
+    every emit, so per-token cost stays O(tokens since last emit).
+    A trailing replacement char means an incomplete UTF-8/byte-fallback
+    sequence — hold until a later token completes it.
+
+    ``prompt_tail``: the last few prompt ids, seeding the window so the
+    first generated piece keeps its inter-word spacing after the prompt.
     """
 
-    def __init__(self, tokenizer: Tokenizer):
+    def __init__(self, tokenizer: Tokenizer, prompt_tail: List[int] = ()):
         self._tok = tokenizer
-        self._pending: List[int] = []
+        self._ids: List[int] = list(prompt_tail)
+        self._prefix = 0                   # window start
+        self._read = len(self._ids)        # already-emitted boundary
 
     def push(self, token_id: int) -> str:
-        self._pending.append(token_id)
-        text = self._tok.decode(self._pending)
-        if text.endswith("�"):
+        self._ids.append(token_id)
+        prefix_text = self._tok.decode(self._ids[self._prefix:self._read])
+        full_text = self._tok.decode(self._ids[self._prefix:])
+        if full_text.endswith("�") or len(full_text) <= len(prefix_text):
             return ""
-        self._pending.clear()
-        return text
+        self._prefix = self._read
+        self._read = len(self._ids)
+        return full_text[len(prefix_text):]
 
     def flush(self) -> str:
-        text = self._tok.decode(self._pending)
-        self._pending.clear()
-        return text
+        prefix_text = self._tok.decode(self._ids[self._prefix:self._read])
+        full_text = self._tok.decode(self._ids[self._prefix:])
+        self._read = len(self._ids)
+        return full_text[len(prefix_text):]
 
 
 class StopMatcher:
