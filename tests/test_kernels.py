@@ -119,6 +119,57 @@ def test_engine_pallas_backend_sharded_matches_dense():
     assert got_d == got_p
 
 
+@pytest.mark.parametrize("block_q,q_offsets", [(16, (5, 0)), (8, (0, 13))])
+def test_paged_prefill_attention_matches_dense(block_q, q_offsets):
+    """Flash prefill over pool pages == dense gather+causal attention,
+    including cached-prefix offsets and partially-filled last pages."""
+    from tpu_inference.kernels.prefill_attention import paged_prefill_attention
+
+    rng = np.random.default_rng(7)
+    b, s, hq, hkv, d, pg, npg, mp = 2, 32, 8, 2, 64, 8, 64, 8
+    k_pool = jnp.asarray(rng.standard_normal((npg, pg, hkv, d)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((npg, pg, hkv, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.float32)
+    perm = rng.permutation(np.arange(1, npg))[:b * mp]
+    bt = jnp.asarray(perm.reshape(b, mp).astype(np.int32))
+    q_off = jnp.asarray(q_offsets, jnp.int32)
+    prompt = jnp.asarray([20, 32], jnp.int32)
+    kv_len = q_off + prompt
+
+    got = paged_prefill_attention(q, k_pool, v_pool, bt, kv_len, q_off,
+                                  block_q=block_q)
+    kv = kvc.KVPages(k=k_pool[None], v=v_pool[None])
+    k_all, v_all = kvc.gather_kv(kv, 0, bt)
+    want = common.dense_causal_attention(q, k_all, v_all, q_offset=q_off,
+                                         kv_len=kv_len)
+    for i in range(b):
+        n = int(prompt[i])                    # padded query rows unused
+        np.testing.assert_allclose(np.asarray(got)[i, :n],
+                                   np.asarray(want)[i, :n],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_paged_prefill_non_power_of_two_bucket():
+    """Lengths with no 128 divisor pick a smaller valid query block."""
+    from tpu_inference.kernels.prefill_attention import paged_prefill_attention
+
+    rng = np.random.default_rng(8)
+    b, s, h, d, pg, npg, mp = 1, 24, 4, 32, 8, 16, 4
+    k_pool = jnp.asarray(rng.standard_normal((npg, pg, h, d)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((npg, pg, h, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+    bt = jnp.asarray(np.arange(1, 1 + mp)[None].astype(np.int32))
+    kv_len = jnp.asarray([s], jnp.int32)
+    got = paged_prefill_attention(q, k_pool, v_pool, bt, kv_len,
+                                  jnp.zeros((b,), jnp.int32), block_q=16)
+    kv = kvc.KVPages(k=k_pool[None], v=v_pool[None])
+    k_all, v_all = kvc.gather_kv(kv, 0, bt)
+    want = common.dense_causal_attention(q, k_all, v_all, q_offset=0,
+                                         kv_len=kv_len)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("sp,hq,hkv", [(4, 4, 4), (8, 8, 2)])
 def test_ring_attention_matches_dense(sp, hq, hkv):
     """Sequence-parallel ring attention == dense causal attention."""

@@ -175,6 +175,10 @@ class EngineConfig:
     # XLA compiles a bounded number of prefill graphs.
     prefill_buckets: tuple[int, ...] = (64, 128, 256, 512, 1024)
     chunked_prefill_size: int = 0     # 0 = whole-prompt prefill
+    # Same-bucket single-chunk prefills batched into one [P, S] dispatch
+    # (burst arrivals stop paying one serial forward each). Graphs are
+    # compiled for P in {1, this}.
+    max_prefill_batch: int = 4
     # Decode attention backend: "auto" picks the Pallas paged-attention
     # kernel (kernels/paged_attention.py) on real TPU and the dense
     # gather path elsewhere; "pallas"/"dense" force one.

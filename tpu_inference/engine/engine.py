@@ -88,6 +88,25 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             out_specs=head_p, check_vma=False,
         )(q1, kv.k[layer_idx], kv.v[layer_idx], block_tables, kv_len)
 
+    def _pallas_prefill(q, kv: KVPages, layer_idx):
+        from tpu_inference.kernels.prefill_attention import (
+            paged_prefill_attention)
+        if mesh is None:
+            return paged_prefill_attention(q, kv.k[layer_idx],
+                                           kv.v[layer_idx], block_tables,
+                                           kv_len, q_offset)
+        from jax.sharding import PartitionSpec as P
+        head_p = P(None, None, "tp", None)             # q/out [B, S, H*, D]
+        pool_p = P(None, None, "tp", None)             # [P, pg, Hkv, D]
+        return jax.shard_map(
+            lambda q_, k_, v_, bt_, kl_, qo_: paged_prefill_attention(
+                q_, k_, v_, bt_, kl_, qo_),
+            mesh=mesh,
+            in_specs=(head_p, pool_p, pool_p, P(), P(), P()),
+            out_specs=head_p, check_vma=False,
+        )(q, kv.k[layer_idx], kv.v[layer_idx], block_tables, kv_len,
+          q_offset)
+
     def attn(layer_idx, q, k, v, kv: KVPages):
         slots = kvc.slot_mapping(block_tables, positions, valid, page_size)
         kv = kvc.write_kv(kv, layer_idx, k, v, slots)
@@ -97,6 +116,9 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             # Fresh full-prompt chunk: attention is pure self-attention
             # over (q, k, v) — no need to read back through the pool.
             return _ring_prefill(q, k, v), kv
+        if attn_backend == "pallas" and q.shape[1] > 1:
+            # Flash prefill over pool pages: O(S·page) memory, no gather.
+            return _pallas_prefill(q, kv, layer_idx), kv
         k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
         out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,
                                      kv_len=kv_len)
@@ -210,6 +232,10 @@ class InferenceEngine:
         # Sequence-parallel prefill (ring attention over the sp axis) for
         # fresh full-prompt chunks on an sp>1 mesh.
         self.sp = 1 if mesh is None else int(mesh.shape.get("sp", 1))
+        # Compiled prefill lane counts (pad-to-size keeps XLA graph count
+        # bounded at 2 per bucket).
+        self._prefill_batch_sizes = sorted(
+            {1, max(1, engine_cfg.max_prefill_batch)})
         if self.sp > 1:
             self._prefill_sp_jit = jax.jit(
                 partial(self._prefill_fn, sp_ring=True), donate_argnums=(1,))
@@ -266,6 +292,7 @@ class InferenceEngine:
         attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_table,
                                positions, valid, q_offset=prefix_len,
                                kv_len=total_len, mesh=self.mesh,
+                               attn_backend=self.attn_backend,
                                sp_ring=sp_ring)
         hidden, kv = self.mod.forward_hidden(params, cfg, tokens, positions,
                                              kv, attn)
@@ -290,7 +317,9 @@ class InferenceEngine:
         positions = jnp.minimum(positions, self.engine_cfg.max_context - 1)
         attn = make_paged_attn(cfg, self.engine_cfg.page_size, block_table,
                                positions, valid, q_offset=prefix_len,
-                               kv_len=prefix_len + prompt_len)
+                               kv_len=prefix_len + prompt_len,
+                               mesh=self.mesh,
+                               attn_backend=self.attn_backend)
         _, draft_kv = self.draft_mod.forward_hidden(
             draft_params, cfg, tokens, positions, draft_kv, attn)
         return draft_kv
@@ -360,28 +389,29 @@ class InferenceEngine:
         """
         t0 = time.perf_counter()
         ecfg = self.engine_cfg
-        bt = np.zeros((1, self.max_pages), np.int32)
-        one = jnp.asarray([1], np.int32)
-        zero = jnp.asarray([0], np.int32)
-        tz = jnp.asarray([0.0], np.float32)
-        tp = jnp.asarray([1.0], np.float32)
-        tk = jnp.asarray([0], np.int32)
-        sd = jnp.asarray([-1], np.int32)
-        for bucket in ecfg.prefill_buckets:
-            if bucket > ecfg.max_context:
-                continue
-            toks = jnp.zeros((1, bucket), jnp.int32)
-            self.kv, _, _ = self._prefill_jit(
-                self.params, self.kv, toks, one, zero, jnp.asarray(bt),
-                self._next_key(), tz, tp, tk, sd)
-            if self.sp > 1 and bucket % self.sp == 0:
-                self.kv, _, _ = self._prefill_sp_jit(
-                    self.params, self.kv, toks, one, zero, jnp.asarray(bt),
+        for p in self._prefill_batch_sizes:
+            bt = jnp.zeros((p, self.max_pages), jnp.int32)
+            one = jnp.ones((p,), jnp.int32)
+            zero = jnp.zeros((p,), jnp.int32)
+            tz = jnp.zeros((p,), jnp.float32)
+            tp = jnp.ones((p,), jnp.float32)
+            tk = jnp.zeros((p,), jnp.int32)
+            sd = jnp.full((p,), -1, jnp.int32)
+            for bucket in ecfg.prefill_buckets:
+                if bucket > ecfg.max_context:
+                    continue
+                toks = jnp.zeros((p, bucket), jnp.int32)
+                self.kv, _, _ = self._prefill_jit(
+                    self.params, self.kv, toks, one, zero, bt,
                     self._next_key(), tz, tp, tk, sd)
-            if self.spec_enabled:
-                self.draft_kv = self._draft_prefill_jit(
-                    self.draft_params, self.draft_kv, toks, one, zero,
-                    jnp.asarray(bt))
+                if self.sp > 1 and bucket % self.sp == 0:
+                    self.kv, _, _ = self._prefill_sp_jit(
+                        self.params, self.kv, toks, one, zero, bt,
+                        self._next_key(), tz, tp, tk, sd)
+                if self.spec_enabled:
+                    self.draft_kv = self._draft_prefill_jit(
+                        self.draft_params, self.draft_kv, toks, one, zero,
+                        bt)
         b = ecfg.max_batch_size
         if self.spec_enabled:
             out = self._spec_jit(
@@ -403,6 +433,41 @@ class InferenceEngine:
                 jnp.zeros((b,), jnp.int32), jnp.full((b,), -1, jnp.int32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
+
+    def check_numerics(self) -> None:
+        """Numerics sanitizer (SURVEY.md §5 race/sanitizer tier).
+
+        Fails fast if any param leaf is non-finite, then runs one
+        checkify'd forward (NaN/inf float checks compiled into the graph)
+        on tiny inputs. Use at startup after loading a checkpoint, or from
+        debug tooling after a suspect update. For always-on checking, run
+        with ``--debug-nans`` (jax_debug_nans) instead — it re-runs any
+        NaN-producing op un-jitted and pinpoints it.
+        """
+        from jax.experimental import checkify
+
+        from tpu_inference.models.common import make_dense_attn
+
+        leaves = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        bad = [jax.tree_util.keystr(path) for path, x in leaves
+               if not bool(jnp.isfinite(x).all())]
+        if bad:
+            raise FloatingPointError(
+                f"non-finite values in params at {bad}")
+
+        cfg = self.model_cfg
+
+        def fwd(params, tokens, positions):
+            hidden, _ = self.mod.forward_hidden(params, cfg, tokens,
+                                                positions, None,
+                                                make_dense_attn())
+            return self.mod.unembed(params, cfg, hidden)
+
+        toks = jnp.zeros((1, 8), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32)[None], (1, 8))
+        err, _ = jax.jit(checkify.checkify(
+            fwd, errors=checkify.float_checks))(self.params, toks, pos)
+        err.throw()
 
     def _next_key(self) -> jax.Array:
         self._step_count += 1
@@ -446,13 +511,10 @@ class InferenceEngine:
         bt[:len(pages)] = pages
         return bt
 
-    def prefill(self, seq: Sequence, slot: Optional[int] = None) -> int:
-        """Admit a sequence: allocate pages, run the prefill graph (chunked
-        when the prompt exceeds the largest bucket), sample the first token.
-        Returns the slot index."""
+    def _prefill_setup(self, seq: Sequence, slot: int) -> List[int]:
+        """Allocate pages (with prefix-cache reuse), bind the slot, and
+        return the (possibly truncated) prompt to prefill."""
         ecfg = self.engine_cfg
-        if slot is None:
-            slot = self.free_slots()[0]
         # Keep the most recent tokens of over-long prompts (leave room for
         # at least one generated token).
         prompt = seq.prompt_tokens[-(ecfg.max_context - 1):]
@@ -471,10 +533,30 @@ class InferenceEngine:
             raise
         seq.slot = slot
         seq.prefill_start = time.perf_counter()
-        bt = self._block_table_array(seq.pages)[None]
+        return prompt
 
-        # Chunked prefill: each chunk attends to itself + all cached tokens
-        # (prefix_len). Only the final chunk's sampled token is kept.
+    def _prefill_finish(self, seq: Sequence, prompt: List[int],
+                        first: int) -> None:
+        """Common post-prefill bookkeeping for one sequence."""
+        seq.ctx_len = len(prompt)
+        seq.generated.append(first)
+        seq.first_token_time = time.perf_counter()
+        self.slots[seq.slot] = seq
+        self._maybe_finish(seq, first)
+
+    def _use_sp(self, offset: int, chunk_len: int, prompt_len: int,
+                bucket: int) -> bool:
+        """Ring-attention prefill is eligible for fresh single-chunk
+        prompts on an sp>1 mesh (self-attention only, no cached prefix)."""
+        return (self.sp > 1 and offset == 0 and chunk_len == prompt_len
+                and bucket % self.sp == 0)
+
+    def _prefill_chunked(self, seq: Sequence, prompt: List[int]) -> None:
+        """Serial (one-lane) prefill; chunks prompts that exceed the
+        largest bucket. Each chunk attends to itself + all cached tokens
+        (prefix_len); only the final chunk's sampled token is kept."""
+        ecfg = self.engine_cfg
+        bt = self._block_table_array(seq.pages)[None]
         chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
         offset = seq.cached_tokens
         tok = None
@@ -484,11 +566,7 @@ class InferenceEngine:
             bucket = ecfg.bucket_for(len(chunk))
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :len(chunk)] = chunk
-            # Ring-attention prefill for fresh single-chunk prompts on an
-            # sp>1 mesh (self-attention only — no cached prefix to read).
-            use_sp = (self.sp > 1 and offset == 0
-                      and len(chunk) == len(prompt)
-                      and bucket % self.sp == 0)
+            use_sp = self._use_sp(offset, len(chunk), len(prompt), bucket)
             prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
             self.kv, tok, _ = prefill(
                 self.params, self.kv, jnp.asarray(toks),
@@ -506,13 +584,91 @@ class InferenceEngine:
                     jnp.asarray([len(chunk)], np.int32),
                     jnp.asarray([offset], np.int32), jnp.asarray(bt))
             offset += len(chunk)
-        seq.ctx_len = len(prompt)
-        first = int(tok[0])
-        seq.generated.append(first)
-        seq.first_token_time = time.perf_counter()
-        self.slots[slot] = seq
-        self._maybe_finish(seq, first)
+        self._prefill_finish(seq, prompt, int(tok[0]))
+
+    def prefill(self, seq: Sequence, slot: Optional[int] = None) -> int:
+        """Admit a sequence: allocate pages, run the prefill graph (chunked
+        when the prompt exceeds the largest bucket), sample the first token.
+        Returns the slot index."""
+        if slot is None:
+            slot = self.free_slots()[0]
+        prompt = self._prefill_setup(seq, slot)
+        self._prefill_chunked(seq, prompt)
         return slot
+
+    def _prefill_run_batched(self, group: List[Tuple[Sequence, List[int]]],
+                             bucket: int, use_sp: bool) -> None:
+        """One multi-lane prefill dispatch: P sequences, same bucket.
+
+        Lanes are padded up to a compiled batch size; dummy lanes carry
+        prompt_len=1 with an all-zero block table, so their single write
+        lands on the trash page and their sampled token is discarded.
+        """
+        ecfg = self.engine_cfg
+        p = next(s for s in self._prefill_batch_sizes if s >= len(group))
+        toks = np.zeros((p, bucket), np.int32)
+        plen = np.ones((p,), np.int32)
+        pref = np.zeros((p,), np.int32)
+        bts = np.zeros((p, self.max_pages), np.int32)
+        temps = np.zeros((p,), np.float32)
+        top_ps = np.ones((p,), np.float32)
+        top_ks = np.zeros((p,), np.int32)
+        seeds = np.full((p,), -1, np.int32)
+        for i, (seq, prompt) in enumerate(group):
+            chunk = prompt[seq.cached_tokens:]
+            toks[i, :len(chunk)] = chunk
+            plen[i] = len(chunk)
+            pref[i] = seq.cached_tokens
+            bts[i] = self._block_table_array(seq.pages)
+            temps[i] = seq.temperature
+            top_ps[i] = seq.top_p
+            top_ks[i], seeds[i] = self._sampling_arrays(seq)
+        prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
+        self.kv, tok, _ = prefill(
+            self.params, self.kv, jnp.asarray(toks), jnp.asarray(plen),
+            jnp.asarray(pref), jnp.asarray(bts), self._next_key(),
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+            jnp.asarray(seeds))
+        if self.spec_enabled:
+            self.draft_kv = self._draft_prefill_jit(
+                self.draft_params, self.draft_kv, jnp.asarray(toks),
+                jnp.asarray(plen), jnp.asarray(pref), jnp.asarray(bts))
+        toks_out = np.asarray(tok)
+        for i, (seq, prompt) in enumerate(group):
+            self._prefill_finish(seq, prompt, int(toks_out[i]))
+
+    def prefill_many(self, seqs: List[Sequence]) -> None:
+        """Admit several sequences, batching same-bucket single-chunk
+        prefills into one device dispatch (a burst of arrivals no longer
+        pays one serial [1, S] forward each — the MXU sees [P, S]).
+
+        Prompts needing multiple chunks fall back to the serial path.
+        """
+        ecfg = self.engine_cfg
+        chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
+        slots = self.free_slots()
+        if len(slots) < len(seqs):
+            # zip truncation would silently drop (and strand) requests.
+            raise RuntimeError(
+                f"prefill_many: {len(seqs)} sequences but only "
+                f"{len(slots)} free slots")
+        staged: List[Tuple[Sequence, List[int]]] = []
+        for seq, slot in zip(seqs, slots):
+            staged.append((seq, self._prefill_setup(seq, slot)))
+        groups: Dict[Tuple[int, bool], List[Tuple[Sequence, List[int]]]] = {}
+        for seq, prompt in staged:
+            rest = len(prompt) - seq.cached_tokens
+            if rest <= chunk_cap:
+                bucket = ecfg.bucket_for(rest)
+                use_sp = self._use_sp(seq.cached_tokens, rest, len(prompt),
+                                      bucket)
+                groups.setdefault((bucket, use_sp), []).append((seq, prompt))
+            else:
+                self._prefill_chunked(seq, prompt)
+        cap = self._prefill_batch_sizes[-1]
+        for (bucket, use_sp), group in groups.items():
+            for i in range(0, len(group), cap):
+                self._prefill_run_batched(group[i:i + cap], bucket, use_sp)
 
     def _maybe_finish(self, seq: Sequence, tok: int) -> None:
         if seq.eos_token_id is not None and tok == seq.eos_token_id:

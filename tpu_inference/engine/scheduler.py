@@ -85,9 +85,14 @@ class EngineScheduler:
     """Threaded continuous-batching loop around an InferenceEngine."""
 
     def __init__(self, engine: InferenceEngine,
-                 max_prefills_per_step: int = 1,
+                 max_prefills_per_step: Optional[int] = None,
                  idle_sleep_s: float = 0.001):
         self.engine = engine
+        if max_prefills_per_step is None:
+            # Default to the engine's batched-prefill width: a burst of
+            # arrivals shares one [P, S] dispatch instead of queueing
+            # behind P serial prefills.
+            max_prefills_per_step = engine.engine_cfg.max_prefill_batch
         self.max_prefills_per_step = max_prefills_per_step
         self.idle_sleep_s = idle_sleep_s
         self.stats = SchedulerStats()
@@ -158,39 +163,54 @@ class EngineScheduler:
             self._thread.join(timeout=timeout)
 
     def _admit(self) -> int:
-        """Prefill up to max_prefills_per_step waiting requests."""
-        admitted = 0
-        while admitted < self.max_prefills_per_step:
-            with self._lock:
-                if not self._waiting:
-                    break
+        """Admit up to max_prefills_per_step waiting requests in one
+        batched prefill dispatch (engine.prefill_many): same-bucket
+        arrivals share a [P, S] forward instead of queueing behind P
+        serial prefills."""
+        batch: List[_Pending] = []
+        reserved = 0
+        with self._lock:
+            free_slots = len(self.engine.free_slots())
+            while (len(batch) < self.max_prefills_per_step
+                   and len(batch) < free_slots and self._waiting):
                 pending = self._waiting[0]
                 if pending.seq.done:          # cancelled while queued
                     self._waiting.popleft()
                     continue
-                if not self.engine.can_admit(pending.seq):
+                # Worst-case page accounting across the whole batch —
+                # allocation happens later inside prefill_many, so each
+                # candidate must fit on top of those already selected.
+                need = self.engine._pages_reserved(pending.seq)
+                if self.engine._free_plus_evictable() < reserved + need:
                     break
                 self._waiting.popleft()
                 # Register before releasing the lock so cancel() always
                 # finds the request in _waiting or _callbacks.
                 self._callbacks[pending.seq.request_id] = pending
+                reserved += need
+                batch.append(pending)
+        if not batch:
+            return 0
+        try:
+            self.engine.prefill_many([p.seq for p in batch])
+        except Exception:  # noqa: BLE001 — keep the engine loop alive
+            import traceback
+            traceback.print_exc()
+            # Coarse failure domain: the whole batch errors (admission
+            # control makes device OOM here exceptional, not routine).
+            for pending in batch:
+                pending.seq.done, pending.seq.finish_reason = True, "error"
+                self._finish(pending.seq)   # releases pages/slot
+            return 0
+        for pending in batch:
             seq = pending.seq
-            try:
-                self.engine.prefill(seq)
-            except Exception:  # noqa: BLE001 — keep the engine loop alive
-                import traceback
-                traceback.print_exc()
-                seq.done, seq.finish_reason = True, "error"
-                self._finish(seq)   # releases pages/slot
-                continue
             self.stats.prefills += 1
             self.stats.tokens_generated += 1
             self.stats.tokens_prefix_cached += seq.cached_tokens
-            admitted += 1
             pending.on_token(seq, seq.generated[-1])
             if seq.done:
                 self._finish(seq)
-        return admitted
+        return len(batch)
 
     def _finish(self, seq: Sequence) -> None:
         with self._lock:
@@ -218,6 +238,7 @@ class EngineScheduler:
         n_out = len(seq.generated)
         return {
             "request_id": seq.request_id,
+            "finished_unix": round(time.time(), 3),
             "prompt_tokens": len(seq.prompt_tokens),
             "cached_tokens": seq.cached_tokens,
             "output_tokens": n_out,

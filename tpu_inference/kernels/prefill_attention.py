@@ -1,0 +1,159 @@
+"""Pallas paged prefill attention (flash-style online softmax over KV pages).
+
+Prefill previously gathered every page of a sequence into a contiguous
+[B, MP*page, H, D] buffer and materialized dense [B, H, S, S_kv] scores
+(models/common.py dense path) — O(S^2) HBM traffic and VMEM pressure that
+walls at long context. This kernel streams each KV page HBM->VMEM once per
+query block and folds it into running (m, l, acc) online-softmax state:
+memory is O(S·page), the gather never materializes, and both the prompt's
+own KV and any cached prefix are read from the same paged pool (the engine
+writes the current chunk's KV before attending, so pool pages are the
+single source of truth).
+
+Layout mirrors the decode kernel (kernels/paged_attention.py): grid
+(B, S/bq, MP) with the page index innermost; each instance carries a
+whole query block for every kv head — q viewed [Hkv, bq*R, D] so each
+page contributes one head-batched [bq*R, pg] MXU contraction per head.
+Causality and cache validity fuse into one mask (k_pos <= q_pos and
+k_pos < kv_len); pages entirely in the causal future or past kv_len are
+skipped via @pl.when.
+
+Reference has no analogue (client-only, SURVEY.md §0); this is the
+prefill half of the vLLM-style PagedAttention pair, re-designed for
+Mosaic/TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
+                    v_ref, out_ref, m_ref, l_ref, acc_ref, *, page_size: int,
+                    block_q: int, n_rep: int, scale: float):
+    b = pl.program_id(0)
+    qb = pl.program_id(1)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    kv_len = kv_len_ref[b]
+    q_off = q_offset_ref[b]
+    page_start = p * page_size
+    # Highest query position in this block; later pages are all-masked.
+    q_hi = q_off + qb * block_q + block_q - 1
+
+    @pl.when((page_start < kv_len) & (page_start <= q_hi))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)               # [Hkv, bq*R, D]
+        # Mosaic wants batched dot dims in matching positions: kv-head
+        # leading on both sides.
+        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
+        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # [Hkv, bq*R, pg]
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_rep
+        q_pos = q_off + qb * block_q + row
+        k_pos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where((k_pos <= q_pos) & (k_pos < kv_len), s, NEG_INF)
+
+        m_prev = m_ref[:]                                 # [Hkv, bq*R, 1]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)
+        # Fully-masked rows: exp(NEG_INF - NEG_INF) = 1; zero them.
+        pr = jnp.where(s > NEG_INF / 2, pr, 0.0)
+        o = jax.lax.dot_general(
+            pr, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # [Hkv, bq*R, D]
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(pr, axis=2, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + o
+
+    @pl.when(p == num_pages - 1)
+    def _flush():
+        denom = jnp.maximum(l_ref[:], 1e-20)
+        out_ref[0, 0] = (acc_ref[:] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, block_tables: jax.Array,
+                            kv_len: jax.Array, q_offset: jax.Array,
+                            block_q: int = 128,
+                            interpret: bool | None = None) -> jax.Array:
+    """Prefill attention over the paged KV pool.
+
+    q:            [B, S, Hq, D]  (the current chunk's queries)
+    k/v_pages:    [P, page_size, Hkv, D]  (one layer's pool; the chunk's
+                  own KV must already be written)
+    block_tables: [B, MP] int32 physical page ids (0 = trash page)
+    kv_len:       [B] total valid tokens (cached prefix + this chunk)
+    q_offset:     [B] absolute position of q[:, 0] (= prefix length)
+    Returns [B, S, Hq, D] in q.dtype.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, hq, d = q.shape
+    _, page_size, hkv, _ = k_pages.shape
+    n_rep = hq // hkv
+    mp = block_tables.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    # Largest divisor of s not exceeding block_q (buckets are usually
+    # powers of two, but any length must work — e.g. a 192 bucket).
+    bq = next(b for b in range(min(block_q, s), 0, -1) if s % b == 0)
+    n_qb = s // bq
+
+    # [B, S, Hq, D] -> [B, QB, Hkv, bq*R, D]: GQA groups contiguous so a
+    # row's kv head is row // n_rep within its block.
+    q_g = (q.reshape(b, n_qb, bq, hkv, n_rep, d)
+           .transpose(0, 1, 3, 2, 4, 5)
+           .reshape(b, n_qb, hkv, bq * n_rep, d))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,        # block_tables, kv_len, q_offset
+        grid=(b, n_qb, mp),
+        in_specs=[
+            pl.BlockSpec((1, 1, hkv, bq * n_rep, d),
+                         lambda i, qb, p, bt, kl, qo: (i, qb, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, hkv, d),
+                         lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, hkv, d),
+                         lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, hkv, bq * n_rep, d),
+            lambda i, qb, p, bt, kl, qo: (i, qb, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, bq * n_rep, 1), jnp.float32),   # running max
+            pltpu.VMEM((hkv, bq * n_rep, 1), jnp.float32),   # running sum
+            pltpu.VMEM((hkv, bq * n_rep, d), jnp.float32),   # running out
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, page_size=page_size, block_q=bq,
+                          n_rep=n_rep, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_qb, hkv, bq * n_rep, d),
+                                       q.dtype),
+        interpret=interpret,
+    )(block_tables, kv_len, q_offset, q_g, k_pages, v_pages)
+    return (out.reshape(b, n_qb, hkv, bq, n_rep, d)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(b, s, hq, d))
