@@ -38,6 +38,10 @@ def main() -> None:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel degree: ring-attention prefill "
                         "over this many devices (long prompts)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel replicas: each gets its own tp*sp "
+                        "submesh, KV pool and scheduler; requests route "
+                        "to the least-loaded replica")
     p.add_argument("--attn-backend", default="auto",
                    choices=("auto", "dense", "pallas"),
                    help="decode attention: Pallas paged kernel (TPU) or "
@@ -53,13 +57,26 @@ def main() -> None:
     p.add_argument("--debug", action="store_true",
                    help="expose the unauthenticated /debug/* endpoints "
                         "(request timelines, profiler control)")
+    p.add_argument("--check-numerics", action="store_true",
+                   help="verify params are finite + run a checkify'd "
+                        "forward before serving (catches corrupt "
+                        "checkpoints)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans: any NaN-producing op "
+                        "re-runs un-jitted and raises at the source")
     args = p.parse_args()
+
+    if args.debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
 
     from tpu_inference.server.http import build_server
 
     server = build_server(model=args.model, tokenizer=args.tokenizer,
                           checkpoint=args.checkpoint,
                           warmup=not args.no_warmup, tp=args.tp, sp=args.sp,
+                          dp=args.dp,
                           draft_model=args.draft_model,
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,
@@ -70,6 +87,10 @@ def main() -> None:
                           num_speculative_tokens=(
                               args.num_speculative_tokens
                               if args.draft_model else 0))
+    if args.check_numerics:
+        for eng in server.group.engines:
+            eng.check_numerics()
+        print("numerics check passed: params finite, forward NaN-free")
     app = server.make_app()
     web.run_app(app, host=args.host, port=args.port)
 

@@ -31,13 +31,12 @@ import itertools
 import json
 import random
 import time
-from typing import Optional
+from typing import Any, Optional
 
 from aiohttp import web
 
 from tpu_inference.config import FrameworkConfig, PRESETS
 from tpu_inference.engine.engine import InferenceEngine, Sequence
-from tpu_inference.engine.scheduler import EngineScheduler
 from tpu_inference.server.tokenizer import (IncrementalDecoder, StopMatcher,
                                             build_tokenizer)
 
@@ -47,24 +46,70 @@ def _now_iso() -> str:
             .strftime("%Y-%m-%dT%H:%M:%S.%f000Z"))
 
 
+def build_engine_group(cfg: FrameworkConfig, load_params=None,
+                       draft_cfg=None, load_draft=None) -> "EngineGroup":
+    """Construct the dp replica engines for a FrameworkConfig.
+
+    dp=1: one engine over the whole (tp, sp) mesh. dp>1: replica-per-group
+    serving — each replica gets its own tp*sp-device submesh, KV pool and
+    scheduler thread (server/replicas.py). ``load_params``/``load_draft``
+    are callables (mesh | None) -> params so checkpoints stream into each
+    replica's own device layout.
+    """
+    import jax
+
+    from tpu_inference.config import ParallelConfig
+    from tpu_inference.parallel.mesh import build_mesh
+    from tpu_inference.server.replicas import EngineGroup
+
+    pcfg = cfg.parallel
+    if pcfg.dp <= 1:
+        meshes = [build_mesh(pcfg) if pcfg.n_devices > 1 else None]
+    else:
+        per = pcfg.tp * pcfg.sp
+        devices = jax.devices()
+        if len(devices) < per * pcfg.dp:
+            raise ValueError(f"dp={pcfg.dp} replicas of {per} devices need "
+                             f"{per * pcfg.dp}; only {len(devices)} visible")
+        sub = ParallelConfig(tp=pcfg.tp, sp=pcfg.sp)
+        meshes = [build_mesh(sub, devices=devices[i * per:(i + 1) * per])
+                  for i in range(pcfg.dp)]
+    engines = []
+    for mesh in meshes:
+        params = load_params(mesh) if load_params else None
+        draft_params = (load_draft(mesh)
+                        if (load_draft and draft_cfg is not None) else None)
+        engines.append(InferenceEngine(
+            cfg.model, cfg.engine, params=params, seed=cfg.seed, mesh=mesh,
+            draft_cfg=draft_cfg, draft_params=draft_params))
+    return EngineGroup(engines)
+
+
 class InferenceServer:
-    """Engine + scheduler + tokenizer behind the Ollama HTTP protocol."""
+    """Engine replicas + schedulers + tokenizer behind the Ollama HTTP
+    protocol."""
 
     def __init__(self, cfg: FrameworkConfig,
-                 engine: Optional[InferenceEngine] = None):
+                 engine: Optional[InferenceEngine] = None,
+                 group: Optional[Any] = None,
+                 load_duration_ns: Optional[int] = None):
+        """``load_duration_ns``: time spent building engines/loading
+        weights when the caller built the group itself (build_server) —
+        it feeds the Ollama ``load_duration`` wire field."""
+        from tpu_inference.server.replicas import EngineGroup
+
         self.cfg = cfg
         t0 = time.perf_counter()
-        mesh = None
-        if engine is None and cfg.parallel.n_devices > 1:
-            from tpu_inference.parallel.mesh import build_mesh
-
-            mesh = build_mesh(cfg.parallel)
-        self.engine = engine or InferenceEngine(cfg.model, cfg.engine,
-                                                seed=cfg.seed, mesh=mesh)
+        if group is None:
+            group = (EngineGroup([engine]) if engine is not None
+                     else build_engine_group(cfg))
+        self.group = group
+        self.engine = group.engine            # primary replica (tests/bench)
         self.tokenizer = build_tokenizer(cfg.server.tokenizer,
                                          vocab_size=cfg.model.vocab_size)
-        self.load_duration_ns = int((time.perf_counter() - t0) * 1e9)
-        self.scheduler = EngineScheduler(self.engine)
+        self.load_duration_ns = (load_duration_ns if load_duration_ns
+                                 is not None else
+                                 int((time.perf_counter() - t0) * 1e9))
         self._ids = itertools.count()
 
     # ------------------------------------------------------------- app
@@ -86,12 +131,12 @@ class InferenceServer:
 
     async def _on_startup(self, app) -> None:
         if self.cfg.server.warmup:
-            secs = self.engine.warmup()
+            secs = self.group.warmup()
             print(f"engine warmup: compiled all graphs in {secs:.1f}s")
-        self.scheduler.start()
+        self.group.start()
 
     async def _on_cleanup(self, app) -> None:
-        self.scheduler.stop(drain=False)
+        self.group.stop(drain=False)
 
     # ------------------------------------------------------------- routes
 
@@ -112,7 +157,7 @@ class InferenceServer:
         }]})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        return web.json_response(self.scheduler.stats.snapshot(self.engine))
+        return web.json_response(self.group.stats_snapshot())
 
     async def handle_debug_requests(self, request: web.Request
                                     ) -> web.Response:
@@ -126,7 +171,7 @@ class InferenceServer:
                 content_type="application/json")
         if n <= 0:
             return web.json_response([])
-        return web.json_response(self.scheduler.recent_snapshot(n))
+        return web.json_response(self.group.recent_snapshot(n))
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """Start/stop a jax.profiler trace (TensorBoard / Perfetto).
@@ -269,7 +314,7 @@ class InferenceServer:
         def on_finish(s: Sequence) -> None:
             loop.call_soon_threadsafe(queue.put_nowait, ("finish", s))
 
-        self.scheduler.submit(seq, on_token, on_finish)
+        self.group.submit(seq, on_token, on_finish)
         try:
             if stream:
                 return await self._stream_response(request, queue, seq,
@@ -279,11 +324,11 @@ class InferenceServer:
                                               recv_t, chat, stop)
         except asyncio.TimeoutError:
             # Request exceeded request_timeout_s: free the slot and pages.
-            self.scheduler.cancel(rid)
+            self.group.cancel(rid)
             raise web.HTTPGatewayTimeout(text=json.dumps(
                 {"error": "request timed out"}), content_type="application/json")
         except (asyncio.CancelledError, ConnectionResetError):
-            self.scheduler.cancel(rid)
+            self.group.cancel(rid)
             raise
 
     # ------------------------------------------------------------- helpers
@@ -373,7 +418,7 @@ class InferenceServer:
                     # stop string itself).
                     if emit:
                         await write_line(emit)
-                    self.scheduler.cancel(seq.request_id)
+                    self.group.cancel(seq.request_id)
                     return await finish(stopped=True)
                 await write_line(emit)
             else:
@@ -422,7 +467,7 @@ class InferenceServer:
                 emit, stopped = matcher.push(decoder.push(payload))
                 parts.append(emit)
                 if stopped:
-                    self.scheduler.cancel(seq.request_id)
+                    self.group.cancel(seq.request_id)
                     return respond(seq, stopped=True)
             else:
                 tail, stopped = matcher.push(decoder.flush())
@@ -434,7 +479,7 @@ class InferenceServer:
 
 def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                  checkpoint: Optional[str] = None, warmup: bool = True,
-                 tp: int = 1, sp: int = 1,
+                 tp: int = 1, sp: int = 1, dp: int = 1,
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None,
                  enable_debug: bool = False,
@@ -473,7 +518,7 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
         tokenizer = checkpoint if has_tok else "byte"
     engine_cfg = EngineConfig(**engine_overrides) if engine_overrides else EngineConfig()
     cfg = FrameworkConfig(model=model_cfg, engine=engine_cfg,
-                          parallel=ParallelConfig(tp=tp, sp=sp),
+                          parallel=ParallelConfig(dp=dp, tp=tp, sp=sp),
                           server=ServerConfig(model_name=model,
                                               tokenizer=tokenizer,
                                               warmup=warmup,
@@ -482,41 +527,36 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
     draft_cfg = None
     if draft_model:
         draft_cfg, draft_checkpoint = resolve(draft_model, draft_checkpoint)
-    params = draft_params = None
-    mesh = None
-    if cfg.parallel.n_devices > 1:
-        # Build the mesh BEFORE loading weights so checkpoints stream
-        # shard-by-shard straight into their TP layout — never an
-        # unsharded copy on host or device 0 (host-OOM at 70B scale).
-        from tpu_inference.parallel.mesh import build_mesh
+    if draft_cfg is not None and checkpoint and not draft_checkpoint:
+        # Trained target + random draft = ~zero acceptance: every
+        # round pays draft+verify to emit one token. Refuse loudly.
+        raise ValueError(
+            "--draft-model with --checkpoint requires "
+            "--draft-checkpoint: a random-weight draft makes "
+            "speculative decoding a pure slowdown")
 
-        mesh = build_mesh(cfg.parallel)
+    def _loader(mcfg, path):
+        """(mesh | None) -> params: checkpoints stream per-replica so each
+        replica's leaves land directly in ITS device layout — never an
+        unsharded copy on host or device 0 (host-OOM at 70B scale)."""
+        def load(mesh):
+            from tpu_inference.models import weights
 
-    def _load(mcfg, path):
-        from tpu_inference.models import weights
+            shardings = None
+            if mesh is not None:
+                from tpu_inference.parallel import shardings as shd
 
-        shardings = None
-        if mesh is not None:
-            from tpu_inference.parallel import shardings as shd
+                shardings = shd.param_shardings(mcfg, mesh)
+            return weights.load_checkpoint(mcfg, path, shardings=shardings)
 
-            shardings = shd.param_shardings(mcfg, mesh)
-        return weights.load_checkpoint(mcfg, path, shardings=shardings)
+        return load
 
-    if checkpoint:
-        params = _load(model_cfg, checkpoint)
-    if draft_cfg is not None:
-        if draft_checkpoint:
-            draft_params = _load(draft_cfg, draft_checkpoint)
-        elif checkpoint:
-            # Trained target + random draft = ~zero acceptance: every
-            # round pays draft+verify to emit one token. Refuse loudly.
-            raise ValueError(
-                "--draft-model with --checkpoint requires "
-                "--draft-checkpoint: a random-weight draft makes "
-                "speculative decoding a pure slowdown")
-    if params is not None or draft_cfg is not None or mesh is not None:
-        engine = InferenceEngine(model_cfg, engine_cfg, params=params,
-                                 mesh=mesh, draft_cfg=draft_cfg,
-                                 draft_params=draft_params)
-        return InferenceServer(cfg, engine=engine)
-    return InferenceServer(cfg)
+    t0 = time.perf_counter()
+    group = build_engine_group(
+        cfg,
+        load_params=_loader(model_cfg, checkpoint) if checkpoint else None,
+        draft_cfg=draft_cfg,
+        load_draft=(_loader(draft_cfg, draft_checkpoint)
+                    if draft_checkpoint else None))
+    load_ns = int((time.perf_counter() - t0) * 1e9)
+    return InferenceServer(cfg, group=group, load_duration_ns=load_ns)

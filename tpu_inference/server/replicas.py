@@ -1,0 +1,118 @@
+"""Data-parallel replica serving: dp independent engines behind one facade.
+
+``ParallelConfig.dp`` used to replicate params inside ONE engine (useful
+for the sharding dry-run, useless for throughput: one scheduler, one
+decode batch). True dp serving is replica-per-group — each replica owns a
+``tp*sp``-device submesh, its own KV pool, and its own continuous-batching
+scheduler thread; the HTTP layer routes each request to the least-loaded
+replica. The reference's analogue is the load balancer in front of its
+external endpoint (implicit, out of repo — SURVEY.md §0); here it is
+in-process.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.scheduler import EngineScheduler
+
+
+class EngineGroup:
+    """dp EngineSchedulers with least-loaded request routing.
+
+    With one engine this is a transparent pass-through, so the server
+    always talks to an EngineGroup.
+    """
+
+    def __init__(self, engines: List[InferenceEngine]):
+        assert engines
+        self.engines = engines
+        self.schedulers = [EngineScheduler(e) for e in engines]
+        # request_id -> scheduler that owns it (ids are globally unique).
+        self._owner = {}
+
+    @property
+    def engine(self) -> InferenceEngine:
+        """Primary replica (single-engine callers, tests)."""
+        return self.engines[0]
+
+    def warmup(self) -> float:
+        return sum(e.warmup() for e in self.engines)
+
+    def start(self) -> "EngineGroup":
+        for s in self.schedulers:
+            s.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        for s in self.schedulers:
+            s.stop(drain=drain, timeout=timeout)
+
+    def _least_loaded(self) -> EngineScheduler:
+        def load(s: EngineScheduler) -> int:
+            return len(s._waiting) + len(s.engine.active_sequences())
+
+        return min(self.schedulers, key=load)
+
+    def submit(self, seq: Sequence, on_token: Callable,
+               on_finish: Callable) -> None:
+        sched = self._least_loaded()
+        self._owner[seq.request_id] = sched
+
+        def done(s: Sequence) -> None:
+            self._owner.pop(s.request_id, None)
+            on_finish(s)
+
+        sched.submit(seq, on_token, done)
+
+    def cancel(self, request_id: int) -> None:
+        # Pop (not get): a request cancelled while still QUEUED never
+        # reaches _finish/on_finish, so the owner entry must be released
+        # here or it leaks one dict entry per timed-out/disconnected
+        # request. Double-pop from a later on_finish is harmless.
+        sched = self._owner.pop(request_id, None)
+        if sched is not None:
+            sched.cancel(request_id)
+
+    def recent_snapshot(self, n: int) -> List[dict]:
+        """Most recent n finished-request timelines ACROSS replicas
+        (merged by completion time — a plain tail would show only the
+        last replica's view)."""
+        items: List[dict] = []
+        for s in self.schedulers:
+            items.extend(s.recent_snapshot(n))
+        items.sort(key=lambda t: t.get("finished_unix", 0.0))
+        return items[-n:]
+
+    # Per-chip gauges that must not be summed across replicas.
+    _NON_ADDITIVE = ("model_params", "approx_flops_per_token",
+                     "mean_batch_occupancy", "kv_pages_total")
+
+    def stats_snapshot(self) -> dict:
+        """Aggregate counters + per-replica breakdown."""
+        per = [s.stats.snapshot(s.engine) for s in self.schedulers]
+        if len(per) == 1:
+            return per[0]
+        agg = dict(per[0])
+        for d in per[1:]:
+            for k, v in d.items():
+                if (k in self._NON_ADDITIVE or isinstance(v, bool)
+                        or not isinstance(v, (int, float))):
+                    continue
+                agg[k] = agg.get(k, 0) + v
+        agg["mean_batch_occupancy"] = (
+            sum(d["mean_batch_occupancy"] for d in per) / len(per))
+        if "prefix_cache" in per[0]:
+            agg["prefix_cache"] = {
+                k: sum(d["prefix_cache"][k] for d in per)
+                for k in per[0]["prefix_cache"]}
+        if "speculative" in per[0]:
+            drafted = sum(d["speculative"]["drafted"] for d in per)
+            accepted = sum(d["speculative"]["accepted"] for d in per)
+            agg["speculative"] = {
+                "drafted": drafted, "accepted": accepted,
+                "acceptance_rate": (accepted / drafted) if drafted else 0.0}
+        agg["replicas"] = per
+        agg["dp"] = len(per)
+        return agg
