@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import gc
 import json
+import os
 import sys
 import time
 import traceback
@@ -161,21 +162,45 @@ def main() -> None:
     }))
 
 
-if __name__ == "__main__":
+def _supervise() -> None:
+    """Watchdog: run the measurement in a CHILD process with a hard
+    timeout + retries. The TPU tunnel's failure mode is a HANG (a dead
+    relay blocks ``import jax`` inside the axon plugin registration), so
+    an in-process try/except can never fire — only killing the process
+    works."""
+    import subprocess
+
     attempts = 3
     for i in range(attempts):
         try:
-            main()
-            break
-        except Exception as e:  # noqa: BLE001 — retry transient TPU failures
-            traceback.print_exc()
-            if i + 1 == attempts:
-                print(json.dumps({"metric": "decode_tok_s_llama1b_bs8_pallas",
-                                  "value": None, "unit": "tokens/s",
-                                  "vs_baseline": None,
-                                  "error": f"{type(e).__name__}: {e}"}))
-                sys.exit(1)
-            wait = 15 * (i + 1)
-            print(f"[bench] attempt {i + 1} failed; retrying in {wait}s",
+            rc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                timeout=1200).returncode
+        except subprocess.TimeoutExpired:
+            rc = -1
+            print(f"[bench] attempt {i + 1} timed out (hung TPU tunnel?)",
                   file=sys.stderr)
-            time.sleep(wait)
+        if rc == 0:
+            return
+        if i + 1 == attempts:
+            print(json.dumps({"metric": "decode_tok_s_llama1b_bs8_pallas",
+                              "value": None, "unit": "tokens/s",
+                              "vs_baseline": None,
+                              "error": f"all {attempts} attempts failed "
+                                       f"(last rc={rc})"}))
+            sys.exit(1)
+        wait = 20 * (i + 1)
+        print(f"[bench] attempt {i + 1} failed (rc={rc}); retrying in "
+              f"{wait}s", file=sys.stderr)
+        time.sleep(wait)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        try:
+            main()
+        except Exception:  # noqa: BLE001 — parent retries
+            traceback.print_exc()
+            sys.exit(2)
+    else:
+        _supervise()

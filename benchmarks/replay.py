@@ -174,7 +174,7 @@ def main() -> dict:
         replay_s = time.perf_counter() - t0
         summary = summarize(metrics, n_chips=args.tp)
         summary["replay_s"] = round(replay_s, 3)
-        summary["server_stats"] = srv.scheduler.stats.snapshot(srv.engine)
+        summary["server_stats"] = srv.group.stats_snapshot()
     finally:
         stop()
 

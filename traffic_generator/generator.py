@@ -105,7 +105,12 @@ class TrafficGenerator:
                                  self._count_tokens(buf or last_line,
                                                     n_lines))
                 collector.record(query_id, "success", True)
-                print(f"[END] query {query_id}")
+                end = collector.metrics[query_id]["response_end_time"]
+                start = collector.metrics[query_id].get(
+                    "request_start_time", end)
+                # Per-request turnaround line (reference main.py:267).
+                print(f"[END] ID: {query_id}, End: {end:.1f}, "
+                      f"turnaround: {end - start:.1f}")
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             # ClientError covers response/connection AND payload errors
             # (mid-stream resets); one failed query must never abort the

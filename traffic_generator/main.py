@@ -28,10 +28,12 @@ config = {
     "data_path": "data/conversations.json",
     "max_trace": 100,
     "url": "http://127.0.0.1:11434/api/generate",
+    "no_proxy": "",           # set NO_PROXY for LAN endpoints (main.py:307)
     "model": "tiny-llama",
     "temperature": 0.0,
     "max_tokens": None,       # None -> per-query length from the trace
     "stream": True,
+    "save_log": True,         # reference main.py:311 (there: declared only)
     "log_path": "logs/log.json",
 }
 
@@ -52,6 +54,8 @@ def parse_args() -> dict:
 
 def main() -> dict:
     cfg = {**config, **{k: v for k, v in parse_args().items() if v is not None}}
+    if cfg.get("no_proxy"):
+        os.environ["NO_PROXY"] = cfg["no_proxy"]
     data = DataLoader.get_data_from_path(cfg["data_path"])
     schedule = Scheduler.get_schedule_from_trace(cfg["trace_path"],
                                                  cfg["max_trace"])
@@ -62,9 +66,10 @@ def main() -> dict:
                                  max_gen_len=MAX_GEN_LEN)
     metrics = generator.start_profile()
     print(metrics)
-    log_path = cfg["log_path"]
-    os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
-    collector.save(log_path)
+    if cfg.get("save_log", True):
+        log_path = cfg["log_path"]
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        collector.save(log_path)
     return metrics
 
 
