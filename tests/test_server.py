@@ -407,3 +407,24 @@ def test_dp_replica_serving():
         assert all(r["requests_finished"] >= 1 for r in stats["replicas"])
 
     _run(srv, scenario)
+
+
+def test_engine_group_cancel_releases_owner():
+    """Cancelling a QUEUED request must release its EngineGroup owner
+    entry (the finish callback never fires for queued cancels)."""
+    from tpu_inference.engine.engine import Sequence
+    from tpu_inference.server.replicas import EngineGroup
+    from tpu_inference.engine.engine import InferenceEngine
+
+    eng = InferenceEngine(
+        tiny_llama(vocab_size=512),
+        EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
+                     max_batch_size=1, prefill_buckets=(16,)))
+    group = EngineGroup([eng])
+    # Scheduler NOT started: submissions stay queued.
+    seq = Sequence(request_id=7, prompt_tokens=[1, 2, 3], max_new_tokens=4)
+    group.submit(seq, lambda s, t: None, lambda s: None)
+    assert 7 in group._owner
+    group.cancel(7)
+    assert 7 not in group._owner
+    assert seq.finish_reason == "cancelled"
