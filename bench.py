@@ -93,16 +93,23 @@ def run_backend(backend: str, cfg, on_tpu: bool):
                        max_new_tokens=budget)
         engine.prefill(seq)
 
-    # Timed steady-state decode: full batch, k fused steps per dispatch.
+    # Timed steady-state decode, both serving modes:
+    # sync = one host round trip per K-step call (streaming loop);
+    # chained = dispatch-ahead, device-chained carry tokens, one sync.
     for _ in range(ramp_calls):              # un-timed ramp
         engine.decode_steps()
     jax.block_until_ready(engine.kv.k)
     t0 = time.perf_counter()
     produced = 0
-    for _ in range(timed_calls):
+    for _ in range(timed_calls // 2):
         produced += sum(len(t) for t in engine.decode_steps().values())
     jax.block_until_ready(engine.kv.k)
-    dt = time.perf_counter() - t0
+    sync_tok_s = produced / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    out = engine.decode_steps_chained(timed_calls // 2)
+    produced_c = sum(len(t) for t in out.values())
+    chained_tok_s = produced_c / (time.perf_counter() - t0)
 
     mean_ctx = float(np.mean([s.ctx_len for s in engine.slots
                               if s is not None]))
@@ -111,7 +118,7 @@ def run_backend(backend: str, cfg, on_tpu: bool):
     # Free HBM before the next backend's engine materializes.
     del engine
     gc.collect()
-    return produced / dt, n_params, mean_ctx, head
+    return sync_tok_s, chained_tok_s, n_params, mean_ctx, head
 
 
 def main() -> None:
@@ -122,9 +129,10 @@ def main() -> None:
     cfg = bench_cfg(platform)
     print(f"[bench] platform={platform} model={cfg.name}", file=sys.stderr)
 
-    dense_tok_s, _, _, dense_head = run_backend("dense", cfg, on_tpu)
-    pallas_tok_s, n_params, mean_ctx, pallas_head = run_backend(
-        "pallas", cfg, on_tpu)
+    dense_tok_s, dense_chained, _, _, dense_head = run_backend(
+        "dense", cfg, on_tpu)
+    (pallas_tok_s, pallas_chained, n_params, mean_ctx,
+     pallas_head) = run_backend("pallas", cfg, on_tpu)
     if dense_head != pallas_head:
         # Greedy sampling: any drift is a correctness signal, not noise.
         print(f"[bench] WARNING: backend token mismatch "
@@ -135,26 +143,36 @@ def main() -> None:
     kv_bytes_per_token = (2 * 2 * cfg.n_layers * mean_ctx
                           * cfg.n_kv_heads * cfg.head_dim)  # K+V, bf16
     weight_bytes = 2 * n_params                              # bf16
-    steps_per_s = pallas_tok_s / batch
-    bytes_per_s = steps_per_s * (weight_bytes
-                                 + batch * kv_bytes_per_token)
     peak_flops, peak_bw = CHIP_PEAKS.get(
         jax.devices()[0].device_kind, (394e12, 819e9))
-    mfu = pallas_tok_s * flops_per_token / peak_flops
-    hbm_util = bytes_per_s / peak_bw
 
+    def util(tok_s):
+        steps_per_s = tok_s / batch
+        bw = steps_per_s * (weight_bytes + batch * kv_bytes_per_token)
+        return (round(tok_s * flops_per_token / peak_flops, 4),
+                round(bw / peak_bw, 4))
+
+    best = max(pallas_tok_s, pallas_chained)
+    mode = "dispatch-ahead" if pallas_chained >= pallas_tok_s else "sync"
+    mfu, hbm_util = util(best)
     print(json.dumps({
         "metric": "decode_tok_s_llama1b_bs8_pallas",
-        "value": round(pallas_tok_s, 2),
-        "unit": "tokens/s (aggregate, batch=8)",
+        "value": round(best, 2),
+        "unit": f"tokens/s (aggregate, batch=8, {mode})",
         # Like-for-like: per-stream rate vs the reference's single-stream 93.
-        "vs_baseline": round(pallas_tok_s / batch / BASELINE_TOK_S, 3),
-        "vs_baseline_aggregate": round(pallas_tok_s / BASELINE_TOK_S, 3),
-        "per_stream_tok_s": round(pallas_tok_s / batch, 2),
+        "vs_baseline": round(best / batch / BASELINE_TOK_S, 3),
+        "vs_baseline_aggregate": round(best / BASELINE_TOK_S, 3),
+        "per_stream_tok_s": round(best / batch, 2),
+        "sync_tok_s": round(pallas_tok_s, 2),
+        "chained_tok_s": round(pallas_chained, 2),
         "dense_tok_s": round(dense_tok_s, 2),
-        "pallas_speedup_vs_dense": round(pallas_tok_s / dense_tok_s, 3),
-        "mfu": round(mfu, 4),
-        "hbm_util": round(hbm_util, 4),
+        "dense_chained_tok_s": round(dense_chained, 2),
+        # Mode-matched kernel comparisons (sync/sync and chained/chained).
+        "pallas_speedup_vs_dense_sync": round(pallas_tok_s / dense_tok_s, 3),
+        "pallas_speedup_vs_dense_chained": round(
+            pallas_chained / dense_chained, 3),
+        "mfu": mfu,
+        "hbm_util": hbm_util,
         "mean_ctx": round(mean_ctx, 1),
         "chip": jax.devices()[0].device_kind,
         "platform": platform,

@@ -315,3 +315,34 @@ def test_check_numerics():
     engine.params = poisoned
     with pytest.raises(FloatingPointError, match="wq"):
         engine.check_numerics()
+
+
+def test_decode_steps_chained_matches_sync():
+    """Dispatch-ahead decode (device-chained carry tokens, one final
+    sync) produces exactly the synchronous loop's tokens."""
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=16,
+                             max_batch_size=4, prefill_buckets=(16,),
+                             decode_steps_per_call=4, max_new_tokens=64,
+                             enable_prefix_cache=False)
+    params, _ = build_model(model_cfg, seed=0)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 9, 12)]
+
+    sync = InferenceEngine(model_cfg, ecfg, params=params)
+    seqs_a = [Sequence(request_id=i, prompt_tokens=p, max_new_tokens=33)
+              for i, p in enumerate(prompts)]
+    for s in seqs_a:
+        sync.prefill(s)
+    for _ in range(8):
+        sync.decode_steps()
+
+    chained = InferenceEngine(model_cfg, ecfg, params=params)
+    seqs_b = [Sequence(request_id=i, prompt_tokens=p, max_new_tokens=33)
+              for i, p in enumerate(prompts)]
+    for s in seqs_b:
+        chained.prefill(s)
+    out = chained.decode_steps_chained(8)
+    assert [s.generated for s in seqs_a] == [s.generated for s in seqs_b]
+    assert sorted(out) == [0, 1, 2] and all(len(v) == 32
+                                            for v in out.values())

@@ -369,10 +369,15 @@ class InferenceEngine:
 
         k_steps = max(1, ecfg.decode_steps_per_call)
         alive0 = jnp.ones(tokens.shape, bool)
-        (kv, _, _, _), outs = jax.lax.scan(
+        (kv, final_tokens, _, _), outs = jax.lax.scan(
             step, (kv, tokens, ctx_lens, alive0),
             jnp.arange(k_steps, dtype=jnp.int32))
-        return kv, outs
+        # final_tokens [B] = each lane's carry after the last step: the
+        # input for a chained next call, letting callers dispatch call
+        # N+1 against call N's device-resident output with no host sync
+        # (dispatch-ahead, SURVEY.md §7 hard part 3 — the host/tunnel
+        # round trip otherwise gates decode throughput).
+        return kv, outs, final_tokens
 
     # ------------------------------------------------------------------
     # Host-side orchestration
@@ -423,7 +428,7 @@ class InferenceEngine:
                 jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32))
             self.kv, self.draft_kv = out.kv, out.draft_kv
         else:
-            self.kv, _ = self._decode_multi_jit(
+            self.kv, _, _ = self._decode_multi_jit(
                 self.params, self.kv, jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b, self.max_pages), jnp.int32),
@@ -805,7 +810,7 @@ class InferenceEngine:
             if seq.eos_token_id is not None:
                 eos_ids[seq.slot] = seq.eos_token_id
 
-        self.kv, outs = self._decode_multi_jit(
+        self.kv, outs, _ = self._decode_multi_jit(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
             self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
@@ -829,6 +834,80 @@ class InferenceEngine:
                 got.append(tok)
             if got:
                 result[seq.request_id] = got
+        return result
+
+    def decode_steps_chained(self, n_calls: int) -> Dict[int, List[int]]:
+        """Dispatch-ahead decode: ``n_calls`` fused-decode dispatches
+        back-to-back, each consuming the previous call's device-resident
+        final carry tokens — ZERO host syncs until the end (then one).
+
+        This removes the host/tunnel round trip from the decode critical
+        path (SURVEY.md §7 hard part 3); with K fused steps per call the
+        device runs n_calls*K tokens per lane uninterrupted. Constraints
+        of the mode: pages are pre-provisioned for the full run (raises
+        MemoryError if the pool can't hold it), EOS/budget do not stop
+        lanes early (bench / fixed-length batch mode — callers cap
+        n_calls*K by the remaining budget).
+        """
+        ecfg = self.engine_cfg
+        k_steps = max(1, ecfg.decode_steps_per_call)
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return {}
+        total = n_calls * k_steps
+        for seq in active_seqs:
+            budget = seq.max_new_tokens - len(seq.generated)
+            room = ecfg.max_context - 1 - seq.ctx_len
+            if total > min(budget, room):
+                # No mid-run stopping in this mode: the caller must size
+                # n_calls*K within every lane's budget AND context room
+                # (decode_steps folds these into `allowed` per step; here
+                # they would overflow the block table / clamp positions).
+                raise ValueError(
+                    f"decode_steps_chained: n_calls*K={total} exceeds "
+                    f"seq {seq.request_id}'s budget={budget} or context "
+                    f"room={room}")
+            need = kvc.pages_needed(total, ecfg.page_size,
+                                    already=seq.ctx_len)
+            if need > 0:
+                seq.pages.extend(self._allocate_reclaiming(need))
+
+        b = ecfg.max_batch_size
+        (tokens, ctx_lens, bts, temps, top_ps,
+         top_ks, seeds) = self._stage_batch(active_seqs)
+        allowed = np.zeros((b,), np.int32)
+        for seq in active_seqs:
+            allowed[seq.slot] = k_steps
+        no_eos = jnp.full((b,), -1, jnp.int32)
+        allowed_d = jnp.asarray(allowed)
+        bts_d = jnp.asarray(bts)
+        temps_d, top_ps_d = jnp.asarray(temps), jnp.asarray(top_ps)
+        top_ks_d, seeds_d = jnp.asarray(top_ks), jnp.asarray(seeds)
+
+        tokens_dev = jnp.asarray(tokens)
+        outs_all = []
+        for c in range(n_calls):
+            self.kv, outs, tokens_dev = self._decode_multi_jit(
+                self.params, self.kv, tokens_dev,
+                jnp.asarray(ctx_lens + c * allowed, np.int32), bts_d,
+                allowed_d, no_eos, self._next_key(), temps_d, top_ps_d,
+                top_ks_d, seeds_d)
+            outs_all.append(outs)
+        jax.block_until_ready(tokens_dev)
+
+        result: Dict[int, List[int]] = {rid.request_id: []
+                                        for rid in active_seqs}
+        for outs in outs_all:
+            outs = np.asarray(outs)
+            for seq in active_seqs:
+                got = [int(t) for t in outs[:, seq.slot] if t >= 0]
+                seq.ctx_len += len(got)
+                seq.generated.extend(got)
+                if seq.first_token_time == 0.0:
+                    seq.first_token_time = time.perf_counter()
+                result[seq.request_id].extend(got)
+        for seq in active_seqs:
+            self._maybe_finish(seq, seq.last_token)
         return result
 
     def _spec_decode_steps(self, max_steps: Optional[int] = None

@@ -121,9 +121,11 @@ class TrafficGenerator:
     async def issue_queries(self) -> dict:
         timeout = aiohttp.ClientTimeout(
             total=float(self.config.get("request_timeout", 600.0)))
+        # trust_env so NO_PROXY/HTTP(S)_PROXY are honored (the reference's
+        # `no_proxy` config key / commented NO_PROXY export, main.py:316).
         async with aiohttp.ClientSession(
                 trace_configs=[self.logger.trace_config],
-                timeout=timeout) as session:
+                timeout=timeout, trust_env=True) as session:
             calls = []
             for _ in range(len(self.queries)):
                 prompt, len_p, len_g, qid, t = self.queries.get_query()
