@@ -95,6 +95,7 @@ def start_server(args) -> tuple:
         max_batch_size=args.max_batch_size, num_pages=args.num_pages,
         page_size=args.page_size, max_pages_per_seq=args.max_pages_per_seq,
         decode_steps_per_call=args.decode_steps_per_call,
+        decode_pipeline_depth=args.decode_pipeline_depth,
         num_speculative_tokens=(args.num_speculative_tokens
                                 if args.draft_model else 0))
     loop = asyncio.new_event_loop()
@@ -147,6 +148,7 @@ def main() -> dict:
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-pages-per-seq", type=int, default=64)
     p.add_argument("--decode-steps-per-call", type=int, default=8)
+    p.add_argument("--decode-pipeline-depth", type=int, default=1)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")

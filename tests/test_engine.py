@@ -346,3 +346,56 @@ def test_decode_steps_chained_matches_sync():
     assert [s.generated for s in seqs_a] == [s.generated for s in seqs_b]
     assert sorted(out) == [0, 1, 2] and all(len(v) == 32
                                             for v in out.values())
+
+
+def test_decode_steps_pipelined_matches_sync():
+    """Depth-2 dispatch-ahead serving loop == synchronous loop: same
+    tokens, same finish reasons, with EOS stops, different budgets, and a
+    mid-flight join."""
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+
+    def run(depth):
+        ecfg = cfgs.EngineConfig(
+            page_size=8, num_pages=128, max_pages_per_seq=16,
+            max_batch_size=4, prefill_buckets=(16,),
+            decode_steps_per_call=4, decode_pipeline_depth=depth,
+            enable_prefix_cache=False)
+        params, _ = build_model(model_cfg, seed=0)
+        engine = InferenceEngine(model_cfg, ecfg, params=params)
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 9)]
+        seqs = [Sequence(request_id=0, prompt_tokens=prompts[0],
+                         max_new_tokens=30, eos_token_id=7),
+                Sequence(request_id=1, prompt_tokens=prompts[1],
+                         max_new_tokens=11)]
+        for s in seqs:
+            engine.prefill(s)
+        joined = False
+        tokens_out = {0: list(seqs[0].generated), 1: list(seqs[1].generated)}
+        for it in range(40):
+            out = engine.decode_steps_pipelined()
+            for rid, toks in out.items():
+                tokens_out.setdefault(rid, []).extend(toks)
+            if it == 2 and not joined:
+                s3 = Sequence(request_id=2,
+                              prompt_tokens=rng.integers(
+                                  0, 256, size=6).tolist(),
+                              max_new_tokens=9)
+                # Same join prompt each run (rng consumed identically).
+                engine.prefill(s3)
+                seqs.append(s3)
+                tokens_out[2] = list(s3.generated)
+            if all(s.done for s in seqs) and not engine.pipeline_pending:
+                break
+        for rid, toks in engine.drain_pipeline().items():
+            tokens_out[rid].extend(toks)
+        return ([s.generated for s in seqs],
+                [s.finish_reason for s in seqs], tokens_out)
+
+    gen_sync, fin_sync, out_sync = run(depth=1)
+    gen_pipe, fin_pipe, out_pipe = run(depth=2)
+    assert gen_sync == gen_pipe
+    assert fin_sync == fin_pipe
+    # Delivered token streams match the recorded generations.
+    for i, gen in enumerate(gen_pipe):
+        assert out_pipe[i] == gen

@@ -428,3 +428,35 @@ def test_engine_group_cancel_releases_owner():
     group.cancel(7)
     assert 7 not in group._owner
     assert seq.finish_reason == "cancelled"
+
+
+def test_pipelined_serving_contract():
+    """Serving with decode_pipeline_depth=2 (dispatch-ahead) keeps the
+    wire contract and greedy determinism."""
+    cfg = FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=8,
+                            max_batch_size=4, prefill_buckets=(16, 32),
+                            decode_steps_per_call=4,
+                            decode_pipeline_depth=2),
+        server=ServerConfig(model_name="t", tokenizer="byte"))
+    srv = InferenceServer(cfg)
+
+    async def go(client):
+        outs = []
+        for _ in range(2):
+            resp = await client.post("/api/generate", json={
+                "prompt": "pipelined", "stream": False, "max_tokens": 9,
+                "temperature": 0.0})
+            body = await resp.json()
+            assert body["done"] and body["eval_count"] == 9
+            outs.append(body["context"])
+        assert outs[0] == outs[1]
+        bodies = await asyncio.gather(*[client.post("/api/generate", json={
+            "prompt": f"c{i}", "stream": False, "max_tokens": 5})
+            for i in range(5)])
+        for r in bodies:
+            b = await r.json()
+            assert b["done"] and b["eval_count"] >= 1
+
+    _run(srv, go)

@@ -188,6 +188,13 @@ class EngineConfig:
     # steady-state decode throughput by up to K. Streamed tokens are
     # flushed every K steps (latency cost: K * per-step time).
     decode_steps_per_call: int = 8
+    # Decode dispatch pipeline depth: >1 keeps that many fused-decode
+    # calls in flight (later calls consume earlier calls' device-resident
+    # carry tokens), hiding host round-trip/dispatch latency behind
+    # device compute. Costs up to (depth-1)*K extra speculative steps
+    # for lanes that stop mid-flight (their tokens are discarded) and
+    # adds (depth-1)*K steps of streaming latency. 1 = fully synchronous.
+    decode_pipeline_depth: int = 1
     # Sampling defaults (overridable per request).
     temperature: float = 0.0          # 0 => greedy
     top_k: int = 0                    # 0 => disabled

@@ -224,6 +224,8 @@ class InferenceEngine:
         self._base_key = jax.random.PRNGKey(seed)
         self._step_count = 0
         self.slots: List[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
+        # Dispatch-ahead decode pipeline (decode_steps_pipelined).
+        self._inflight: List[dict] = []
 
         self._prefill_jit = jax.jit(
             partial(self._prefill_fn), donate_argnums=(1,))
@@ -503,6 +505,55 @@ class InferenceEngine:
             self.prefix_cache.evict(short)
         return self.allocator.allocate(n)
 
+    def _grant_decode_steps(self, seq: Sequence, k_steps: int,
+                            pred_ctx: Optional[int] = None,
+                            pred_done: Optional[int] = None) -> int:
+        """Steps this lane may advance in one fused call — folds the
+        generation budget, the context cap, and KV-page headroom — and
+        allocates the pages it needs. ``pred_*`` override ctx/generated
+        with predicted values while dispatch-ahead calls are in flight.
+        Shared by the sync and pipelined decode paths so grant semantics
+        can't diverge."""
+        ecfg = self.engine_cfg
+        ctx = seq.ctx_len if pred_ctx is None else pred_ctx
+        done = len(seq.generated) if pred_done is None else pred_done
+        budget = seq.max_new_tokens - done
+        # From ctx c the host keeps at most max_context - 1 - c tokens
+        # (_maybe_finish caps at ctx + 1 >= max_context); granting more
+        # would waste a forward pass + KV write per capped sequence.
+        room = ecfg.max_context - 1 - ctx
+        steps = max(0, min(k_steps, budget, room))
+        if steps > 0:
+            need = kvc.pages_needed(steps, ecfg.page_size, already=ctx)
+            grantable = self._free_plus_evictable()
+            if need > grantable:
+                # Pool pressure: advance only as far as the slack in the
+                # current last page plus the pages we can still grant.
+                slack = len(seq.pages) * ecfg.page_size - ctx
+                steps = min(steps, slack + grantable * ecfg.page_size)
+                need = (kvc.pages_needed(steps, ecfg.page_size,
+                                         already=ctx)
+                        if steps > 0 else 0)
+            if need > 0:
+                seq.pages.extend(self._allocate_reclaiming(need))
+        return steps
+
+    def _fold_lane(self, seq: Sequence, toks) -> List[int]:
+        """Fold device-produced tokens (iterable of ints, -1 = no token)
+        into one sequence's host state; stops at done/-1. Shared by every
+        decode sync path."""
+        got: List[int] = []
+        for tok in toks:
+            if seq.done or tok < 0:
+                break
+            seq.ctx_len += 1
+            seq.generated.append(tok)
+            if seq.first_token_time == 0.0:
+                seq.first_token_time = time.perf_counter()
+            self._maybe_finish(seq, tok)
+            got.append(tok)
+        return got
+
     def can_admit(self, seq: Sequence) -> bool:
         return bool(self.free_slots()) and (
             self._free_plus_evictable() >= self._pages_reserved(seq))
@@ -757,6 +808,12 @@ class InferenceEngine:
         ``_maybe_finish`` stays the source of truth for finish state.
         ``max_steps`` additionally caps every lane (decode_step uses 1).
         """
+        if self._inflight:
+            # Mixing entry points: fold any dispatch-ahead state first so
+            # ctx/pages bookkeeping stays consistent (tokens surface in
+            # seq.generated; callers that care use decode_steps_pipelined
+            # exclusively).
+            self.drain_pipeline()
         if self.spec_enabled:
             return self._spec_decode_steps(max_steps)
         ecfg = self.engine_cfg
@@ -770,26 +827,7 @@ class InferenceEngine:
 
         allowed_by_slot: Dict[int, int] = {}
         for seq in active_seqs:
-            budget = seq.max_new_tokens - len(seq.generated)
-            # From ctx c the host keeps at most max_context - 1 - c tokens
-            # (_maybe_finish caps at ctx + 1 >= max_context); granting more
-            # would waste a forward pass + KV write per capped sequence.
-            room = ecfg.max_context - 1 - seq.ctx_len
-            steps = max(0, min(k_steps, budget, room))
-            if steps > 0:
-                need = kvc.pages_needed(steps, ecfg.page_size,
-                                        already=seq.ctx_len)
-                grantable = self._free_plus_evictable()
-                if need > grantable:
-                    # Pool pressure: advance only as far as the slack in the
-                    # current last page plus the pages we can still grant.
-                    slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
-                    steps = min(steps, slack + grantable * ecfg.page_size)
-                    need = (kvc.pages_needed(steps, ecfg.page_size,
-                                             already=seq.ctx_len)
-                            if steps > 0 else 0)
-                if need > 0:
-                    seq.pages.extend(self._allocate_reclaiming(need))
+            steps = self._grant_decode_steps(seq, k_steps)
             if steps <= 0:
                 # No budget/room should have finished already; pool
                 # exhaustion with zero slack fails the sequence safely.
@@ -819,21 +857,139 @@ class InferenceEngine:
 
         result: Dict[int, List[int]] = {}
         for seq in active_seqs:
-            got: List[int] = []
-            for s_idx in range(k_steps):
-                if seq.done:
-                    break
-                tok = int(outs[s_idx, seq.slot])
-                if tok < 0:
-                    break
-                seq.ctx_len += 1
-                seq.generated.append(tok)
-                if seq.first_token_time == 0.0:
-                    seq.first_token_time = time.perf_counter()
-                self._maybe_finish(seq, tok)
-                got.append(tok)
+            got = self._fold_lane(
+                seq, (int(outs[s, seq.slot]) for s in range(k_steps)))
             if got:
                 result[seq.request_id] = got
+        return result
+
+    # ------------------------------------------------------------------
+    # Pipelined decode (dispatch-ahead serving loop)
+    # ------------------------------------------------------------------
+
+    def _stage_decode_call(self):
+        """Stage one fused-decode dispatch from current host state plus
+        the ctx deltas of still-in-flight calls (predicted ctx).
+
+        Returns None when nothing can advance. Page/budget/room logic
+        mirrors decode_steps, evaluated at the predicted positions; lanes
+        that stop mid-flight (EOS) waste at most their staged steps,
+        whose tokens the sync step discards (KV garbage at dead positions
+        is always rewritten by a later owner before being attended).
+        """
+        ecfg = self.engine_cfg
+        k_steps = max(1, ecfg.decode_steps_per_call)
+        # Predicted per-slot ctx advance from unsynced calls.
+        ahead: Dict[int, int] = {}
+        for call in self._inflight:
+            for slot, steps in call["allowed"].items():
+                ahead[slot] = ahead.get(slot, 0) + steps
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return None
+        allowed_by_slot: Dict[int, int] = {}
+        staged: List[Sequence] = []
+        for seq in active_seqs:
+            lag = ahead.get(seq.slot, 0)
+            steps = self._grant_decode_steps(
+                seq, k_steps, pred_ctx=seq.ctx_len + lag,
+                pred_done=len(seq.generated) + lag)
+            if steps <= 0:
+                if lag == 0:
+                    # Nothing in flight can finish it and the pool has
+                    # zero slack: fail the sequence (decode_steps's oom
+                    # semantics). Budget/room exhaustion can't land here
+                    # — _maybe_finish already marked those done.
+                    seq.done, seq.finish_reason = True, "oom"
+                    seq.finish_time = time.perf_counter()
+                continue                      # ahead calls may still emit
+            allowed_by_slot[seq.slot] = steps
+            staged.append(seq)
+        if not staged:
+            return None
+
+        b = ecfg.max_batch_size
+        (tokens, ctx_lens, bts, temps, top_ps,
+         top_ks, seeds) = self._stage_batch(active_seqs)
+        allowed = np.zeros((b,), np.int32)
+        eos_ids = np.full((b,), -1, np.int32)
+        for seq in staged:
+            allowed[seq.slot] = allowed_by_slot[seq.slot]
+            ctx_lens[seq.slot] = seq.ctx_len + ahead.get(seq.slot, 0)
+            if seq.eos_token_id is not None:
+                eos_ids[seq.slot] = seq.eos_token_id
+        tokens_d = jnp.asarray(tokens)
+        # Each continuing lane consumes the carry token of the NEWEST
+        # in-flight call that advanced it (oldest-to-newest fold: later
+        # calls overwrite); lanes in no in-flight call (fresh prefills)
+        # keep their host-known last token.
+        for call in self._inflight:
+            carried = np.zeros((b,), bool)
+            for slot in call["allowed"]:
+                carried[slot] = True
+            tokens_d = jnp.where(jnp.asarray(carried), call["final"],
+                                 tokens_d)
+        self.kv, outs, final = self._decode_multi_jit(
+            self.params, self.kv, tokens_d, jnp.asarray(ctx_lens),
+            jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
+            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.asarray(top_ks), jnp.asarray(seeds))
+        return {"outs": outs, "final": final,
+                "allowed": allowed_by_slot,
+                "seqs": {s.slot: s for s in staged}}
+
+    def _sync_oldest(self) -> Dict[int, List[int]]:
+        """Block on the oldest in-flight call and fold its tokens into
+        host state; tokens for lanes that finished in an earlier call are
+        discarded (their compute was speculative)."""
+        call = self._inflight.pop(0)
+        outs = np.asarray(call["outs"])               # [K, B]
+        result: Dict[int, List[int]] = {}
+        for slot, seq in call["seqs"].items():
+            if seq.done or self.slots[seq.slot] is not seq:
+                continue
+            got = self._fold_lane(
+                seq, (int(outs[s, slot]) for s in range(outs.shape[0])))
+            if got:
+                result[seq.request_id] = got
+        return result
+
+    def decode_steps_pipelined(self) -> Dict[int, List[int]]:
+        """Dispatch-ahead serving step: keep up to
+        ``decode_pipeline_depth`` fused-decode calls in flight; sync only
+        the oldest. Token delivery lags dispatch by depth-1 calls, and
+        device compute overlaps all host work in between.
+        Falls back to the synchronous path when depth <= 1 or spec is on.
+        """
+        depth = self.engine_cfg.decode_pipeline_depth
+        if depth <= 1 or self.spec_enabled:
+            return self.decode_steps()
+        call = self._stage_decode_call()
+        if call is not None:
+            self._inflight.append(call)
+        if not self._inflight:
+            return {}
+        if len(self._inflight) >= depth or call is None:
+            return self._sync_oldest()
+        return {}
+
+    @property
+    def pipeline_pending(self) -> bool:
+        return bool(self._inflight)
+
+    def abort_pipeline(self) -> None:
+        """Discard in-flight calls WITHOUT folding (decode-error
+        recovery): after an error their outputs are suspect, and leaving
+        stale entries would poison ctx prediction / carry tokens for
+        whatever request reuses those slots next."""
+        self._inflight.clear()
+
+    def drain_pipeline(self) -> Dict[int, List[int]]:
+        """Sync every in-flight call (idle/finish/shutdown path)."""
+        result: Dict[int, List[int]] = {}
+        while self._inflight:
+            for rid, toks in self._sync_oldest().items():
+                result.setdefault(rid, []).extend(toks)
         return result
 
     def decode_steps_chained(self, n_calls: int) -> Dict[int, List[int]]:

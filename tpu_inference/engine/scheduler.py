@@ -252,13 +252,23 @@ class EngineScheduler:
             if n_out > 1 else None,
         }
 
+    def _deliver(self, new_tokens: Dict[int, List[int]]) -> None:
+        for rid, toks in new_tokens.items():
+            pending = self._callbacks.get(rid)
+            if pending is not None:
+                for tok in toks:
+                    pending.on_token(pending.seq, tok)
+
     def run(self) -> None:
         engine = self.engine
         while not self._stop.is_set():
             self._admit()
             active = engine.active_sequences()
             if not active:
-                # Reap cancelled-in-flight sequences even when idle.
+                # Flush any dispatch-ahead calls, then reap
+                # cancelled-in-flight sequences even when idle.
+                if engine.pipeline_pending:
+                    self._deliver(engine.drain_pipeline())
                 for s in [s for s in engine.slots if s is not None and s.done]:
                     self._finish(s)
                 if not self._waiting:
@@ -269,27 +279,32 @@ class EngineScheduler:
                 continue
 
             try:
-                new_tokens = engine.decode_steps()
+                new_tokens = engine.decode_steps_pipelined()
             except Exception:  # noqa: BLE001 — keep the engine loop alive
                 import traceback
                 traceback.print_exc()
-                for s in active:
+                engine.abort_pipeline()   # stale in-flight state would
+                for s in active:          # poison reused slots
                     s.done, s.finish_reason = True, "error"
                     s.finish_time = time.perf_counter()
                     self._finish(s)
                 continue
             self.stats.steps += 1
             self.stats.batch_occupancy_sum += len(active)
+            done_seqs = [s for s in engine.slots if s is not None and s.done]
+            if done_seqs and engine.pipeline_pending:
+                # A finish releases pages a newer in-flight call may still
+                # write: drain first so release happens against settled
+                # device state, and deliver the drained tokens too.
+                extra = engine.drain_pipeline()
+                for rid, toks in extra.items():
+                    new_tokens.setdefault(rid, []).extend(toks)
             self.stats.tokens_generated += sum(
                 len(toks) for toks in new_tokens.values())
             in_use = (engine.engine_cfg.num_pages - 1) - engine.allocator.num_free
             self.stats.peak_pages_in_use = max(self.stats.peak_pages_in_use,
                                                in_use)
 
-            for rid, toks in new_tokens.items():
-                pending = self._callbacks.get(rid)
-                if pending is not None:
-                    for tok in toks:
-                        pending.on_token(pending.seq, tok)
+            self._deliver(new_tokens)
             for s in [s for s in engine.slots if s is not None and s.done]:
                 self._finish(s)

@@ -53,6 +53,10 @@ def main() -> None:
                    help="HF safetensors dir for the draft model (required "
                         "when --checkpoint is set)")
     p.add_argument("--num-speculative-tokens", type=int, default=4)
+    p.add_argument("--decode-pipeline-depth", type=int, default=1,
+                   help=">1 keeps that many fused-decode dispatches in "
+                        "flight (hides dispatch latency; adds (depth-1)*K "
+                        "steps of streaming latency)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--debug", action="store_true",
                    help="expose the unauthenticated /debug/* endpoints "
@@ -84,6 +88,7 @@ def main() -> None:
                           max_batch_size=args.max_batch_size,
                           num_pages=args.num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
+                          decode_pipeline_depth=args.decode_pipeline_depth,
                           num_speculative_tokens=(
                               args.num_speculative_tokens
                               if args.draft_model else 0))
