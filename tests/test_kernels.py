@@ -94,6 +94,29 @@ def test_engine_pallas_backend_matches_dense():
     assert got_d == got_p
 
 
+def test_engine_pallas_backend_mixtral_sharded_matches_dense():
+    """MoE (expert-parallel) engine under a tp mesh with the Pallas
+    decode+prefill kernels == dense single-device."""
+    from tpu_inference.parallel.mesh import build_mesh
+
+    model_cfg = cfgs.tiny_mixtral(vocab_size=256)
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=16,
+                             max_batch_size=4, prefill_buckets=(16, 32),
+                             decode_steps_per_call=4)
+    params, _ = build_model(model_cfg, seed=0)
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 18)]
+
+    dense = InferenceEngine(model_cfg, ecfg, params=params,
+                            attn_backend="dense")
+    got_d = dense.generate(prompts, max_new_tokens=8)
+    mesh = build_mesh(cfgs.ParallelConfig(tp=2))
+    pallas = InferenceEngine(model_cfg, ecfg, params=params,
+                             attn_backend="pallas", mesh=mesh)
+    got_p = pallas.generate(prompts, max_new_tokens=8)
+    assert got_d == got_p
+
+
 def test_engine_pallas_backend_sharded_matches_dense():
     """Pallas decode under a dp×tp mesh (shard_map over tp) == dense.
 

@@ -9,3 +9,7 @@ dense jnp reference paths in models/common.py.
 """
 
 from tpu_inference.kernels.paged_attention import paged_attention  # noqa: F401
+from tpu_inference.kernels.prefill_attention import (  # noqa: F401
+    paged_prefill_attention)
+from tpu_inference.kernels.ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_local)
