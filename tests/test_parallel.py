@@ -141,6 +141,24 @@ def test_sp_engine_ring_prefill_matches_unsharded():
     assert got == want
 
 
+def test_sp_long_context_prefill():
+    """Long-context serving: a 2k-token prompt prefills through ring
+    attention (sp=4) with per-chip sequence shards and decodes on the
+    paged pool, token-equal to the unsharded engine."""
+    cfg = tp_llama_cfg()
+    ecfg = EngineConfig(page_size=16, num_pages=320, max_pages_per_seq=160,
+                        max_batch_size=2, prefill_buckets=(256, 2048))
+    prompt = [(7 * i + 3) % cfg.vocab_size for i in range(2048)]
+
+    base = InferenceEngine(cfg, ecfg, seed=0)
+    want = base.generate([prompt], max_new_tokens=4)
+
+    mesh = build_mesh(ParallelConfig(tp=2, sp=4))
+    eng = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+    got = eng.generate([prompt], max_new_tokens=4)
+    assert got == want
+
+
 def test_dp_tp_mesh_shapes():
     mesh = build_mesh(ParallelConfig(dp=2, tp=2, sp=2))
     assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}

@@ -64,6 +64,8 @@ class SchedulerStats:
             # ~2 FLOPs per param per decoded token; divide tokens/s by
             # chip peak to get MFU.
             "approx_flops_per_token": 2 * engine.n_params,
+            "attn_backend": engine.attn_backend,
+            "decode_pipeline_depth": engine.engine_cfg.decode_pipeline_depth,
         }
         if engine.prefix_cache is not None:
             out["prefix_cache"] = engine.prefix_cache.stats()
