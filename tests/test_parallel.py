@@ -221,3 +221,31 @@ def test_multihost_initialize_noop_single_process():
     assert role["process_count"] == 1
     assert role["local_devices_in_mesh"] == 2
     assert role["hosts_frontend"] is True
+
+
+def test_tp_engine_pipelined_decode_matches():
+    """Dispatch-ahead decode under a tp mesh == sync unsharded engine."""
+    cfg = tp_llama_cfg()
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+
+    base = InferenceEngine(cfg, EngineConfig(
+        page_size=8, num_pages=64, max_pages_per_seq=8, max_batch_size=2,
+        prefill_buckets=(16,), decode_steps_per_call=4), seed=0)
+    want = base.generate(prompts, max_new_tokens=12)
+
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=8,
+                        max_batch_size=2, prefill_buckets=(16,),
+                        decode_steps_per_call=4, decode_pipeline_depth=2)
+    eng = InferenceEngine(cfg, ecfg, seed=0,
+                          mesh=build_mesh(ParallelConfig(tp=4)))
+    from tpu_inference.engine.engine import Sequence
+    seqs = [Sequence(request_id=i, prompt_tokens=p, max_new_tokens=12)
+            for i, p in enumerate(prompts)]
+    for s in seqs:
+        eng.prefill(s)
+    for _ in range(20):
+        eng.decode_steps_pipelined()
+        if all(s.done for s in seqs) and not eng.pipeline_pending:
+            break
+    eng.drain_pipeline()
+    assert [s.generated for s in seqs] == want
