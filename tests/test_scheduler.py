@@ -110,3 +110,46 @@ def test_scheduler_cancel_queued(engine):
     time.sleep(0.3)
     assert s.generated == []
     sched.stop()
+
+
+def test_pipelined_decode_error_recovery():
+    """A decode-dispatch exception with calls in flight must not poison
+    later requests: the pipeline is aborted, the victims error out, and a
+    fresh request through the reused slots completes correctly."""
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=8,
+                             max_batch_size=2, prefill_buckets=(16,),
+                             decode_steps_per_call=4,
+                             decode_pipeline_depth=2)
+    params, _ = build_model(model_cfg, seed=0)
+    engine = InferenceEngine(model_cfg, ecfg, params=params)
+
+    want = InferenceEngine(model_cfg, ecfg, params=params).generate(
+        [[5, 6, 7]], max_new_tokens=6)[0]
+
+    real = engine._decode_multi_jit
+    state = {"calls": 0}
+
+    def flaky(*a, **kw):
+        state["calls"] += 1
+        if state["calls"] == 2:
+            raise RuntimeError("injected decode failure")
+        return real(*a, **kw)
+
+    engine._decode_multi_jit = flaky
+    sched = EngineScheduler(engine).start()
+    try:
+        victim = Sequence(request_id=1, prompt_tokens=[1, 2, 3],
+                          max_new_tokens=12)
+        events = _submit_and_wait(sched, [victim])
+        assert victim.finish_reason == "error"
+        assert not engine.pipeline_pending
+
+        engine._decode_multi_jit = real
+        fresh = Sequence(request_id=2, prompt_tokens=[5, 6, 7],
+                         max_new_tokens=6)
+        _submit_and_wait(sched, [fresh])
+        assert fresh.finish_reason == "length"
+        assert fresh.generated == want
+    finally:
+        sched.stop(drain=False)
