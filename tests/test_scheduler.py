@@ -153,3 +153,33 @@ def test_pipelined_decode_error_recovery():
         assert fresh.generated == want
     finally:
         sched.stop(drain=False)
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A multi-chunk prompt prefills one chunk per loop iteration, so a
+    running request keeps decoding in between; both outputs equal the
+    non-interleaved reference."""
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=16,
+                             max_batch_size=4, prefill_buckets=(16, 32),
+                             enable_prefix_cache=False)
+    params, _ = build_model(model_cfg, seed=0)
+    rng = np.random.default_rng(21)
+    short = rng.integers(0, 256, size=6).tolist()
+    long = rng.integers(0, 256, size=90).tolist()   # 3 chunks of <=32
+
+    ref = InferenceEngine(model_cfg, ecfg, params=params)
+    want_short = ref.generate([short], max_new_tokens=20)[0]
+    want_long = ref.generate([long], max_new_tokens=8)[0]
+
+    engine = InferenceEngine(model_cfg, ecfg, params=params)
+    sched = EngineScheduler(engine).start()
+    try:
+        s1 = Sequence(request_id=1, prompt_tokens=short, max_new_tokens=20)
+        s2 = Sequence(request_id=2, prompt_tokens=long, max_new_tokens=8)
+        events = _submit_and_wait(sched, [s1, s2])
+    finally:
+        sched.stop(drain=False)
+    assert events[1] == want_short
+    assert events[2] == want_long
+    assert s2.finish_reason == "length"

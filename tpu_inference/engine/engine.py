@@ -144,6 +144,9 @@ class Sequence:
     pages: List[int] = dataclasses.field(default_factory=list)
     ctx_len: int = 0                       # tokens currently in KV
     cached_tokens: int = 0                 # prefix-cache hit length
+    # Incremental multi-chunk prefill state (prefill_begin/prefill_step).
+    prefill_prompt: Optional[List[int]] = None
+    prefill_offset: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     finish_reason: str = ""
@@ -607,40 +610,73 @@ class InferenceEngine:
         return (self.sp > 1 and offset == 0 and chunk_len == prompt_len
                 and bucket % self.sp == 0)
 
+    def _prefill_one_chunk(self, seq: Sequence, prompt: List[int],
+                           offset: int) -> Tuple[int, Any]:
+        """Run one prefill chunk at ``offset``; returns (next_offset,
+        sampled-token device array for the chunk)."""
+        ecfg = self.engine_cfg
+        bt = self._block_table_array(seq.pages)[None]
+        chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
+        top_k, rseed = self._sampling_arrays(seq)
+        chunk = prompt[offset:offset + chunk_cap]
+        bucket = ecfg.bucket_for(len(chunk))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(chunk)] = chunk
+        use_sp = self._use_sp(offset, len(chunk), len(prompt), bucket)
+        prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
+        self.kv, tok, _ = prefill(
+            self.params, self.kv, jnp.asarray(toks),
+            jnp.asarray([len(chunk)], np.int32),
+            jnp.asarray([offset], np.int32), jnp.asarray(bt),
+            self._next_key(),
+            jnp.asarray([seq.temperature], np.float32),
+            jnp.asarray([seq.top_p], np.float32),
+            jnp.asarray([top_k], np.int32),
+            jnp.asarray([rseed], np.int32))
+        if self.spec_enabled:
+            # Mirror the chunk into the draft model's KV (same pages).
+            self.draft_kv = self._draft_prefill_jit(
+                self.draft_params, self.draft_kv, jnp.asarray(toks),
+                jnp.asarray([len(chunk)], np.int32),
+                jnp.asarray([offset], np.int32), jnp.asarray(bt))
+        return offset + len(chunk), tok
+
     def _prefill_chunked(self, seq: Sequence, prompt: List[int]) -> None:
         """Serial (one-lane) prefill; chunks prompts that exceed the
         largest bucket. Each chunk attends to itself + all cached tokens
         (prefix_len); only the final chunk's sampled token is kept."""
-        ecfg = self.engine_cfg
-        bt = self._block_table_array(seq.pages)[None]
-        chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
         offset = seq.cached_tokens
         tok = None
-        top_k, rseed = self._sampling_arrays(seq)
         while offset < len(prompt):
-            chunk = prompt[offset:offset + chunk_cap]
-            bucket = ecfg.bucket_for(len(chunk))
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :len(chunk)] = chunk
-            use_sp = self._use_sp(offset, len(chunk), len(prompt), bucket)
-            prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
-            self.kv, tok, _ = prefill(
-                self.params, self.kv, jnp.asarray(toks),
-                jnp.asarray([len(chunk)], np.int32),
-                jnp.asarray([offset], np.int32), jnp.asarray(bt),
-                self._next_key(),
-                jnp.asarray([seq.temperature], np.float32),
-                jnp.asarray([seq.top_p], np.float32),
-                jnp.asarray([top_k], np.int32),
-                jnp.asarray([rseed], np.int32))
-            if self.spec_enabled:
-                # Mirror the chunk into the draft model's KV (same pages).
-                self.draft_kv = self._draft_prefill_jit(
-                    self.draft_params, self.draft_kv, jnp.asarray(toks),
-                    jnp.asarray([len(chunk)], np.int32),
-                    jnp.asarray([offset], np.int32), jnp.asarray(bt))
-            offset += len(chunk)
+            offset, tok = self._prefill_one_chunk(seq, prompt, offset)
         self._prefill_finish(seq, prompt, int(tok[0]))
+
+    # -- Incremental (interleavable) prefill: one chunk per call, so the
+    # -- scheduler can run decode steps between a long prompt's chunks
+    # -- instead of stalling the whole batch for the full prefill.
+
+    def prefill_begin(self, seq: Sequence,
+                      slot: Optional[int] = None) -> int:
+        """Set up an incremental prefill (pages, slot, cache lookup);
+        drive it with prefill_step(). Returns the slot."""
+        if slot is None:
+            slot = self.free_slots()[0]
+        seq.prefill_prompt = self._prefill_setup(seq, slot)
+        seq.prefill_offset = seq.cached_tokens
+        return slot
+
+    def prefill_step(self, seq: Sequence) -> bool:
+        """Run ONE chunk of an incremental prefill; True when complete
+        (first token sampled and bookkeeping done)."""
+        prompt = seq.prefill_prompt
+        assert prompt is not None, "prefill_step without prefill_begin"
+        seq.prefill_offset, tok = self._prefill_one_chunk(
+            seq, prompt, seq.prefill_offset)
+        if seq.prefill_offset < len(prompt):
+            return False
+        self._prefill_finish(seq, prompt, int(tok[0]))
+        seq.prefill_prompt = None
+        return True
 
     def prefill(self, seq: Sequence, slot: Optional[int] = None) -> int:
         """Admit a sequence: allocate pages, run the prefill graph (chunked
@@ -747,6 +783,7 @@ class InferenceEngine:
             self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages)
         self.allocator.free(seq.pages)
         seq.pages = []
+        seq.prefill_prompt = None          # cancel/error mid-prefill
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
 

@@ -104,6 +104,9 @@ class EngineScheduler:
         self.recent: Deque[dict] = collections.deque(maxlen=256)
         self._waiting: Deque[_Pending] = collections.deque()
         self._callbacks: Dict[int, _Pending] = {}
+        # At most one multi-chunk prompt prefills incrementally (one
+        # chunk per loop iteration) so decode keeps running in between.
+        self._prefilling: Optional[_Pending] = None
         self._lock = threading.Lock()
         self._work = threading.Event()
         self._stop = threading.Event()
@@ -157,19 +160,68 @@ class EngineScheduler:
         if drain:
             deadline = time.monotonic() + timeout
             while (time.monotonic() < deadline
-                   and (self._waiting or self.engine.active_sequences())):
+                   and (self._waiting or self._prefilling is not None
+                        or self.engine.active_sequences())):
                 time.sleep(0.01)
         self._stop.set()
         self._work.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
 
+    def _needs_chunking(self, seq: Sequence) -> bool:
+        """True when the prompt spans several prefill chunks (so it goes
+        through the incremental path instead of stalling the batch).
+        Conservative: a prefix-cache hit could still shrink it to one."""
+        ecfg = self.engine.engine_cfg
+        cap = ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1]
+        return min(len(seq.prompt_tokens), ecfg.max_context - 1) > cap
+
+    def _prefill_done(self, pending: _Pending) -> None:
+        """Post-prefill bookkeeping shared by the batched and incremental
+        paths: counters, first-token delivery, immediate finish."""
+        seq = pending.seq
+        self.stats.prefills += 1
+        self.stats.tokens_generated += 1
+        self.stats.tokens_prefix_cached += seq.cached_tokens
+        pending.on_token(seq, seq.generated[-1])
+        if seq.done:
+            self._finish(seq)
+
+    def _step_incremental_prefill(self) -> None:
+        """Advance the in-progress multi-chunk prefill by ONE chunk."""
+        pending = self._prefilling
+        seq = pending.seq
+        if seq.done:                          # cancelled mid-prefill
+            self._prefilling = None
+            self._finish(seq)
+            return
+        try:
+            finished = self.engine.prefill_step(seq)
+        except Exception:  # noqa: BLE001 — keep the engine loop alive
+            import traceback
+            traceback.print_exc()
+            self._prefilling = None
+            seq.done, seq.finish_reason = True, "error"
+            self._finish(seq)
+            return
+        if finished:
+            self._prefilling = None
+            self._prefill_done(pending)
+
     def _admit(self) -> int:
         """Admit up to max_prefills_per_step waiting requests in one
         batched prefill dispatch (engine.prefill_many): same-bucket
         arrivals share a [P, S] forward instead of queueing behind P
-        serial prefills."""
+        serial prefills. Multi-chunk prompts instead start an incremental
+        prefill advanced one chunk per loop, so decode interleaves —
+        and short requests can still batch-admit in the same iteration
+        (no head-of-line blocking behind the long prompt)."""
+        admitted = 0
+        if self._prefilling is not None:
+            self._step_incremental_prefill()
+            admitted += 1
         batch: List[_Pending] = []
+        start_chunked: Optional[_Pending] = None
         reserved = 0
         with self._lock:
             free_slots = len(self.engine.free_slots())
@@ -185,14 +237,37 @@ class EngineScheduler:
                 need = self.engine._pages_reserved(pending.seq)
                 if self.engine._free_plus_evictable() < reserved + need:
                     break
+                if self._needs_chunking(pending.seq):
+                    if self._prefilling is not None:
+                        break     # one incremental prefill at a time
+                    if batch:
+                        break     # admit the batch first; chunked head next
+                    self._waiting.popleft()
+                    self._callbacks[pending.seq.request_id] = pending
+                    start_chunked = pending
+                    reserved += need
+                    break
                 self._waiting.popleft()
                 # Register before releasing the lock so cancel() always
                 # finds the request in _waiting or _callbacks.
                 self._callbacks[pending.seq.request_id] = pending
                 reserved += need
                 batch.append(pending)
+        if start_chunked is not None:
+            seq = start_chunked.seq
+            try:
+                self.engine.prefill_begin(seq)
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+                seq.done, seq.finish_reason = True, "error"
+                self._finish(seq)
+                return admitted
+            self._prefilling = start_chunked
+            self._step_incremental_prefill()
+            return admitted + 1
         if not batch:
-            return 0
+            return admitted
         try:
             self.engine.prefill_many([p.seq for p in batch])
         except Exception:  # noqa: BLE001 — keep the engine loop alive
@@ -203,16 +278,10 @@ class EngineScheduler:
             for pending in batch:
                 pending.seq.done, pending.seq.finish_reason = True, "error"
                 self._finish(pending.seq)   # releases pages/slot
-            return 0
+            return admitted
         for pending in batch:
-            seq = pending.seq
-            self.stats.prefills += 1
-            self.stats.tokens_generated += 1
-            self.stats.tokens_prefix_cached += seq.cached_tokens
-            pending.on_token(seq, seq.generated[-1])
-            if seq.done:
-                self._finish(seq)
-        return len(batch)
+            self._prefill_done(pending)
+        return admitted + len(batch)
 
     def _finish(self, seq: Sequence) -> None:
         with self._lock:
@@ -273,6 +342,8 @@ class EngineScheduler:
                     self._deliver(engine.drain_pipeline())
                 for s in [s for s in engine.slots if s is not None and s.done]:
                     self._finish(s)
+                if self._prefilling is not None:
+                    continue          # next iteration runs the next chunk
                 if not self._waiting:
                     self._work.clear()
                     self._work.wait(timeout=0.1)
