@@ -58,11 +58,12 @@ def bench_cfg(platform: str):
     )
 
 
-def run_backend(backend: str, cfg, on_tpu: bool):
+def run_backend(backend: str, cfg, on_tpu: bool, quant: str = "none"):
     """Time steady-state batched decode for one attention backend.
 
-    Returns (aggregate tok/s, model param count, mean context length,
-    first 8 greedy tokens of lane 0 for cross-backend equality).
+    Returns (sync tok/s, chained tok/s, model param count, weight bytes
+    actually resident (int8 shrinks this), mean context length, first 8
+    greedy tokens of lane 0 for cross-backend equality).
     """
     import jax
     import jax.numpy as jnp
@@ -80,10 +81,11 @@ def run_backend(backend: str, cfg, on_tpu: bool):
     ecfg = EngineConfig(page_size=16, num_pages=512, max_pages_per_seq=32,
                         max_batch_size=batch, prefill_buckets=(128,),
                         decode_steps_per_call=k, max_new_tokens=budget,
-                        attn_backend=backend)
+                        attn_backend=backend, quant=quant)
     engine = InferenceEngine(cfg, ecfg)
     t = engine.warmup()
-    print(f"[bench] {backend}: warmup (XLA compile) {t:.1f}s", file=sys.stderr)
+    print(f"[bench] {backend}/{quant}: warmup (XLA compile) {t:.1f}s",
+          file=sys.stderr)
 
     rng = np.random.default_rng(0)
     for i in range(batch):
@@ -115,10 +117,12 @@ def run_backend(backend: str, cfg, on_tpu: bool):
                               if s is not None]))
     head = list(engine.slots[0].generated[:8])
     n_params = engine.n_params
+    weight_bytes = int(sum(x.size * x.dtype.itemsize
+                           for x in jax.tree.leaves(engine.params)))
     # Free HBM before the next backend's engine materializes.
     del engine
     gc.collect()
-    return sync_tok_s, chained_tok_s, n_params, mean_ctx, head
+    return sync_tok_s, chained_tok_s, n_params, weight_bytes, mean_ctx, head
 
 
 def main() -> None:
@@ -129,34 +133,44 @@ def main() -> None:
     cfg = bench_cfg(platform)
     print(f"[bench] platform={platform} model={cfg.name}", file=sys.stderr)
 
-    dense_tok_s, dense_chained, _, _, dense_head = run_backend(
+    dense_tok_s, dense_chained, _, _, _, dense_head = run_backend(
         "dense", cfg, on_tpu)
-    (pallas_tok_s, pallas_chained, n_params, mean_ctx,
+    (pallas_tok_s, pallas_chained, n_params, weight_bytes, mean_ctx,
      pallas_head) = run_backend("pallas", cfg, on_tpu)
     if dense_head != pallas_head:
         # Greedy sampling: any drift is a correctness signal, not noise.
         print(f"[bench] WARNING: backend token mismatch "
               f"dense={dense_head} pallas={pallas_head}", file=sys.stderr)
+    # Weight-only int8 (models/quant.py): halves the HBM weight read that
+    # bounds decode. Tokens legitimately differ from bf16 (quantization),
+    # so no equality check — test_quant.py pins the error envelope.
+    (int8_tok_s, int8_chained, _, int8_weight_bytes, _,
+     _) = run_backend("pallas", cfg, on_tpu, quant="int8")
 
     batch = 8
     flops_per_token = 2 * n_params
     kv_bytes_per_token = (2 * 2 * cfg.n_layers * mean_ctx
                           * cfg.n_kv_heads * cfg.head_dim)  # K+V, bf16
-    weight_bytes = 2 * n_params                              # bf16
     peak_flops, peak_bw = CHIP_PEAKS.get(
         jax.devices()[0].device_kind, (394e12, 819e9))
 
-    def util(tok_s):
+    def util(tok_s, wbytes):
         steps_per_s = tok_s / batch
-        bw = steps_per_s * (weight_bytes + batch * kv_bytes_per_token)
+        bw = steps_per_s * (wbytes + batch * kv_bytes_per_token)
         return (round(tok_s * flops_per_token / peak_flops, 4),
                 round(bw / peak_bw, 4))
 
-    best = max(pallas_tok_s, pallas_chained)
-    mode = "dispatch-ahead" if pallas_chained >= pallas_tok_s else "sync"
-    mfu, hbm_util = util(best)
+    best_bf16 = max(pallas_tok_s, pallas_chained)
+    best_int8 = max(int8_tok_s, int8_chained)
+    best = max(best_bf16, best_int8)
+    wbytes = int8_weight_bytes if best_int8 >= best_bf16 else weight_bytes
+    quant_tag = "int8" if best_int8 >= best_bf16 else "bf16"
+    mode = "dispatch-ahead" if max(pallas_chained, int8_chained) >= \
+        max(pallas_tok_s, int8_tok_s) else "sync"
+    mfu, hbm_util = util(best, wbytes)
+    mfu_bf16, hbm_util_bf16 = util(best_bf16, weight_bytes)
     print(json.dumps({
-        "metric": "decode_tok_s_llama1b_bs8_pallas",
+        "metric": f"decode_tok_s_llama1b_bs8_pallas_{quant_tag}",
         "value": round(best, 2),
         "unit": f"tokens/s (aggregate, batch=8, {mode})",
         # Like-for-like: per-stream rate vs the reference's single-stream 93.
@@ -167,12 +181,20 @@ def main() -> None:
         "chained_tok_s": round(pallas_chained, 2),
         "dense_tok_s": round(dense_tok_s, 2),
         "dense_chained_tok_s": round(dense_chained, 2),
+        "int8_tok_s": round(int8_tok_s, 2),
+        "int8_chained_tok_s": round(int8_chained, 2),
         # Mode-matched kernel comparisons (sync/sync and chained/chained).
         "pallas_speedup_vs_dense_sync": round(pallas_tok_s / dense_tok_s, 3),
         "pallas_speedup_vs_dense_chained": round(
             pallas_chained / dense_chained, 3),
+        "int8_speedup_vs_bf16": round(best_int8 / best_bf16, 3),
         "mfu": mfu,
         "hbm_util": hbm_util,
+        "bf16_tok_s": round(best_bf16, 2),
+        "bf16_mfu": mfu_bf16,
+        "bf16_hbm_util": hbm_util_bf16,
+        "weight_bytes_bf16": weight_bytes,
+        "weight_bytes_int8": int8_weight_bytes,
         "mean_ctx": round(mean_ctx, 1),
         "chip": jax.devices()[0].device_kind,
         "platform": platform,

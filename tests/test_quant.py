@@ -1,0 +1,190 @@
+"""Weight-only int8 quantization (models/quant.py).
+
+The reference has no quantization tier (no model code at all, SURVEY.md
+§0); its external Ollama endpoint served quantized GGUF models — this is
+the TPU-native equivalent (int8 weights + per-channel scales, XLA fusing
+the dequant into the matmul). Tests pin: quantization error bounds, the
+qdot/qeinsum contraction helpers, end-to-end engine serving parity, and
+TP-sharded quantized params matching the unsharded quantized tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_inference.config import (
+    EngineConfig,
+    ParallelConfig,
+    tiny_gpt2,
+    tiny_llama,
+    tiny_mixtral,
+)
+from tpu_inference.engine.engine import InferenceEngine
+from tpu_inference.models.quant import (
+    QUANT_KEYS,
+    QuantizedArray,
+    dequantize,
+    qdot,
+    qeinsum,
+    quantize_array,
+    quantize_params,
+)
+
+
+def test_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32)) * 0.05
+    qa = quantize_array(w)
+    assert qa.q.dtype == jnp.int8
+    assert qa.scale.shape == (1, 32)
+    # Symmetric rounding: |w - dq(q(w))| <= scale/2 per output channel.
+    err = jnp.abs(dequantize(qa) - w)
+    assert bool((err <= qa.scale / 2 + 1e-7).all())
+
+
+def test_qdot_matches_dequantized_product():
+    # The contraction invariant: qdot(x, qa) == x @ dequantize(qa) — the
+    # scale factors out of the contraction exactly (it scales the output
+    # channel, which is never summed over).
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(32, 16)) * 0.05, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+    qa = quantize_array(w)
+    np.testing.assert_allclose(np.asarray(qdot(x, qa)),
+                               np.asarray(x @ dequantize(qa)),
+                               rtol=1e-5, atol=1e-6)
+    # Plain-array passthrough.
+    np.testing.assert_allclose(qdot(x, w), x @ w, rtol=1e-6)
+
+
+def test_qeinsum_expert_contractions():
+    rng = np.random.default_rng(1)
+    e, c, d, f = 2, 3, 8, 16
+    a = jnp.asarray(rng.normal(size=(e, c, d)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(e, d, f)) * 0.02, jnp.float32)
+    qa = quantize_array(w)
+    got = qeinsum("ecd,edf->ecf", a, qa)
+    want = jnp.einsum("ecd,edf->ecf", a, dequantize(qa))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_quantize_params_selects_matmul_weights_only():
+    from tpu_inference.models.registry import build_model
+    cfg = tiny_llama()
+    params, _ = build_model(cfg, seed=0)
+    qp = quantize_params(params)
+    assert isinstance(qp["blocks"]["wq"], QuantizedArray)
+    assert isinstance(qp["blocks"]["w_down"], QuantizedArray)
+    # Norms, embeddings stay full precision.
+    assert not isinstance(qp["blocks"]["attn_norm"], QuantizedArray)
+    assert not isinstance(qp["embed"], QuantizedArray)
+    # Stacked-layer leaves keep the leading L axis on q and scale.
+    assert qp["blocks"]["wq"].q.shape[0] == cfg.n_layers
+    assert qp["blocks"]["wq"].scale.shape == (cfg.n_layers, 1,
+                                              qp["blocks"]["wq"].q.shape[-1])
+
+
+def test_quantized_forward_close_to_full_precision():
+    from tpu_inference.models.common import make_dense_attn
+    from tpu_inference.models.registry import build_model, get_model_fns
+    cfg = tiny_llama()
+    params, _ = build_model(cfg, seed=0)
+    mod = get_model_fns(cfg)
+    toks = jnp.arange(1, 17, dtype=jnp.int32)[None]
+    pos = jnp.arange(16, dtype=jnp.int32)[None]
+    full, _ = mod.forward(params, cfg, toks, pos, None, make_dense_attn())
+    quant, _ = mod.forward(quantize_params(params), cfg, toks, pos, None,
+                           make_dense_attn())
+    # Per-channel int8 keeps logits within a tight relative envelope.
+    denom = jnp.abs(full).max()
+    assert float(jnp.abs(quant - full).max() / denom) < 0.05
+
+
+@pytest.mark.parametrize("cfg_fn", [tiny_llama, tiny_mixtral, tiny_gpt2])
+def test_engine_serves_int8(cfg_fn):
+    cfg = cfg_fn()
+    ecfg = EngineConfig(num_pages=64, max_batch_size=2,
+                        prefill_buckets=(64,), max_new_tokens=16,
+                        quant="int8")
+    engine = InferenceEngine(cfg, ecfg, seed=0)
+    out = engine.generate([list(range(1, 20)), list(range(5, 40))],
+                          max_new_tokens=8)
+    assert all(len(t) == 8 for t in out)
+    assert all(0 <= tok < cfg.vocab_size for t in out for tok in t)
+
+
+def test_tp_sharded_int8_matches_unsharded():
+    from tpu_inference.parallel.mesh import build_mesh
+    cfg = tiny_llama()
+    ecfg = EngineConfig(num_pages=64, max_batch_size=2,
+                        prefill_buckets=(64,), max_new_tokens=16,
+                        quant="int8")
+    prompts = [list(range(1, 20)), list(range(5, 40))]
+    base = InferenceEngine(cfg, ecfg, seed=0).generate(prompts,
+                                                       max_new_tokens=10)
+    mesh = build_mesh(ParallelConfig(tp=2))
+    tp = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh).generate(
+        prompts, max_new_tokens=10)
+    assert base == tp
+
+
+def test_tp_sharded_int8_mixtral_ep():
+    from tpu_inference.parallel.mesh import build_mesh
+    cfg = tiny_mixtral()
+    ecfg = EngineConfig(num_pages=64, max_batch_size=2,
+                        prefill_buckets=(64,), max_new_tokens=16,
+                        quant="int8")
+    prompts = [list(range(1, 16))]
+    base = InferenceEngine(cfg, ecfg, seed=0).generate(prompts,
+                                                       max_new_tokens=8)
+    mesh = build_mesh(ParallelConfig(tp=2))
+    tp = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh).generate(
+        prompts, max_new_tokens=8)
+    assert base == tp
+
+
+def test_scale_sharding_unshards_reduced_dim():
+    """wo shards its input (contraction) dim on tp; the scale's size-1
+    contraction dim must come out unsharded or device_put would fail."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_inference.models.registry import build_model
+    from tpu_inference.parallel import shardings as shd
+    from tpu_inference.parallel.mesh import build_mesh
+    cfg = tiny_llama()
+    params, _ = build_model(cfg, seed=0)
+    qp = quantize_params(params)
+    mesh = build_mesh(ParallelConfig(tp=2))
+    sh = shd.param_shardings(cfg, mesh, qp)
+    wo = sh["blocks"]["wo"]
+    assert wo.q.spec == P(None, "tp", None)
+    assert wo.scale.spec == P(None, None, None)
+    placed = shd.shard_params(qp, cfg, mesh)
+    assert placed["blocks"]["wo"].q.sharding.spec == P(None, "tp", None)
+
+
+def test_check_numerics_passes_on_quantized_params():
+    cfg = tiny_llama()
+    ecfg = EngineConfig(num_pages=64, max_batch_size=2,
+                        prefill_buckets=(64,), quant="int8")
+    InferenceEngine(cfg, ecfg, seed=0).check_numerics()
+
+
+def test_unknown_quant_mode_rejected():
+    with pytest.raises(ValueError, match="unknown quant mode"):
+        quantize_params({}, "fp4")
+
+
+def test_quant_keys_cover_all_families():
+    # Every family's big matmul weights are in QUANT_KEYS (drift guard).
+    from tpu_inference.models.registry import build_model
+    for cfg_fn in (tiny_llama, tiny_mixtral, tiny_gpt2):
+        cfg = cfg_fn()
+        params, _ = build_model(cfg, seed=0)
+        qp = quantize_params(params)
+        n_quant = sum(isinstance(x, QuantizedArray)
+                      for x in jax.tree.leaves(
+                          qp, is_leaf=lambda x: isinstance(x, QuantizedArray))
+                      if isinstance(x, QuantizedArray))
+        assert n_quant >= 4, f"{cfg.name}: only {n_quant} quantized leaves"

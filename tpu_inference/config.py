@@ -183,6 +183,11 @@ class EngineConfig:
     # kernel (kernels/paged_attention.py) on real TPU and the dense
     # gather path elsewhere; "pallas"/"dense" force one.
     attn_backend: str = "auto"
+    # Weight quantization: "int8" stores matmul weights as int8 with
+    # per-output-channel scales (models/quant.py), halving the per-step
+    # HBM weight traffic that bounds decode throughput. "none" = serve
+    # in the model dtype.
+    quant: str = "none"
     # Device-side decode steps fused per host call (lax.scan): each host
     # round trip costs ~dispatch latency, so K steps per call multiply
     # steady-state decode throughput by up to K. Streamed tokens are

@@ -194,10 +194,20 @@ class InferenceEngine:
             _shd.validate_tp(model_cfg, mesh.shape.get("tp", 1))
             if draft_cfg is not None:
                 _shd.validate_tp(draft_cfg, mesh.shape.get("tp", 1))
+        def maybe_quantize(p):
+            # Weight-only int8: halves the per-step HBM weight read that
+            # bounds decode throughput (BASELINE.md roofline). Runs on
+            # device; shard_params below re-canonicalizes placements.
+            if engine_cfg.quant == "none":
+                return p
+            from tpu_inference.models.quant import quantize_params
+            return quantize_params(p, engine_cfg.quant)
+
         if params is None:
             params, _ = build_model(model_cfg, seed=seed)
         if shard_fn is not None:
             params = shard_fn(params)
+        params = maybe_quantize(params)
         self.mesh = mesh
         kv_sh = None
         if mesh is not None:
@@ -258,6 +268,7 @@ class InferenceEngine:
             self.draft_mod = get_model_fns(draft_cfg)
             if draft_params is None:
                 draft_params, _ = build_model(draft_cfg, seed=seed + 1)
+            draft_params = maybe_quantize(draft_params)
             if mesh is not None:
                 # Draft weights get the same mesh treatment as the target
                 # (divisibility was fail-fast-checked above); the draft

@@ -65,6 +65,7 @@ class SchedulerStats:
             # chip peak to get MFU.
             "approx_flops_per_token": 2 * engine.n_params,
             "attn_backend": engine.attn_backend,
+            "quant": engine.engine_cfg.quant,
             "decode_pipeline_depth": engine.engine_cfg.decode_pipeline_depth,
         }
         if engine.prefix_cache is not None:

@@ -18,6 +18,8 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 
+from tpu_inference.models.quant import qdot
+
 # attn(layer_idx, q, k, v, kv_state) -> (attn_out, kv_state)
 AttentionFn = Callable[[int, jax.Array, jax.Array, jax.Array, Any],
                        Tuple[jax.Array, Any]]
@@ -118,15 +120,18 @@ def make_dense_attn(theta_unused: float = 0.0) -> AttentionFn:
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
            w_down: jax.Array) -> jax.Array:
-    """SwiGLU FFN: down( silu(x @ gate) * (x @ up) )."""
-    gate = jax.nn.silu(jnp.dot(x, w_gate, preferred_element_type=jnp.float32))
-    up = jnp.dot(x, w_up, preferred_element_type=jnp.float32)
-    return jnp.dot((gate * up).astype(x.dtype), w_down,
-                   preferred_element_type=jnp.float32).astype(x.dtype)
+    """SwiGLU FFN: down( silu(x @ gate) * (x @ up) ).
+
+    Weights may be int8 ``QuantizedArray``s (models/quant.py) — ``qdot``
+    handles both representations.
+    """
+    gate = jax.nn.silu(qdot(x, w_gate))
+    up = qdot(x, w_up)
+    return qdot((gate * up).astype(x.dtype), w_down).astype(x.dtype)
 
 
 def linear(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
-    out = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    out = qdot(x, w)
     if b is not None:
         out = out + b.astype(jnp.float32)
     return out.astype(x.dtype)

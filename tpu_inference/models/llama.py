@@ -29,6 +29,7 @@ from tpu_inference.models.common import (
     rms_norm,
     swiglu,
 )
+from tpu_inference.models.quant import qdot
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
@@ -70,9 +71,9 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     hd = cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.dot(h, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-    k = jnp.dot(h, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-    v = jnp.dot(h, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = qdot(h, lp["wq"]).astype(x.dtype)
+    k = qdot(h, lp["wk"]).astype(x.dtype)
+    v = qdot(h, lp["wv"]).astype(x.dtype)
     q = q.reshape(b, s, cfg.n_heads, hd)
     k = k.reshape(b, s, cfg.n_kv_heads, hd)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)
@@ -81,8 +82,7 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
 
     attn_out, kv = attn(layer_idx, q, k, v, kv)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
-    x = x + jnp.dot(attn_out, lp["wo"],
-                    preferred_element_type=jnp.float32).astype(x.dtype)
+    x = x + qdot(attn_out, lp["wo"]).astype(x.dtype)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
@@ -109,8 +109,10 @@ def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
 
 def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """Hidden states -> f32 logits."""
-    w = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return jnp.dot(hidden, w, preferred_element_type=jnp.float32)
+    if cfg.tie_embeddings:
+        return jnp.dot(hidden, params["embed"].T,
+                       preferred_element_type=jnp.float32)
+    return qdot(hidden, params["lm_head"])
 
 
 def forward(params: dict, cfg: ModelConfig, tokens: jax.Array,

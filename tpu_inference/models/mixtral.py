@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from tpu_inference.config import ModelConfig
 from tpu_inference.models.common import AttentionFn, apply_rope, rms_norm
+from tpu_inference.models.quant import qdot, qeinsum
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
@@ -93,13 +94,10 @@ def moe_ffn(cfg: ModelConfig, lp: dict, x: jax.Array) -> jax.Array:
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x2,
                            preferred_element_type=jnp.float32).astype(cfg.dtype)
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"],
-                                  preferred_element_type=jnp.float32))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"],
-                    preferred_element_type=jnp.float32)
-    expert_out = jnp.einsum("ecf,efd->ecd", (gate * up).astype(cfg.dtype),
-                            lp["w_down"],
-                            preferred_element_type=jnp.float32)  # [E, C, D] f32
+    gate = jax.nn.silu(qeinsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
+    up = qeinsum("ecd,edf->ecf", expert_in, lp["w_up"])
+    expert_out = qeinsum("ecf,efd->ecd", (gate * up).astype(cfg.dtype),
+                         lp["w_down"])                           # [E, C, D] f32
 
     combine = dispatch.astype(jnp.float32) * combine_w[..., None]
     out = jnp.einsum("tec,ecd->td", combine, expert_out)
@@ -112,9 +110,9 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     hd = cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.dot(h, lp["wq"], preferred_element_type=jnp.float32).astype(x.dtype)
-    k = jnp.dot(h, lp["wk"], preferred_element_type=jnp.float32).astype(x.dtype)
-    v = jnp.dot(h, lp["wv"], preferred_element_type=jnp.float32).astype(x.dtype)
+    q = qdot(h, lp["wq"]).astype(x.dtype)
+    k = qdot(h, lp["wk"]).astype(x.dtype)
+    v = qdot(h, lp["wv"]).astype(x.dtype)
     q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions, cfg.rope_theta)
     k = apply_rope(k.reshape(b, s, cfg.n_kv_heads, hd), positions,
                    cfg.rope_theta)
@@ -122,8 +120,7 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
 
     attn_out, kv = attn(layer_idx, q, k, v, kv)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
-    x = x + jnp.dot(attn_out, lp["wo"],
-                    preferred_element_type=jnp.float32).astype(x.dtype)
+    x = x + qdot(attn_out, lp["wo"]).astype(x.dtype)
 
     h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
     x = x + moe_ffn(cfg, lp, h)
@@ -148,8 +145,7 @@ def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
 
 
 def unembed(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
-    return jnp.dot(hidden, params["lm_head"],
-                   preferred_element_type=jnp.float32)
+    return qdot(hidden, params["lm_head"])
 
 
 def forward(params: dict, cfg: ModelConfig, tokens: jax.Array,

@@ -23,12 +23,13 @@ collectives.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_inference.config import ModelConfig
+from tpu_inference.models.quant import QuantizedArray
 
 
 def _llama_specs(cfg: ModelConfig) -> dict:
@@ -124,15 +125,45 @@ def param_specs(cfg: ModelConfig) -> dict:
     return fam(cfg)
 
 
-def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Any:
+def _scale_spec(spec: P, ndim: int) -> P:
+    """Spec for a QuantizedArray's scale: same as the weight's, with the
+    contraction dim (axis -2, size 1 in the scale) unsharded."""
+    entries = list(spec) + [None] * (ndim - len(spec))
+    entries[ndim - 2] = None
+    return P(*entries)
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh,
+                    params: Optional[dict] = None) -> Any:
+    """NamedSharding pytree for the family's params.
+
+    Without ``params`` the tree mirrors ``param_specs`` (plain-array
+    leaves). With ``params`` (possibly holding int8 ``QuantizedArray``
+    leaves, models/quant.py) the result mirrors the actual params tree:
+    the quantized payload takes the weight's spec, the scale the same
+    spec with its reduced contraction dim unsharded.
+    """
     validate_tp(cfg, mesh.shape.get("tp", 1))
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), param_specs(cfg),
+    specs = param_specs(cfg)
+    if params is None:
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def mk(spec: P, leaf: Any):
+        if isinstance(leaf, QuantizedArray):
+            return QuantizedArray(
+                q=NamedSharding(mesh, spec),
+                scale=NamedSharding(mesh, _scale_spec(spec, leaf.q.ndim)))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(mk, specs, params,
                         is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_params(params: dict, cfg: ModelConfig, mesh: Mesh) -> dict:
     """Place a params pytree onto the mesh per `param_specs`."""
-    return jax.tree.map(jax.device_put, params, param_shardings(cfg, mesh))
+    return jax.tree.map(jax.device_put, params,
+                        param_shardings(cfg, mesh, params))
 
 
 def kv_spec() -> P:

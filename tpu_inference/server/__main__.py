@@ -46,6 +46,10 @@ def main() -> None:
                    choices=("auto", "dense", "pallas"),
                    help="decode attention: Pallas paged kernel (TPU) or "
                         "dense gather; auto = pallas on TPU")
+    p.add_argument("--quant", default="none", choices=("none", "int8"),
+                   help="weight quantization: int8 stores matmul weights "
+                        "as int8 + per-channel scales, halving the HBM "
+                        "weight traffic that bounds decode throughput")
     p.add_argument("--draft-model", default=None,
                    help="enable speculative decoding with this draft "
                         "preset or HF checkpoint dir")
@@ -85,6 +89,7 @@ def main() -> None:
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,
                           attn_backend=args.attn_backend,
+                          quant=args.quant,
                           max_batch_size=args.max_batch_size,
                           num_pages=args.num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
