@@ -170,7 +170,10 @@ def main() -> None:
     mfu, hbm_util = util(best, wbytes)
     mfu_bf16, hbm_util_bf16 = util(best_bf16, weight_bytes)
     print(json.dumps({
-        "metric": f"decode_tok_s_llama1b_bs8_pallas_{quant_tag}",
+        # Name stays stable across rounds (BENCH_r{N}.json diffs by key);
+        # the winning lane is reported in best_lane.
+        "metric": "decode_tok_s_llama1b_bs8_pallas",
+        "best_lane": quant_tag,
         "value": round(best, 2),
         "unit": f"tokens/s (aggregate, batch=8, {mode})",
         # Like-for-like: per-stream rate vs the reference's single-stream 93.

@@ -139,3 +139,27 @@ def test_load_checkpoint_gpt2_and_mixtral(tmp_path):
     _write_sharded(msd, str(mdir), n_shards=2)
     got = weights.load_checkpoint(mcfg, str(mdir))
     _assert_tree_equal(got, weights.convert_state_dict(mcfg, msd))
+
+
+def test_load_checkpoint_quantizes_at_load(tmp_path):
+    """quant="int8": matmul weights come back as QuantizedArray leaves,
+    numerically equal to quantizing the full-precision load afterwards
+    (but without ever materializing the whole bf16 tree)."""
+    from tpu_inference.models.quant import QuantizedArray, quantize_array
+
+    cfg = cfgs.tiny_llama()
+    sd = _random_llama_sd(cfg, np.random.default_rng(5))
+    _write_sharded(sd, str(tmp_path))
+
+    full = weights.load_checkpoint(cfg, str(tmp_path))
+    got = weights.load_checkpoint(cfg, str(tmp_path), quant="int8")
+    assert isinstance(got["blocks"]["wq"], QuantizedArray)
+    assert not isinstance(got["embed"], QuantizedArray)
+    want = quantize_array(full["blocks"]["wq"])
+    np.testing.assert_array_equal(np.asarray(got["blocks"]["wq"].q),
+                                  np.asarray(want.q))
+    np.testing.assert_allclose(np.asarray(got["blocks"]["wq"].scale),
+                               np.asarray(want.scale), rtol=1e-6)
+    # Norm/embed leaves untouched.
+    np.testing.assert_array_equal(np.asarray(got["embed"]),
+                                  np.asarray(full["embed"]))

@@ -398,7 +398,8 @@ def _read_slab(files: _CheckpointFiles, keys, transpose: bool,
 
 
 def load_checkpoint(cfg: ModelConfig, path: str,
-                    shardings: Optional[dict] = None) -> dict:
+                    shardings: Optional[dict] = None,
+                    quant: str = "none") -> dict:
     """Load a HF safetensors directory into a (optionally sharded) pytree.
 
     ``shardings``: pytree matching the params structure with
@@ -406,12 +407,20 @@ def load_checkpoint(cfg: ModelConfig, path: str,
     memory-mapped files into its device layout: with shardings, every chip
     reads only its own slab and no unsharded copy ever exists on host or
     device (the ADVICE r1 70B-host-OOM fix).
+
+    ``quant="int8"`` quantizes each eligible matmul weight (QUANT_KEYS)
+    on device immediately after it lands, before the next leaf streams
+    in — peak device memory is the int8 model plus ONE full-precision
+    leaf, so a model that only fits quantized can actually be loaded
+    (quantizing after a full bf16 load would peak at bf16 + int8).
     """
+    from tpu_inference.models.quant import QUANT_KEYS, quantize_array
+
     files = _CheckpointFiles(path)
     plan = _PLANNERS[cfg.family](cfg, set(files.keys()))
     dtype = cfg.dtype
 
-    def build(leaf_plan: _Plan, sharding=None):
+    def build(tree_path, leaf_plan: _Plan, sharding=None):
         keys, transpose = leaf_plan
         shape = _base_shape(files, keys, transpose)
         full = tuple(slice(0, s) for s in shape)
@@ -421,10 +430,19 @@ def load_checkpoint(cfg: ModelConfig, path: str,
             return _read_slab(files, keys, transpose, index).astype(dtype)
 
         if sharding is None:
-            return jnp.asarray(read())
-        return jax.make_array_from_callback(shape, sharding, read)
+            arr = jnp.asarray(read())
+        else:
+            arr = jax.make_array_from_callback(shape, sharding, read)
+        name = tree_path[-1].key if tree_path else ""
+        if quant != "none" and name in QUANT_KEYS:
+            # The bf16 leaf becomes garbage as soon as this returns; its
+            # device buffer frees before the next leaf materializes.
+            return jax.jit(quantize_array)(arr)
+        return arr
 
     is_plan_leaf = lambda x: isinstance(x, tuple)  # noqa: E731
     if shardings is None:
-        return jax.tree.map(build, plan, is_leaf=is_plan_leaf)
-    return jax.tree.map(build, plan, shardings, is_leaf=is_plan_leaf)
+        return jax.tree_util.tree_map_with_path(build, plan,
+                                                is_leaf=is_plan_leaf)
+    return jax.tree_util.tree_map_with_path(build, plan, shardings,
+                                            is_leaf=is_plan_leaf)

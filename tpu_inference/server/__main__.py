@@ -61,6 +61,17 @@ def main() -> None:
                    help=">1 keeps that many fused-decode dispatches in "
                         "flight (hides dispatch latency; adds (depth-1)*K "
                         "steps of streaming latency)")
+    p.add_argument("--platform", default="auto",
+                   choices=("auto", "cpu", "tpu"),
+                   help="jax platform: 'cpu' forces the CPU backend "
+                        "(with --cpu-devices virtual devices) before any "
+                        "computation — serve without TPU hardware or "
+                        "when the TPU tunnel is down; 'auto' uses the "
+                        "environment default")
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="with --platform cpu: number of virtual CPU "
+                        "devices (0 = max(1, dp*tp*sp), enough for the "
+                        "requested mesh)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--debug", action="store_true",
                    help="expose the unauthenticated /debug/* endpoints "
@@ -73,6 +84,18 @@ def main() -> None:
                    help="enable jax_debug_nans: any NaN-producing op "
                         "re-runs un-jitted and raises at the source")
     args = p.parse_args()
+
+    if args.platform != "auto":
+        # Must land before jax initializes a backend: env vars are read
+        # at (sitecustomize-time) import in this image, so jax.config is
+        # the only working override (same pattern as tests/conftest.py
+        # and __graft_entry__.dryrun_multichip).
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu":
+            n = args.cpu_devices or max(1, args.dp * args.tp * args.sp)
+            jax.config.update("jax_num_cpu_devices", n)
 
     if args.debug_nans:
         import jax

@@ -538,7 +538,9 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
     def _loader(mcfg, path):
         """(mesh | None) -> params: checkpoints stream per-replica so each
         replica's leaves land directly in ITS device layout — never an
-        unsharded copy on host or device 0 (host-OOM at 70B scale)."""
+        unsharded copy on host or device 0 (host-OOM at 70B scale). With
+        quant on, each matmul weight quantizes as it lands, so peak device
+        memory stays ~int8-model-sized (never full bf16 + int8)."""
         def load(mesh):
             from tpu_inference.models import weights
 
@@ -547,7 +549,8 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                 from tpu_inference.parallel import shardings as shd
 
                 shardings = shd.param_shardings(mcfg, mesh)
-            return weights.load_checkpoint(mcfg, path, shardings=shardings)
+            return weights.load_checkpoint(mcfg, path, shardings=shardings,
+                                           quant=cfg.engine.quant)
 
         return load
 
