@@ -1,0 +1,188 @@
+"""Multi-turn conversation benchmark (BASELINE.json config 3 workload).
+
+Simulates C concurrent chat sessions of T turns each against the
+in-process Ollama-protocol server. Every turn resends the full
+conversation so far plus a new user message — exactly how the
+reference's interactive chat loop accumulates context (reference:
+notebooks/request_demo.ipynb cell 4d5cf82f keeps `context` across
+turns) — so each request's prompt is a strict extension of the previous
+turn's prompt + response. That is the workload the prefix cache
+(engine/prefix_cache.py) exists for: turn N's prefill should reuse turn
+N-1's published KV pages and recompute only the new suffix.
+
+Reported per run: per-turn-index TTFT (flat-ish with the cache, growing
+~linearly with context without it), aggregate TTFT/TPOT percentiles,
+server-side prefix-hit tokens. ``--compare`` runs the same workload a
+second time with the prefix cache disabled and reports the speedup.
+
+Usage:
+    python benchmarks/multiturn.py --model tiny-llama --conversations 6 \
+        --turns 5 --compare --out benchmarks/results/config3_multiturn.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from benchmarks.replay import _percentiles, start_server  # noqa: E402
+
+USER_TOPICS = [
+    "Tell me about the weather patterns in the Pacific Northwest.",
+    "How does that compare to the East Coast?",
+    "What should I pack for a trip there in October?",
+    "Are there any hiking trails you would recommend?",
+    "How difficult is the most popular one?",
+    "What wildlife might I encounter on the trail?",
+    "Is it safe to hike alone in that area?",
+    "What emergency supplies should I carry?",
+]
+
+
+async def _one_conversation(session, url: str, model: str, conv_id: int,
+                            turns: int, max_tokens: int) -> list[dict]:
+    """Run one chat session; each turn resends the accumulated history."""
+    records = []
+    history = ""
+    for t in range(turns):
+        user_msg = USER_TOPICS[t % len(USER_TOPICS)]
+        prompt = f"{history}User: {user_msg}\nAssistant:"
+        payload = {"model": model, "prompt": prompt, "temperature": 0.0,
+                   "stream": True, "options": {"num_predict": max_tokens}}
+        t0 = time.perf_counter()
+        ttft = None
+        chunks = []
+        n_tokens = 0
+        async with session.post(url, json=payload) as resp:
+            resp.raise_for_status()
+            async for line in resp.content:
+                if not line.strip():
+                    continue
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+                rec = json.loads(line)
+                if rec.get("response"):
+                    chunks.append(rec["response"])
+                if rec.get("done"):
+                    n_tokens = rec.get("eval_count", len(chunks))
+        e2e = time.perf_counter() - t0
+        reply = "".join(chunks)
+        history = prompt + reply + "\n"
+        records.append({
+            "conv": conv_id, "turn": t, "prompt_chars": len(prompt),
+            "ttft_s": ttft, "e2e_s": e2e, "output_tokens": n_tokens,
+            "tpot_s": ((e2e - ttft) / (n_tokens - 1)
+                       if ttft is not None and n_tokens > 1 else None),
+        })
+    return records
+
+
+async def _drive(port: int, model: str, conversations: int, turns: int,
+                 max_tokens: int) -> list[dict]:
+    import aiohttp
+
+    url = f"http://127.0.0.1:{port}/api/generate"
+    timeout = aiohttp.ClientTimeout(total=1800)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        results = await asyncio.gather(*[
+            _one_conversation(session, url, model, c, turns, max_tokens)
+            for c in range(conversations)])
+    return [r for conv in results for r in conv]
+
+
+def _summarize(records: list[dict], turns: int) -> dict:
+    ttfts = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
+    tpots = [r["tpot_s"] for r in records if r["tpot_s"] is not None]
+    by_turn = []
+    for t in range(turns):
+        xs = [r["ttft_s"] for r in records
+              if r["turn"] == t and r["ttft_s"] is not None]
+        by_turn.append(round(float(np.median(xs)), 4) if xs else None)
+    return {
+        "requests": len(records),
+        "output_tokens": int(sum(r["output_tokens"] for r in records)),
+        "ttft_s": _percentiles(ttfts),
+        "tpot_s": _percentiles(tpots),
+        "ttft_p50_by_turn": by_turn,
+        "final_prompt_chars_p50": round(float(np.median(
+            [r["prompt_chars"] for r in records
+             if r["turn"] == turns - 1])), 0) if records else None,
+    }
+
+
+def run_once(args, enable_prefix_cache: bool) -> dict:
+    args.enable_prefix_cache = enable_prefix_cache
+    srv, port, stop = start_server(args)
+    try:
+        t0 = time.perf_counter()
+        records = asyncio.run(_drive(port, args.model, args.conversations,
+                                     args.turns, args.max_tokens))
+        wall = time.perf_counter() - t0
+        summary = _summarize(records, args.turns)
+        summary["wall_s"] = round(wall, 3)
+        stats = srv.group.stats_snapshot()
+        summary["prefix_cache_enabled"] = enable_prefix_cache
+        summary["tokens_prefix_cached"] = stats.get("tokens_prefix_cached", 0)
+        summary["prefix_cache"] = stats.get("prefix_cache")
+        summary["steps"] = stats.get("steps")
+        summary["prefills"] = stats.get("prefills")
+    finally:
+        stop()
+    return summary
+
+
+def main() -> dict:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="tiny-llama")
+    p.add_argument("--tokenizer", default="byte")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--draft-model", default=None)
+    p.add_argument("--draft-checkpoint", default=None)
+    p.add_argument("--num-speculative-tokens", type=int, default=0)
+    p.add_argument("--conversations", type=int, default=6)
+    p.add_argument("--turns", type=int, default=5)
+    p.add_argument("--max-tokens", type=int, default=48,
+                   help="assistant tokens per turn")
+    p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages-per-seq", type=int, default=64)
+    p.add_argument("--decode-steps-per-call", type=int, default=8)
+    p.add_argument("--decode-pipeline-depth", type=int, default=1)
+    p.add_argument("--quant", default="none", choices=("none", "int8"))
+    p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--compare", action="store_true",
+                   help="also run with the prefix cache disabled and "
+                        "report the TTFT delta")
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    # Snapshot before run_once mutates args (enable_prefix_cache toggles).
+    out = {"config": dict(vars(args))}
+    out["cached"] = run_once(args, enable_prefix_cache=True)
+    if args.compare:
+        out["uncached"] = run_once(args, enable_prefix_cache=False)
+        c, u = out["cached"], out["uncached"]
+        if c["ttft_s"]["p50"] and u["ttft_s"]["p50"]:
+            out["ttft_p50_speedup_from_cache"] = round(
+                u["ttft_s"]["p50"] / c["ttft_s"]["p50"], 3)
+    print(json.dumps({k: v for k, v in out.items() if k != "config"},
+                     indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -96,6 +96,8 @@ def start_server(args) -> tuple:
         page_size=args.page_size, max_pages_per_seq=args.max_pages_per_seq,
         decode_steps_per_call=args.decode_steps_per_call,
         decode_pipeline_depth=args.decode_pipeline_depth,
+        quant=getattr(args, "quant", "none"),
+        enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
         num_speculative_tokens=(args.num_speculative_tokens
                                 if args.draft_model else 0))
     loop = asyncio.new_event_loop()
@@ -150,6 +152,7 @@ def main() -> dict:
     p.add_argument("--decode-steps-per-call", type=int, default=8)
     p.add_argument("--decode-pipeline-depth", type=int, default=1)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--quant", default="none", choices=("none", "int8"))
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     args = p.parse_args()
