@@ -97,6 +97,7 @@ def start_server(args) -> tuple:
         decode_steps_per_call=args.decode_steps_per_call,
         decode_pipeline_depth=args.decode_pipeline_depth,
         quant=getattr(args, "quant", "none"),
+        kv_quant=getattr(args, "kv_quant", "none"),
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
         num_speculative_tokens=(args.num_speculative_tokens
                                 if args.draft_model else 0))
@@ -153,6 +154,7 @@ def main() -> dict:
     p.add_argument("--decode-pipeline-depth", type=int, default=1)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--quant", default="none", choices=("none", "int8"))
+    p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     args = p.parse_args()

@@ -1,0 +1,140 @@
+"""int8 KV-cache quantization (engine/kv_cache.py quantize_kv + kernels).
+
+The pool stores int8 codes with per-(token, kv-head) scales; dequant is
+in-kernel for the Pallas decode/prefill kernels and at-gather for the
+dense path. The reference has no KV cache at all (client-only, SURVEY.md
+§0); this is the memory-bandwidth tier of the server its external
+endpoint provided. Tests pin: quantization error bounds, write/gather
+roundtrip through the paged pool, cross-backend token equality (dense
+gather vs Pallas in-kernel dequant read the same codes, so greedy tokens
+must match exactly), TP-sharded equality, and spec-decode compatibility.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_inference.config import (
+    EngineConfig,
+    ParallelConfig,
+    tiny_llama,
+    tiny_mixtral,
+)
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.engine import InferenceEngine
+
+BASE = dict(num_pages=64, max_batch_size=2, prefill_buckets=(64,),
+            max_new_tokens=16)
+PROMPTS = [list(range(1, 20)), list(range(5, 40))]
+
+
+def test_quantize_kv_error_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 3, 16)) * 2.0
+    q, scale = kvc.quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 5, 3)
+    err = jnp.abs(q.astype(jnp.float32) * scale[..., None] - x)
+    assert bool((err <= scale[..., None] / 2 + 1e-6).all())
+
+
+def test_write_gather_roundtrip_quantized():
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, kv_quant="int8")
+    kv = kvc.alloc_kv_pages(cfg, ecfg)
+    assert kv.quantized and kv.k.dtype == jnp.int8
+    k_new = jax.random.normal(jax.random.PRNGKey(1),
+                              (1, 4, cfg.n_kv_heads, cfg.head_dim))
+    v_new = jax.random.normal(jax.random.PRNGKey(2), k_new.shape)
+    bt = jnp.zeros((1, ecfg.max_pages_per_seq), jnp.int32).at[0, 0].set(3)
+    positions = jnp.arange(4)[None]
+    valid = jnp.ones((1, 4), bool)
+    slots = kvc.slot_mapping(bt, positions, valid, ecfg.page_size)
+    kv = kvc.write_kv(kv, 0, k_new, v_new, slots)
+    k_got, v_got = kvc.gather_kv(kv, 0, bt)
+    # Dequantized readback within the per-row quantization envelope.
+    _, ks = kvc.quantize_kv(k_new)
+    np.testing.assert_allclose(np.asarray(k_got[0, :4]),
+                               np.asarray(k_new[0], np.float32),
+                               atol=float(ks.max()) / 2 + 1e-6)
+    _, vs = kvc.quantize_kv(v_new)
+    np.testing.assert_allclose(np.asarray(v_got[0, :4]),
+                               np.asarray(v_new[0], np.float32),
+                               atol=float(vs.max()) / 2 + 1e-6)
+
+
+def test_unquantized_pool_unchanged():
+    cfg = tiny_llama()
+    kv = kvc.alloc_kv_pages(cfg, EngineConfig(**BASE))
+    assert not kv.quantized and kv.k_scale is None
+
+
+def test_dense_and_pallas_token_equal_kv_int8():
+    """Both backends read the SAME int8 codes; greedy tokens must agree
+    exactly (in-kernel dequant == gather dequant)."""
+    cfg = tiny_llama()
+    dense = InferenceEngine(cfg, EngineConfig(**BASE, kv_quant="int8"),
+                            seed=0).generate(PROMPTS, max_new_tokens=10)
+    pallas = InferenceEngine(
+        cfg, EngineConfig(**BASE, kv_quant="int8", attn_backend="pallas"),
+        seed=0).generate(PROMPTS, max_new_tokens=10)
+    assert dense == pallas
+
+
+def test_kv_int8_close_to_full_precision():
+    cfg = tiny_llama()
+    fp = InferenceEngine(cfg, EngineConfig(**BASE),
+                         seed=0).generate(PROMPTS, max_new_tokens=10)
+    kv8 = InferenceEngine(cfg, EngineConfig(**BASE, kv_quant="int8"),
+                          seed=0).generate(PROMPTS, max_new_tokens=10)
+    # Greedy drift is bounded: the first tokens (short context) agree.
+    assert fp[0][:4] == kv8[0][:4]
+
+
+def test_tp_sharded_kv_int8_matches_unsharded():
+    from tpu_inference.parallel.mesh import build_mesh
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, kv_quant="int8", attn_backend="pallas")
+    base = InferenceEngine(cfg, ecfg, seed=0).generate(PROMPTS,
+                                                       max_new_tokens=10)
+    mesh = build_mesh(ParallelConfig(tp=2))
+    tp_eng = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+    assert tp_eng.kv.k_scale.sharding.spec == \
+        jax.sharding.PartitionSpec(None, None, None, "tp")
+    assert base == tp_eng.generate(PROMPTS, max_new_tokens=10)
+
+
+def test_mixtral_kv_int8():
+    cfg = tiny_mixtral()
+    out = InferenceEngine(cfg, EngineConfig(**BASE, kv_quant="int8"),
+                          seed=0).generate([PROMPTS[0]], max_new_tokens=8)
+    assert len(out[0]) == 8
+
+
+def test_spec_decode_with_kv_int8():
+    cfg = tiny_llama()
+    draft = dataclasses.replace(cfg, n_layers=1, name="draft")
+    ecfg = EngineConfig(**BASE, kv_quant="int8", num_speculative_tokens=2,
+                        enable_prefix_cache=False)
+    eng = InferenceEngine(cfg, ecfg, seed=0, draft_cfg=draft)
+    assert eng.draft_kv.quantized
+    out = eng.generate([PROMPTS[0]], max_new_tokens=6)
+    assert len(out[0]) == 6
+
+
+def test_both_quant_tiers_together():
+    """Weights int8 + KV int8 — the full memory-bandwidth configuration."""
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, quant="int8", kv_quant="int8",
+                        attn_backend="pallas")
+    out = InferenceEngine(cfg, ecfg, seed=0).generate(PROMPTS,
+                                                      max_new_tokens=8)
+    assert all(len(t) == 8 for t in out)
+    assert all(0 <= tok < cfg.vocab_size for t in out for tok in t)
+
+
+def test_unknown_kv_quant_mode_rejected():
+    import pytest
+    cfg = tiny_llama()
+    with pytest.raises(ValueError, match="unknown kv_quant"):
+        InferenceEngine(cfg, EngineConfig(**BASE, kv_quant="fp8"), seed=0)

@@ -188,6 +188,12 @@ class EngineConfig:
     # HBM weight traffic that bounds decode throughput. "none" = serve
     # in the model dtype.
     quant: str = "none"
+    # KV-cache quantization: "int8" stores pool pages as int8 codes with
+    # per-(token, kv-head) f32 scales (engine/kv_cache.py quantize_kv) —
+    # halves KV HBM traffic AND doubles the context that fits in a pool
+    # of the same byte size. Dequant is in-kernel (Pallas) or at gather
+    # (dense path).
+    kv_quant: str = "none"
     # Device-side decode steps fused per host call (lax.scan): each host
     # round trip costs ~dispatch latency, so K steps per call multiply
     # steady-state decode throughput by up to K. Streamed tokens are

@@ -73,39 +73,68 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
 
+    def _scales(kv: KVPages, layer_idx):
+        if not kv.quantized:
+            return None, None
+        return kv.k_scale[layer_idx], kv.v_scale[layer_idx]
+
+    def _sharded_paged_call(kernel, kv: KVPages, layer_idx, lead_args,
+                            lead_specs, out_spec):
+        """shard_map a paged kernel over tp: pool (+ scale pool when the
+        KV is int8-quantized) shards on the kv-head dim; scale operands
+        append conditionally so the quantized/unquantized paths share
+        one spec assembly (same pattern as the kernels' own operand
+        lists)."""
+        from jax.sharding import PartitionSpec as P
+        pool_p = P(None, None, "tp", None)             # [P, pg, Hkv, D]
+        args = list(lead_args) + [kv.k[layer_idx], kv.v[layer_idx]]
+        specs = list(lead_specs) + [pool_p, pool_p]
+        if kv.quantized:
+            scale_p = P(None, None, "tp")              # [P, pg, Hkv]
+            args += [kv.k_scale[layer_idx], kv.v_scale[layer_idx]]
+            specs += [scale_p, scale_p]
+        return jax.shard_map(
+            kernel, mesh=mesh, in_specs=tuple(specs), out_specs=out_spec,
+            check_vma=False)(*args)
+
     def _pallas_decode(q1, kv: KVPages, layer_idx):
         from tpu_inference.kernels.paged_attention import paged_attention
         if mesh is None:
+            ks, vs = _scales(kv, layer_idx)
             return paged_attention(q1, kv.k[layer_idx], kv.v[layer_idx],
-                                   block_tables, kv_len)
+                                   block_tables, kv_len, ks, vs)
         from jax.sharding import PartitionSpec as P
         head_p = P(None, "tp", None)                   # q/out [B, H*, D]
-        pool_p = P(None, None, "tp", None)             # [P, pg, Hkv, D]
-        return jax.shard_map(
-            lambda q_, k_, v_, bt_, kl_: paged_attention(q_, k_, v_, bt_, kl_),
-            mesh=mesh,
-            in_specs=(head_p, pool_p, pool_p, P(), P()),
-            out_specs=head_p, check_vma=False,
-        )(q1, kv.k[layer_idx], kv.v[layer_idx], block_tables, kv_len)
+
+        def kernel(q_, bt_, kl_, k_, v_, *scales):
+            ks_, vs_ = scales if scales else (None, None)
+            return paged_attention(q_, k_, v_, bt_, kl_, ks_, vs_)
+
+        return _sharded_paged_call(
+            kernel, kv, layer_idx,
+            lead_args=(q1, block_tables, kv_len),
+            lead_specs=(head_p, P(), P()), out_spec=head_p)
 
     def _pallas_prefill(q, kv: KVPages, layer_idx):
         from tpu_inference.kernels.prefill_attention import (
             paged_prefill_attention)
         if mesh is None:
+            ks, vs = _scales(kv, layer_idx)
             return paged_prefill_attention(q, kv.k[layer_idx],
                                            kv.v[layer_idx], block_tables,
-                                           kv_len, q_offset)
+                                           kv_len, q_offset, ks, vs)
         from jax.sharding import PartitionSpec as P
         head_p = P(None, None, "tp", None)             # q/out [B, S, H*, D]
-        pool_p = P(None, None, "tp", None)             # [P, pg, Hkv, D]
-        return jax.shard_map(
-            lambda q_, k_, v_, bt_, kl_, qo_: paged_prefill_attention(
-                q_, k_, v_, bt_, kl_, qo_),
-            mesh=mesh,
-            in_specs=(head_p, pool_p, pool_p, P(), P(), P()),
-            out_specs=head_p, check_vma=False,
-        )(q, kv.k[layer_idx], kv.v[layer_idx], block_tables, kv_len,
-          q_offset)
+
+        def kernel(q_, bt_, kl_, qo_, k_, v_, *scales):
+            ks_, vs_ = scales if scales else (None, None)
+            return paged_prefill_attention(q_, k_, v_, bt_, kl_, qo_,
+                                           ks_, vs_)
+
+        return _sharded_paged_call(
+            kernel, kv, layer_idx,
+            lead_args=(q, block_tables, kv_len, q_offset),
+            lead_specs=(head_p, P(), P(), P()), out_spec=head_p)
 
     def attn(layer_idx, q, k, v, kv: KVPages):
         slots = kvc.slot_mapping(block_tables, positions, valid, page_size)
@@ -209,7 +238,7 @@ class InferenceEngine:
             params = shard_fn(params)
         params = maybe_quantize(params)
         self.mesh = mesh
-        kv_sh = None
+        kv_sh = kv_scale_sh = None
         if mesh is not None:
             # Declarative TP/EP: annotate weights + KV pool, let GSPMD place
             # the ICI collectives. The jitted graphs pick the shardings up
@@ -217,10 +246,12 @@ class InferenceEngine:
             from tpu_inference.parallel import shardings as shd
             params = shd.shard_params(params, model_cfg, mesh)
             kv_sh = shd.kv_sharding(mesh)
+            kv_scale_sh = shd.kv_scale_sharding(mesh)
         self.params = params
         self.n_params = int(sum(x.size for x in jax.tree.leaves(params)))
         self.attn_backend = backend
-        self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh)
+        self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh,
+                                     scale_sharding=kv_scale_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
         spec_on = (draft_cfg is not None
                    and engine_cfg.num_speculative_tokens > 0)
@@ -278,7 +309,8 @@ class InferenceEngine:
                                                  mesh)
             self.draft_params = draft_params
             self.draft_kv = kvc.alloc_kv_pages(draft_cfg, engine_cfg,
-                                               sharding=kv_sh)
+                                               sharding=kv_sh,
+                                               scale_sharding=kv_scale_sh)
             from tpu_inference.engine.speculative import spec_round
             self._spec_jit = jax.jit(partial(spec_round, self),
                                      donate_argnums=(2, 3))

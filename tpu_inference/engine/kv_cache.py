@@ -31,10 +31,21 @@ from tpu_inference.config import EngineConfig, ModelConfig
 
 
 class KVPages(NamedTuple):
-    """Device-side KV pool. k, v: [L, num_pages, page_size, Hkv, head_dim]."""
+    """Device-side KV pool. k, v: [L, num_pages, page_size, Hkv, head_dim].
+
+    With int8 KV quantization (EngineConfig.kv_quant), k/v hold int8
+    codes and ``k_scale``/``v_scale`` hold per-(token, kv-head) f32
+    scales ``[L, num_pages, page_size, Hkv]`` — symmetric quantization
+    over the head_dim axis, the standard KV-cache scheme. Decode HBM
+    traffic for the KV working set halves vs bf16; dequantization
+    happens on the consumer side (in-kernel for Pallas, at gather for
+    the dense path). ``None`` scales = unquantized pool.
+    """
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_pages(self) -> int:
@@ -44,16 +55,43 @@ class KVPages(NamedTuple):
     def page_size(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
 
 def alloc_kv_pages(model_cfg: ModelConfig, engine_cfg: EngineConfig,
-                   dtype=None, sharding=None) -> KVPages:
+                   dtype=None, sharding=None,
+                   scale_sharding=None) -> KVPages:
     """Allocate the pool; with ``sharding`` each chip materializes only its
     shard (never the full replicated pool — at 70B scale that would OOM)."""
     shape = (model_cfg.n_layers, engine_cfg.num_pages, engine_cfg.page_size,
              model_cfg.n_kv_heads, model_cfg.head_dim)
     dtype = dtype or model_cfg.dtype
+    if engine_cfg.kv_quant not in ("none", "int8"):
+        raise ValueError(f"unknown kv_quant mode {engine_cfg.kv_quant!r}; "
+                         "one of ('none', 'int8')")
+    if engine_cfg.kv_quant == "int8":
+        zeros = jax.jit(lambda: jnp.zeros(shape, jnp.int8),
+                        out_shardings=sharding)
+        szeros = jax.jit(lambda: jnp.zeros(shape[:-1], jnp.float32),
+                         out_shardings=scale_sharding)
+        return KVPages(k=zeros(), v=zeros(), k_scale=szeros(),
+                       v_scale=szeros())
     zeros = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
     return KVPages(k=zeros(), v=zeros())
+
+
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(token, head) symmetric int8 over head_dim.
+
+    x: [B, S, Hkv, D] -> (codes int8 [B,S,Hkv,D], scale f32 [B,S,Hkv]).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def slot_mapping(block_tables: jax.Array, positions: jax.Array,
@@ -71,23 +109,46 @@ def slot_mapping(block_tables: jax.Array, positions: jax.Array,
 
 def write_kv(kv: KVPages, layer_idx: jax.Array, k_new: jax.Array,
              v_new: jax.Array, slots: jax.Array) -> KVPages:
-    """Scatter new K/V ([B, S, Hkv, D]) into the pool at flat ``slots`` [B,S]."""
+    """Scatter new K/V ([B, S, Hkv, D]) into the pool at flat ``slots`` [B,S].
+
+    Quantized pools quantize on the way in (codes + per-token-head scale
+    scatter to the same flat slots)."""
     L, P, pg, H, D = kv.k.shape
     flat = slots.reshape(-1)
+    if kv.quantized:
+        k_new, ks = quantize_kv(k_new)
+        v_new, vs = quantize_kv(v_new)
+        ksf = kv.k_scale.reshape(L, P * pg, H)
+        vsf = kv.v_scale.reshape(L, P * pg, H)
+        ksf = ksf.at[layer_idx, flat].set(ks.reshape(-1, H))
+        vsf = vsf.at[layer_idx, flat].set(vs.reshape(-1, H))
+        k_scale = ksf.reshape(L, P, pg, H)
+        v_scale = vsf.reshape(L, P, pg, H)
+    else:
+        k_scale, v_scale = kv.k_scale, kv.v_scale
     kf = kv.k.reshape(L, P * pg, H, D)
     vf = kv.v.reshape(L, P * pg, H, D)
-    kf = kf.at[layer_idx, flat].set(k_new.reshape(-1, H, D))
-    vf = vf.at[layer_idx, flat].set(v_new.reshape(-1, H, D))
-    return KVPages(k=kf.reshape(L, P, pg, H, D), v=vf.reshape(L, P, pg, H, D))
+    kf = kf.at[layer_idx, flat].set(k_new.reshape(-1, H, D).astype(kv.k.dtype))
+    vf = vf.at[layer_idx, flat].set(v_new.reshape(-1, H, D).astype(kv.v.dtype))
+    return KVPages(k=kf.reshape(L, P, pg, H, D), v=vf.reshape(L, P, pg, H, D),
+                   k_scale=k_scale, v_scale=v_scale)
 
 
 def gather_kv(kv: KVPages, layer_idx: jax.Array,
               block_tables: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Gather each sequence's pages into contiguous [B, max_pages*pg, H, D]."""
+    """Gather each sequence's pages into contiguous [B, max_pages*pg, H, D].
+
+    Quantized pools dequantize after the gather (f32 out — the dense
+    attention path computes in f32 anyway)."""
     b, mp = block_tables.shape
     _, _, pg, H, D = kv.k.shape
     k = kv.k[layer_idx][block_tables].reshape(b, mp * pg, H, D)
     v = kv.v[layer_idx][block_tables].reshape(b, mp * pg, H, D)
+    if kv.quantized:
+        ks = kv.k_scale[layer_idx][block_tables].reshape(b, mp * pg, H)
+        vs = kv.v_scale[layer_idx][block_tables].reshape(b, mp * pg, H)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     return k, v
 
 

@@ -66,6 +66,7 @@ class SchedulerStats:
             "approx_flops_per_token": 2 * engine.n_params,
             "attn_backend": engine.attn_backend,
             "quant": engine.engine_cfg.quant,
+            "kv_quant": engine.engine_cfg.kv_quant,
             "decode_pipeline_depth": engine.engine_cfg.decode_pipeline_depth,
         }
         if engine.prefix_cache is not None:

@@ -34,8 +34,11 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
-                   out_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                   scale: float):
+                   *rest, page_size: int, scale: float, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        out_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
     num_pages = pl.num_programs(1)
@@ -56,6 +59,11 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
         # bring the kv-head dim to the front before the batched contractions.
         k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
         v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
+        if quantized:
+            # int8 codes * per-(token, head) scale — dequant in VMEM, so
+            # HBM sees one int8 read of the page.
+            k = k * ks_ref[0].astype(jnp.float32).transpose(1, 0)[:, :, None]
+            v = v * vs_ref[0].astype(jnp.float32).transpose(1, 0)[:, :, None]
 
         # scores[h, r, t] = <q[h, r], k[h, t]> * scale
         s = jax.lax.dot_general(
@@ -88,6 +96,8 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     block_tables: jax.Array, kv_len: jax.Array,
+                    k_scale: jax.Array | None = None,
+                    v_scale: jax.Array | None = None,
                     interpret: bool | None = None) -> jax.Array:
     """Decode attention over the paged KV pool.
 
@@ -95,10 +105,14 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     k/v_pages:    [P, page_size, Hkv, D]  (one layer's pool)
     block_tables: [B, MP] int32 physical page ids (0 = trash page)
     kv_len:       [B] int32 valid tokens per sequence (incl. current)
+    k/v_scale:    [P, page_size, Hkv] f32 — present when the pool holds
+                  int8 codes (engine/kv_cache.py quantize_kv); dequant
+                  happens in VMEM after each page's DMA.
     Returns [B, Hq, D] in q.dtype.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
     b, hq, d = q.shape
     _, page_size, hkv, _ = k_pages.shape
     n_rep = hq // hkv
@@ -107,16 +121,24 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
     q_g = q.reshape(b, hkv, n_rep, d)
 
+    page_spec = pl.BlockSpec((1, page_size, hkv, d),
+                             lambda i, p, bt, kl: (bt[i, p], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, hkv, n_rep, d), lambda i, p, bt, kl: (i, 0, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q_g, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, page_size, hkv),
+                                  lambda i, p, bt, kl: (bt[i, p], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # block_tables, kv_len
         grid=(b, mp),
-        in_specs=[
-            pl.BlockSpec((1, hkv, n_rep, d), lambda i, p, bt, kl: (i, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, hkv, d),
-                         lambda i, p, bt, kl: (bt[i, p], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, hkv, d),
-                         lambda i, p, bt, kl: (bt[i, p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, n_rep, d),
                                lambda i, p, bt, kl: (i, 0, 0, 0)),
         scratch_shapes=[
@@ -126,9 +148,10 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, page_size=page_size, scale=scale),
+        functools.partial(_decode_kernel, page_size=page_size, scale=scale,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),
         interpret=interpret,
-    )(block_tables, kv_len, q_g, k_pages, v_pages)
+    )(block_tables, kv_len, *operands)
     return out.reshape(b, hq, d)

@@ -36,8 +36,12 @@ NEG_INF = -1e30
 
 
 def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
-                    v_ref, out_ref, m_ref, l_ref, acc_ref, *, page_size: int,
-                    block_q: int, n_rep: int, scale: float):
+                    v_ref, *rest, page_size: int, block_q: int, n_rep: int,
+                    scale: float, quantized: bool):
+    if quantized:
+        ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        out_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     qb = pl.program_id(1)
     p = pl.program_id(2)
@@ -62,6 +66,9 @@ def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
         # leading on both sides.
         k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
         v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+        if quantized:
+            k = k * ks_ref[0].astype(jnp.float32).transpose(1, 0)[:, :, None]
+            v = v * vs_ref[0].astype(jnp.float32).transpose(1, 0)[:, :, None]
 
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
@@ -96,6 +103,8 @@ def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
 def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
                             v_pages: jax.Array, block_tables: jax.Array,
                             kv_len: jax.Array, q_offset: jax.Array,
+                            k_scale: jax.Array | None = None,
+                            v_scale: jax.Array | None = None,
                             block_q: int = 128,
                             interpret: bool | None = None) -> jax.Array:
     """Prefill attention over the paged KV pool.
@@ -106,10 +115,13 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
     block_tables: [B, MP] int32 physical page ids (0 = trash page)
     kv_len:       [B] total valid tokens (cached prefix + this chunk)
     q_offset:     [B] absolute position of q[:, 0] (= prefix length)
+    k/v_scale:    [P, page_size, Hkv] f32 when the pool is int8-quantized
+                  (engine/kv_cache.py); dequant happens in VMEM per page.
     Returns [B, S, Hq, D] in q.dtype.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
     b, s, hq, d = q.shape
     _, page_size, hkv, _ = k_pages.shape
     n_rep = hq // hkv
@@ -126,17 +138,26 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
            .transpose(0, 1, 3, 2, 4, 5)
            .reshape(b, n_qb, hkv, bq * n_rep, d))
 
+    page_spec = pl.BlockSpec((1, page_size, hkv, d),
+                             lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, hkv, bq * n_rep, d),
+                     lambda i, qb, p, bt, kl, qo: (i, qb, 0, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [q_g, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, page_size, hkv),
+            lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,        # block_tables, kv_len, q_offset
         grid=(b, n_qb, mp),
-        in_specs=[
-            pl.BlockSpec((1, 1, hkv, bq * n_rep, d),
-                         lambda i, qb, p, bt, kl, qo: (i, qb, 0, 0, 0)),
-            pl.BlockSpec((1, page_size, hkv, d),
-                         lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, hkv, d),
-                         lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, hkv, bq * n_rep, d),
             lambda i, qb, p, bt, kl, qo: (i, qb, 0, 0, 0)),
@@ -148,12 +169,12 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_prefill_kernel, page_size=page_size, block_q=bq,
-                          n_rep=n_rep, scale=scale),
+                          n_rep=n_rep, scale=scale, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_qb, hkv, bq * n_rep, d),
                                        q.dtype),
         interpret=interpret,
-    )(block_tables, kv_len, q_offset, q_g, k_pages, v_pages)
+    )(block_tables, kv_len, q_offset, *operands)
     return (out.reshape(b, n_qb, hkv, bq, n_rep, d)
             .transpose(0, 1, 3, 2, 4, 5)
             .reshape(b, s, hq, d))

@@ -173,3 +173,9 @@ def kv_spec() -> P:
 
 def kv_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, kv_spec())
+
+
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """Scale pool [L, pages, page_size, Hkv] (int8 KV): heads on tp,
+    aligned with the code pool so in-kernel dequant stays chip-local."""
+    return NamedSharding(mesh, P(None, None, None, "tp"))

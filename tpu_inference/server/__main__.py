@@ -50,6 +50,10 @@ def main() -> None:
                    help="weight quantization: int8 stores matmul weights "
                         "as int8 + per-channel scales, halving the HBM "
                         "weight traffic that bounds decode throughput")
+    p.add_argument("--kv-quant", default="none", choices=("none", "int8"),
+                   help="KV-cache quantization: int8 codes + per-token-"
+                        "head scales — halves KV HBM traffic and doubles "
+                        "the context a same-sized pool holds")
     p.add_argument("--draft-model", default=None,
                    help="enable speculative decoding with this draft "
                         "preset or HF checkpoint dir")
@@ -112,7 +116,7 @@ def main() -> None:
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,
                           attn_backend=args.attn_backend,
-                          quant=args.quant,
+                          quant=args.quant, kv_quant=args.kv_quant,
                           max_batch_size=args.max_batch_size,
                           num_pages=args.num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
