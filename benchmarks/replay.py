@@ -41,7 +41,7 @@ def _percentiles(xs, ps=(50, 99)):
 def summarize(metrics: dict, n_chips: int = 1) -> dict:
     """Reduce the harness's per-request dicts to the headline numbers."""
     ok = {k: m for k, m in metrics.items() if m.get("success")}
-    ttft, tpot, e2e, tokens = [], [], [], 0
+    ttft, tpot, e2e, gaps, tokens = [], [], [], [], 0
     t_first, t_last = float("inf"), 0.0
     for m in ok.values():
         start = m["request_start_time"]
@@ -54,6 +54,8 @@ def summarize(metrics: dict, n_chips: int = 1) -> dict:
             e2e.append(end - start)
         if end is not None and first is not None and n_out > 1:
             tpot.append((end - first) / (n_out - 1))
+        if m.get("max_interchunk_gap") is not None:
+            gaps.append(m["max_interchunk_gap"])
         tokens += n_out
         if start is not None:
             t_first = min(t_first, start)
@@ -70,6 +72,9 @@ def summarize(metrics: dict, n_chips: int = 1) -> dict:
         "ttft_s": _percentiles(ttft),
         "tpot_s": _percentiles(tpot),
         "e2e_s": _percentiles(e2e),
+        # Worst per-request stall between streamed chunks (the K-bursty
+        # flush sawtooth a mean TPOT hides).
+        "max_interchunk_gap_s": _percentiles(gaps),
     }
 
 

@@ -163,3 +163,22 @@ def test_load_checkpoint_quantizes_at_load(tmp_path):
     # Norm/embed leaves untouched.
     np.testing.assert_array_equal(np.asarray(got["embed"]),
                                   np.asarray(full["embed"]))
+
+
+def test_orbax_roundtrip_quantized_params(tmp_path):
+    """Orbax save/restore preserves QuantizedArray trees (int8 codes +
+    scales survive as pytree leaves) — checkpoint/resume works for a
+    quantized deployment without re-quantizing from the HF source."""
+    from tpu_inference.models.quant import QuantizedArray, quantize_params
+    from tpu_inference.models.registry import build_model
+    from tpu_inference.models.weights import load_native, save_native
+
+    cfg = cfgs.tiny_llama()
+    params, _ = build_model(cfg, seed=3)
+    qp = quantize_params(params)
+    path = str(tmp_path / "native-q")
+    save_native(qp, path)
+    restored = load_native(path, qp)
+    assert isinstance(restored["blocks"]["wq"], QuantizedArray)
+    assert restored["blocks"]["wq"].q.dtype == jnp.int8
+    _assert_tree_equal(restored, qp)

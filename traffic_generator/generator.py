@@ -84,11 +84,21 @@ class TrafficGenerator:
                 n_lines = 0
                 buf = b""
                 last_line = b""
+                # Streaming smoothness: fused K-step decode flushes tokens
+                # in bursts, so the worst inter-chunk gap (not just mean
+                # TPOT) is what a user perceives as a stall. Additive
+                # metric field; reference schema otherwise preserved.
+                prev_chunk_t = None
+                max_gap = 0.0
                 async for _chunk in resp.content:
+                    now = collector.elapsed()
                     if first:
                         collector.record(query_id, "first_token_arrive_time",
-                                         collector.elapsed())
+                                         now)
                         first = False
+                    else:
+                        max_gap = max(max_gap, now - prev_chunk_t)
+                    prev_chunk_t = now
                     n_lines += _chunk.count(b"\n")
                     # Track the last COMPLETE line whole: the terminal
                     # record carries the full `context` id list and can be
@@ -104,6 +114,7 @@ class TrafficGenerator:
                 collector.record(query_id, "num_output_tokens",
                                  self._count_tokens(buf or last_line,
                                                     n_lines))
+                collector.record(query_id, "max_interchunk_gap", max_gap)
                 collector.record(query_id, "success", True)
                 end = collector.metrics[query_id]["response_end_time"]
                 start = collector.metrics[query_id].get(

@@ -46,6 +46,7 @@ class MetricCollector:
             "first_token_arrive_time": None,
             "response_end_time": None,
             "num_output_tokens": None,
+            "max_interchunk_gap": None,
             "scheduled_start_time": scheduled_start,
             "success": None,
         }
