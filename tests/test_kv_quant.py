@@ -138,3 +138,33 @@ def test_unknown_kv_quant_mode_rejected():
     cfg = tiny_llama()
     with pytest.raises(ValueError, match="unknown kv_quant"):
         InferenceEngine(cfg, EngineConfig(**BASE, kv_quant="fp8"), seed=0)
+
+
+def test_prefix_cache_reuses_quantized_pages():
+    """Cached pages hold int8 codes + scales; a second request sharing
+    the prefix must reuse them and produce the same tokens as a cold
+    run (cache hits are output-invisible, quantized or not)."""
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, kv_quant="int8")
+    eng = InferenceEngine(cfg, ecfg, seed=0)
+    cold = eng.generate([PROMPTS[1]], max_new_tokens=8)
+    hits_before = eng.prefix_cache.stats()["hits"]
+    warm = eng.generate([PROMPTS[1]], max_new_tokens=8)
+    assert eng.prefix_cache.stats()["hits"] > hits_before
+    assert cold == warm
+
+
+def test_sp_ring_prefill_with_kv_int8():
+    """sp>1 ring-attention prefill writes the chunk's KV into the
+    quantized pool; decode then reads int8 codes — token-equal to the
+    unsharded int8-KV engine."""
+    from tpu_inference.parallel.mesh import build_mesh
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, kv_quant="int8")
+    prompt = [list(range(1, 33))]                 # 32 % sp == 0
+    base = InferenceEngine(cfg, ecfg, seed=0).generate(prompt,
+                                                       max_new_tokens=8)
+    mesh = build_mesh(ParallelConfig(tp=2, sp=2))
+    eng = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+    assert eng.sp == 2
+    assert base == eng.generate(prompt, max_new_tokens=8)

@@ -243,6 +243,12 @@ def test_aux_routes(server):
         assert "kv_pages_in_use" in metrics
         version = await (await client.get("/api/version")).json()
         assert "version" in version
+        show = await (await client.post("/api/show",
+                                        json={"model": "m"})).json()
+        assert show["details"]["family"] == "llama"
+        info = show["model_info"]
+        assert info["llama.context_length"] > 0
+        assert info["general.parameter_count"] > 0
 
     _run(server, go)
 

@@ -119,6 +119,7 @@ class InferenceServer:
         app.router.add_post("/api/generate", self.handle_generate)
         app.router.add_post("/api/chat", self.handle_chat)
         app.router.add_get("/api/tags", self.handle_tags)
+        app.router.add_post("/api/show", self.handle_show)
         app.router.add_get("/api/version", self.handle_version)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
@@ -155,6 +156,33 @@ class InferenceServer:
             "details": {"family": self.cfg.model.family,
                         "parameter_size": self.cfg.model.name},
         }]})
+
+    async def handle_show(self, request: web.Request) -> web.Response:
+        """Ollama /api/show: model card for clients that introspect before
+        generating. Serves the architecture + serving knobs of the one
+        loaded model regardless of the requested name (single-model
+        server, like `ollama show` on a single-model host)."""
+        mc, ec = self.cfg.model, self.cfg.engine
+        return web.json_response({
+            "modelfile": "",
+            "details": {"family": mc.family, "format": "safetensors",
+                        "parameter_size": mc.name,
+                        "quantization_level": ec.quant},
+            "model_info": {
+                "general.architecture": mc.family,
+                "general.parameter_count": self.engine.n_params,
+                f"{mc.family}.context_length": ec.max_context,
+                f"{mc.family}.embedding_length": mc.d_model,
+                f"{mc.family}.block_count": mc.n_layers,
+                f"{mc.family}.attention.head_count": mc.n_heads,
+                f"{mc.family}.attention.head_count_kv": mc.n_kv_heads,
+                f"{mc.family}.vocab_size": mc.vocab_size,
+                # Resolved backend (not the "auto" sentinel) — matches
+                # what /metrics reports.
+                "serving.attn_backend": self.engine.attn_backend,
+                "serving.kv_quant": ec.kv_quant,
+            },
+        })
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.json_response(self.group.stats_snapshot())
