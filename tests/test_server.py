@@ -466,3 +466,27 @@ def test_pipelined_serving_contract():
             assert b["done"] and b["eval_count"] >= 1
 
     _run(srv, go)
+
+
+def test_repeat_penalty_option(server):
+    """options.repeat_penalty changes greedy output (applied pre-argmax,
+    Ollama semantics); invalid values 400."""
+    async def go(client):
+        base = {"prompt": "repeat repeat repeat", "stream": False,
+                "max_tokens": 16, "temperature": 0.0}
+        plain = (await (await client.post(
+            "/api/generate", json=base)).json())["context"]
+        pen = (await (await client.post("/api/generate", json={
+            **base, "options": {"repeat_penalty": 1.8,
+                                "repeat_last_n": 64}})).json())["context"]
+        assert plain != pen
+        # Penalized greedy decode is still deterministic.
+        pen2 = (await (await client.post("/api/generate", json={
+            **base, "options": {"repeat_penalty": 1.8,
+                                "repeat_last_n": 64}})).json())["context"]
+        assert pen == pen2
+        bad = await client.post("/api/generate", json={
+            **base, "options": {"repeat_penalty": 0}})
+        assert bad.status == 400
+
+    _run(server, go)

@@ -32,7 +32,12 @@ import numpy as np
 from tpu_inference.config import EngineConfig, ModelConfig
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.kv_cache import KVPages, PageAllocator
-from tpu_inference.engine.sampling import SamplingParams, sample
+from tpu_inference.engine.sampling import (
+    PENALTY_WINDOW,
+    SamplingParams,
+    roll_window,
+    sample,
+)
 from tpu_inference.models.registry import build_model, get_model_fns
 
 
@@ -167,6 +172,11 @@ class Sequence:
     top_p: float = 1.0
     top_k: Optional[int] = None            # None = engine default
     seed: Optional[int] = None             # None = engine-global key stream
+    # Ollama repetition penalty (1.0 = off; window clamps to
+    # sampling.PENALTY_WINDOW). Ignored under speculative decoding
+    # (rejection sampling needs the unmodified target distribution).
+    repeat_penalty: float = 1.0
+    repeat_last_n: int = 64
     eos_token_id: Optional[int] = None
     # Filled by the engine:
     slot: int = -1
@@ -323,7 +333,7 @@ class InferenceEngine:
 
     def _prefill_fn(self, params, kv: KVPages, tokens, prompt_len, prefix_len,
                     block_table, key, temperature, top_p, top_k, seed,
-                    sp_ring: bool = False):
+                    rpen, rlast, window, sp_ring: bool = False):
         """One sequence, tokens [1, S_bucket] right-padded.
 
         prefix_len > 0 means ``prefix_len`` tokens are already cached in this
@@ -350,7 +360,8 @@ class InferenceEngine:
         logits = self.mod.unembed(params, cfg, last)             # [1, V]
         sp = SamplingParams(temperature=temperature, top_p=top_p,
                             top_k=top_k, seed=seed)
-        tok = sample(logits, key, sp, ctx=total_len)
+        tok = sample(logits, key, sp, ctx=total_len, penalty_window=window,
+                     repeat_penalty=rpen, repeat_last_n=rlast)
         return kv, tok, logits
 
     def _draft_prefill_fn(self, draft_params, draft_kv: KVPages, tokens,
@@ -374,7 +385,7 @@ class InferenceEngine:
 
     def _decode_multi_fn(self, params, kv: KVPages, tokens, ctx_lens,
                          block_tables, allowed, eos_ids, key, temperature,
-                         top_p, top_k, seed):
+                         top_p, top_k, seed, rpen, rlast, window):
         """K fused decode steps under one dispatch (lax.scan on device).
 
         Sampled tokens feed back into the next step without leaving HBM;
@@ -384,14 +395,17 @@ class InferenceEngine:
 
         allowed: [B] int32 — steps each slot may advance this call (folds
         budget, context cap, and page headroom). eos_ids: [B] int32, -1
-        when the request has no EOS. Returns (kv, out [K, B] int32) with
-        -1 in slots that produced nothing at that step.
+        when the request has no EOS. window: [B, W] recent-token ring for
+        the repetition penalty, updated on device each step so fused
+        steps see their own samples. Returns (kv, out [K, B] int32, final
+        carry tokens [B], final window [B, W]) with -1 out entries for
+        slots that produced nothing at that step.
         """
         cfg = self.model_cfg
         ecfg = self.engine_cfg
 
         def step(carry, s):
-            kv, tokens, ctx_lens, alive = carry
+            kv, tokens, ctx_lens, alive, window = carry
             act = alive & (s < allowed)
             positions = jnp.minimum(ctx_lens, ecfg.max_context - 1)[:, None]
             attn = make_paged_attn(cfg, ecfg.page_size, block_tables,
@@ -408,24 +422,27 @@ class InferenceEngine:
             # (the current input token occupies ctx) — the seeded-stream
             # position that makes per-request seeds scheduling-invariant.
             toks = sample(logits, jax.random.fold_in(key, s), sp,
-                          ctx=ctx_lens + 1)
+                          ctx=ctx_lens + 1, penalty_window=window,
+                          repeat_penalty=rpen, repeat_last_n=rlast)
             toks = jnp.where(act, toks, tokens)
+            window = roll_window(window, toks, act)
             out = jnp.where(act, toks, -1)
             alive = alive & jnp.where(act, toks != eos_ids, True)
             ctx_lens = ctx_lens + act.astype(jnp.int32)
-            return (kv, toks, ctx_lens, alive), out
+            return (kv, toks, ctx_lens, alive, window), out
 
         k_steps = max(1, ecfg.decode_steps_per_call)
         alive0 = jnp.ones(tokens.shape, bool)
-        (kv, final_tokens, _, _), outs = jax.lax.scan(
-            step, (kv, tokens, ctx_lens, alive0),
+        (kv, final_tokens, _, _, final_window), outs = jax.lax.scan(
+            step, (kv, tokens, ctx_lens, alive0, window),
             jnp.arange(k_steps, dtype=jnp.int32))
-        # final_tokens [B] = each lane's carry after the last step: the
-        # input for a chained next call, letting callers dispatch call
-        # N+1 against call N's device-resident output with no host sync
-        # (dispatch-ahead, SURVEY.md §7 hard part 3 — the host/tunnel
-        # round trip otherwise gates decode throughput).
-        return kv, outs, final_tokens
+        # final_tokens [B] (and final_window) = each lane's carry after
+        # the last step: the input for a chained next call, letting
+        # callers dispatch call N+1 against call N's device-resident
+        # output with no host sync (dispatch-ahead, SURVEY.md §7 hard
+        # part 3 — the host/tunnel round trip otherwise gates decode
+        # throughput).
+        return kv, outs, final_tokens, final_window
 
     # ------------------------------------------------------------------
     # Host-side orchestration
@@ -450,17 +467,20 @@ class InferenceEngine:
             tp = jnp.ones((p,), jnp.float32)
             tk = jnp.zeros((p,), jnp.int32)
             sd = jnp.full((p,), -1, jnp.int32)
+            rp = jnp.ones((p,), jnp.float32)
+            rl = jnp.zeros((p,), jnp.int32)
+            win = jnp.full((p, PENALTY_WINDOW), -1, jnp.int32)
             for bucket in ecfg.prefill_buckets:
                 if bucket > ecfg.max_context:
                     continue
                 toks = jnp.zeros((p, bucket), jnp.int32)
                 self.kv, _, _ = self._prefill_jit(
                     self.params, self.kv, toks, one, zero, bt,
-                    self._next_key(), tz, tp, tk, sd)
+                    self._next_key(), tz, tp, tk, sd, rp, rl, win)
                 if self.sp > 1 and bucket % self.sp == 0:
                     self.kv, _, _ = self._prefill_sp_jit(
                         self.params, self.kv, toks, one, zero, bt,
-                        self._next_key(), tz, tp, tk, sd)
+                        self._next_key(), tz, tp, tk, sd, rp, rl, win)
                 if self.spec_enabled:
                     self.draft_kv = self._draft_prefill_jit(
                         self.draft_params, self.draft_kv, toks, one, zero,
@@ -476,14 +496,16 @@ class InferenceEngine:
                 jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32))
             self.kv, self.draft_kv = out.kv, out.draft_kv
         else:
-            self.kv, _, _ = self._decode_multi_jit(
+            self.kv, _, _, _ = self._decode_multi_jit(
                 self.params, self.kv, jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b, self.max_pages), jnp.int32),
                 jnp.zeros((b,), jnp.int32),
                 jnp.full((b,), -1, jnp.int32), self._next_key(),
                 jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32),
-                jnp.zeros((b,), jnp.int32), jnp.full((b,), -1, jnp.int32))
+                jnp.zeros((b,), jnp.int32), jnp.full((b,), -1, jnp.int32),
+                jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
+                jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -661,6 +683,12 @@ class InferenceEngine:
         bt = self._block_table_array(seq.pages)[None]
         chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
         top_k, rseed = self._sampling_arrays(seq)
+        rpen, rlast = self._penalty_arrays(seq)
+        # First sampled token's penalty window = the prompt tail (only the
+        # final chunk's sample is kept, so mid-chunk windows don't matter).
+        win = np.full((1, PENALTY_WINDOW), -1, np.int32)
+        if rpen != 1.0:
+            win[0] = self._penalty_window_row(seq)
         chunk = prompt[offset:offset + chunk_cap]
         bucket = ecfg.bucket_for(len(chunk))
         toks = np.zeros((1, bucket), np.int32)
@@ -675,7 +703,9 @@ class InferenceEngine:
             jnp.asarray([seq.temperature], np.float32),
             jnp.asarray([seq.top_p], np.float32),
             jnp.asarray([top_k], np.int32),
-            jnp.asarray([rseed], np.int32))
+            jnp.asarray([rseed], np.int32),
+            jnp.asarray([rpen], np.float32),
+            jnp.asarray([rlast], np.int32), jnp.asarray(win))
         if self.spec_enabled:
             # Mirror the chunk into the draft model's KV (same pages).
             self.draft_kv = self._draft_prefill_jit(
@@ -749,6 +779,9 @@ class InferenceEngine:
         top_ps = np.ones((p,), np.float32)
         top_ks = np.zeros((p,), np.int32)
         seeds = np.full((p,), -1, np.int32)
+        rpens = np.ones((p,), np.float32)
+        rlasts = np.zeros((p,), np.int32)
+        wins = np.full((p, PENALTY_WINDOW), -1, np.int32)
         for i, (seq, prompt) in enumerate(group):
             chunk = prompt[seq.cached_tokens:]
             toks[i, :len(chunk)] = chunk
@@ -758,12 +791,16 @@ class InferenceEngine:
             temps[i] = seq.temperature
             top_ps[i] = seq.top_p
             top_ks[i], seeds[i] = self._sampling_arrays(seq)
+            rpens[i], rlasts[i] = self._penalty_arrays(seq)
+            if rpens[i] != 1.0:
+                wins[i] = self._penalty_window_row(seq)
         prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
         self.kv, tok, _ = prefill(
             self.params, self.kv, jnp.asarray(toks), jnp.asarray(plen),
             jnp.asarray(pref), jnp.asarray(bts), self._next_key(),
             jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
-            jnp.asarray(seeds))
+            jnp.asarray(seeds), jnp.asarray(rpens), jnp.asarray(rlasts),
+            jnp.asarray(wins))
         if self.spec_enabled:
             self.draft_kv = self._draft_prefill_jit(
                 self.draft_params, self.draft_kv, jnp.asarray(toks),
@@ -847,10 +884,34 @@ class InferenceEngine:
             seed = int(seq.seed) & 0x7FFFFFFF
         return top_k, seed
 
+    def _penalty_arrays(self, seq: Sequence):
+        """(repeat_penalty, repeat_last_n) with Ollama conventions:
+        last_n < 0 means 'whole context' (clamped to the static window),
+        0 disables. Under speculative decoding the penalty is ignored
+        ENTIRELY (prefill included) — rejection sampling needs the
+        unmodified target distribution, and a first-token-only penalty
+        would be a silent half-application."""
+        if self.spec_enabled:
+            return 1.0, 0
+        rlast = int(seq.repeat_last_n)
+        if rlast < 0:
+            rlast = PENALTY_WINDOW
+        return float(seq.repeat_penalty), min(rlast, PENALTY_WINDOW)
+
+    @staticmethod
+    def _penalty_window_row(seq: Sequence) -> np.ndarray:
+        """Last W known tokens (prompt + generated), newest at the high
+        end, -1 padded — the device-side ring picks up from here."""
+        row = np.full((PENALTY_WINDOW,), -1, np.int32)
+        hist = (seq.prompt_tokens + seq.generated)[-PENALTY_WINDOW:]
+        if hist:
+            row[-len(hist):] = hist
+        return row
+
     def _stage_batch(self, active_seqs: List[Sequence]):
         """Fill the per-slot host arrays shared by both decode entry points:
-        (tokens, ctx_lens, block_tables, temps, top_ps, top_ks, seeds),
-        all [B]-shaped."""
+        (tokens, ctx_lens, block_tables, temps, top_ps, top_ks, seeds,
+        rpens, rlasts, windows) — [B]-shaped ([B, W] for windows)."""
         b = self.engine_cfg.max_batch_size
         tokens = np.zeros((b,), np.int32)
         ctx_lens = np.zeros((b,), np.int32)
@@ -859,6 +920,9 @@ class InferenceEngine:
         top_ps = np.ones((b,), np.float32)
         top_ks = np.zeros((b,), np.int32)
         seeds = np.full((b,), -1, np.int32)
+        rpens = np.ones((b,), np.float32)
+        rlasts = np.zeros((b,), np.int32)
+        windows = np.full((b, PENALTY_WINDOW), -1, np.int32)
         for seq in active_seqs:
             i = seq.slot
             tokens[i] = seq.last_token
@@ -867,7 +931,11 @@ class InferenceEngine:
             temps[i] = seq.temperature
             top_ps[i] = seq.top_p
             top_ks[i], seeds[i] = self._sampling_arrays(seq)
-        return tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds
+            rpens[i], rlasts[i] = self._penalty_arrays(seq)
+            if rpens[i] != 1.0:
+                windows[i] = self._penalty_window_row(seq)
+        return (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
+                rpens, rlasts, windows)
 
     def decode_step(self) -> Dict[int, int]:
         """One batched decode step (single-step view of the fused graph:
@@ -919,8 +987,8 @@ class InferenceEngine:
         if not active_seqs:
             return {}
 
-        (tokens, ctx_lens, bts, temps, top_ps,
-         top_ks, seeds) = self._stage_batch(active_seqs)
+        (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
+         rpens, rlasts, windows) = self._stage_batch(active_seqs)
         allowed = np.zeros((b,), np.int32)
         eos_ids = np.full((b,), -1, np.int32)
         for seq in active_seqs:
@@ -928,11 +996,12 @@ class InferenceEngine:
             if seq.eos_token_id is not None:
                 eos_ids[seq.slot] = seq.eos_token_id
 
-        self.kv, outs, _ = self._decode_multi_jit(
+        self.kv, outs, _, _ = self._decode_multi_jit(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
             self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(top_ks), jnp.asarray(seeds))
+            jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
+            jnp.asarray(rlasts), jnp.asarray(windows))
         outs = np.asarray(outs)                                 # [K, B]
 
         result: Dict[int, List[int]] = {}
@@ -989,8 +1058,8 @@ class InferenceEngine:
             return None
 
         b = ecfg.max_batch_size
-        (tokens, ctx_lens, bts, temps, top_ps,
-         top_ks, seeds) = self._stage_batch(active_seqs)
+        (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
+         rpens, rlasts, windows) = self._stage_batch(active_seqs)
         allowed = np.zeros((b,), np.int32)
         eos_ids = np.full((b,), -1, np.int32)
         for seq in staged:
@@ -999,22 +1068,27 @@ class InferenceEngine:
             if seq.eos_token_id is not None:
                 eos_ids[seq.slot] = seq.eos_token_id
         tokens_d = jnp.asarray(tokens)
-        # Each continuing lane consumes the carry token of the NEWEST
-        # in-flight call that advanced it (oldest-to-newest fold: later
-        # calls overwrite); lanes in no in-flight call (fresh prefills)
-        # keep their host-known last token.
+        window_d = jnp.asarray(windows)
+        # Each continuing lane consumes the carry token (and penalty
+        # window) of the NEWEST in-flight call that advanced it
+        # (oldest-to-newest fold: later calls overwrite); lanes in no
+        # in-flight call (fresh prefills) keep their host-known state.
         for call in self._inflight:
             carried = np.zeros((b,), bool)
             for slot in call["allowed"]:
                 carried[slot] = True
-            tokens_d = jnp.where(jnp.asarray(carried), call["final"],
-                                 tokens_d)
-        self.kv, outs, final = self._decode_multi_jit(
+            carried_d = jnp.asarray(carried)
+            tokens_d = jnp.where(carried_d, call["final"], tokens_d)
+            window_d = jnp.where(carried_d[:, None], call["final_window"],
+                                 window_d)
+        self.kv, outs, final, final_window = self._decode_multi_jit(
             self.params, self.kv, tokens_d, jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
             self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(top_ks), jnp.asarray(seeds))
+            jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
+            jnp.asarray(rlasts), window_d)
         return {"outs": outs, "final": final,
+                "final_window": final_window,
                 "allowed": allowed_by_slot,
                 "seqs": {s.slot: s for s in staged}}
 
@@ -1109,8 +1183,8 @@ class InferenceEngine:
                 seq.pages.extend(self._allocate_reclaiming(need))
 
         b = ecfg.max_batch_size
-        (tokens, ctx_lens, bts, temps, top_ps,
-         top_ks, seeds) = self._stage_batch(active_seqs)
+        (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
+         rpens, rlasts, windows) = self._stage_batch(active_seqs)
         allowed = np.zeros((b,), np.int32)
         for seq in active_seqs:
             allowed[seq.slot] = k_steps
@@ -1119,15 +1193,17 @@ class InferenceEngine:
         bts_d = jnp.asarray(bts)
         temps_d, top_ps_d = jnp.asarray(temps), jnp.asarray(top_ps)
         top_ks_d, seeds_d = jnp.asarray(top_ks), jnp.asarray(seeds)
+        rpens_d, rlasts_d = jnp.asarray(rpens), jnp.asarray(rlasts)
 
         tokens_dev = jnp.asarray(tokens)
+        window_dev = jnp.asarray(windows)
         outs_all = []
         for c in range(n_calls):
-            self.kv, outs, tokens_dev = self._decode_multi_jit(
+            self.kv, outs, tokens_dev, window_dev = self._decode_multi_jit(
                 self.params, self.kv, tokens_dev,
                 jnp.asarray(ctx_lens + c * allowed, np.int32), bts_d,
                 allowed_d, no_eos, self._next_key(), temps_d, top_ps_d,
-                top_ks_d, seeds_d)
+                top_ks_d, seeds_d, rpens_d, rlasts_d, window_dev)
             outs_all.append(outs)
         jax.block_until_ready(tokens_dev)
 
@@ -1192,8 +1268,10 @@ class InferenceEngine:
             return {}
 
         b = ecfg.max_batch_size
-        (tokens, ctx_lens, bts, temps, top_ps,
-         top_ks, _seeds) = self._stage_batch(active_seqs)
+        # Seeds and repetition penalties are not plumbed into spec rounds
+        # (rejection sampling needs the unmodified target distribution).
+        (tokens, ctx_lens, bts, temps, top_ps, top_ks,
+         _seeds, _rpens, _rlasts, _windows) = self._stage_batch(active_seqs)
         cap = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         for seq in active_seqs:

@@ -38,6 +38,46 @@ class SamplingParams(NamedTuple):
                               seed=jnp.full((batch,), -1, jnp.int32))
 
 
+# Static ring-buffer width for repetition-penalty windows. Ollama's
+# repeat_last_n defaults to 64; per-request values clamp to this (XLA
+# static shapes — one buffer size serves every request mix).
+PENALTY_WINDOW = 64
+
+
+def apply_repeat_penalty(logits: jax.Array, window: jax.Array,
+                         penalty: jax.Array,
+                         last_n: jax.Array) -> jax.Array:
+    """Ollama/llama.cpp repetition penalty, batched and jit-safe.
+
+    logits: [B, V]; window: [B, W] chronological recent token ids (-1 =
+    empty slot); penalty: [B] f32 (1.0 disables); last_n: [B] int32 —
+    only the newest ``last_n`` window entries count (0 disables).
+    Positive logits divide by the penalty, negative multiply — the
+    llama.cpp convention that always reduces a repeated token's score.
+    """
+    b, v = logits.shape
+    w = window.shape[1]
+    rank = jnp.arange(w)[None, :]
+    # Window is chronological, so the newest last_n entries live at the
+    # high end of the buffer.
+    in_n = rank >= (w - jnp.minimum(last_n, w))[:, None]
+    valid = (window >= 0) & in_n
+    idx = jnp.where(valid, window, 0)
+    presence = jnp.zeros((b, v), bool).at[
+        jnp.arange(b)[:, None], idx].max(valid)
+    p = penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where(presence & (p != 1.0), penalized, logits)
+
+
+def roll_window(window: jax.Array, tokens: jax.Array,
+                active: jax.Array) -> jax.Array:
+    """Append this step's sampled tokens to active rows' windows
+    (device-side, so fused multi-step decode keeps windows current)."""
+    rolled = jnp.roll(window, -1, axis=1).at[:, -1].set(tokens)
+    return jnp.where(active[:, None], rolled, window)
+
+
 def apply_filters(logits: jax.Array, top_k, top_p: jax.Array) -> jax.Array:
     """Sequential top-k then top-p (nucleus) filtering, ONE [B, V] sort.
 
@@ -79,14 +119,27 @@ def _row_keys(key: jax.Array, seed: jax.Array, ctx: jax.Array) -> jax.Array:
 
 
 def sample(logits: jax.Array, key: jax.Array, params: SamplingParams,
-           ctx: Optional[jax.Array] = None) -> jax.Array:
+           ctx: Optional[jax.Array] = None,
+           penalty_window: Optional[jax.Array] = None,
+           repeat_penalty: Optional[jax.Array] = None,
+           repeat_last_n: Optional[jax.Array] = None) -> jax.Array:
     """logits: [B, V] f32 -> token ids [B] int32.
 
     Greedy rows (temperature <= 0) and sampled rows coexist in one batch.
     ``ctx``: [B] int32 absolute position of the token being sampled
     (keys per-request seeded streams; defaults to 0s).
+    ``penalty_window``/``repeat_penalty``/``repeat_last_n``: recent-token
+    repetition penalty (Ollama options); applied before temperature and
+    before the greedy argmax, so greedy rows are penalized too (matching
+    Ollama, where penalties act even at temperature 0).
     """
     b = logits.shape[0]
+    if penalty_window is not None:
+        logits = jax.lax.cond(
+            jnp.any(repeat_penalty != 1.0),
+            lambda l: apply_repeat_penalty(l, penalty_window,
+                                           repeat_penalty, repeat_last_n),
+            lambda l: l, logits)
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if ctx is None:
         ctx = jnp.zeros((b,), jnp.int32)

@@ -310,6 +310,10 @@ class InferenceServer:
             top_k = int(top_k) if top_k is not None else None
             seed = opts.get("seed", body.get("seed"))
             seed = int(seed) if seed is not None else None
+            repeat_penalty = float(opts.get("repeat_penalty", 1.0))
+            if repeat_penalty <= 0:
+                raise ValueError("'repeat_penalty' must be > 0")
+            repeat_last_n = int(opts.get("repeat_last_n", 64))
             stop = opts.get("stop", body.get("stop"))
             if stop is None:
                 stop = []
@@ -331,6 +335,8 @@ class InferenceServer:
         seq = Sequence(request_id=rid, prompt_tokens=prompt_ids,
                        max_new_tokens=max_tokens, temperature=temperature,
                        top_p=top_p, top_k=top_k, seed=seed,
+                       repeat_penalty=repeat_penalty,
+                       repeat_last_n=repeat_last_n,
                        eos_token_id=self.tokenizer.eos_token_id)
 
         loop = asyncio.get_running_loop()
