@@ -490,3 +490,25 @@ def test_repeat_penalty_option(server):
         assert bad.status == 400
 
     _run(server, go)
+
+
+def test_embeddings_endpoints(server):
+    """/api/embeddings (legacy, prompt->embedding) and /api/embed
+    (input->embeddings): right shapes, deterministic, content-sensitive."""
+    async def go(client):
+        r1 = await (await client.post("/api/embeddings", json={
+            "model": "m", "prompt": "hello world"})).json()
+        vec = r1["embedding"]
+        assert isinstance(vec, list) and len(vec) == 128  # tiny-llama d_model
+        r2 = await (await client.post("/api/embeddings", json={
+            "prompt": "hello world"})).json()
+        assert r2["embedding"] == vec                      # deterministic
+        r3 = await (await client.post("/api/embed", json={
+            "input": ["hello world", "something else"]})).json()
+        assert len(r3["embeddings"]) == 2
+        assert r3["embeddings"][0] == vec                  # same pooling
+        assert r3["embeddings"][1] != vec                  # content-sensitive
+        bad = await client.post("/api/embeddings", json={"nope": 1})
+        assert bad.status == 400
+
+    _run(server, go)

@@ -280,6 +280,10 @@ class InferenceEngine:
         self.slots: List[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
         # Dispatch-ahead decode pipeline (decode_steps_pipelined).
         self._inflight: List[dict] = []
+        # Embeddings graph (built on first /api/embeddings use).
+        import threading
+        self._embed_jit = None
+        self._embed_lock = threading.Lock()
 
         self._prefill_jit = jax.jit(
             partial(self._prefill_fn), donate_argnums=(1,))
@@ -508,6 +512,44 @@ class InferenceEngine:
                 jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
+
+    def embed(self, token_ids: List[int]) -> np.ndarray:
+        """Mean-pooled final hidden state for a token sequence — the
+        engine-side backing for the Ollama /api/embeddings endpoint.
+        Dense (cache-free) forward over a bucketed length, compiled once
+        per bucket; padding sits causally after the valid tokens so the
+        masked mean is padding-invariant."""
+        from tpu_inference.models.common import make_dense_attn
+
+        ecfg = self.engine_cfg
+        # Cap at the largest compiled bucket (bucket_for saturates there,
+        # and the zero-padded buffer is bucket-sized).
+        cap = min(ecfg.max_context - 1, ecfg.prefill_buckets[-1])
+        ids = list(token_ids)[-cap:] or [0]
+        bucket = ecfg.bucket_for(len(ids))
+        with self._embed_lock:
+            # Lazy singleton under a lock: concurrent first requests from
+            # the server's worker threads must not each pay the compile.
+            if self._embed_jit is None:
+                cfg = self.model_cfg
+
+                def fn(params, tokens, length):
+                    s = tokens.shape[1]
+                    pos = jnp.broadcast_to(
+                        jnp.arange(s, dtype=jnp.int32)[None], tokens.shape)
+                    hidden, _ = self.mod.forward_hidden(
+                        params, cfg, tokens, pos, None, make_dense_attn())
+                    mask = (jnp.arange(s) < length)[None, :, None]
+                    pooled = (jnp.sum(hidden * mask, axis=1)
+                              / jnp.maximum(length, 1))
+                    return pooled[0].astype(jnp.float32)
+
+                self._embed_jit = jax.jit(fn)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(ids)] = ids
+        return np.asarray(self._embed_jit(
+            self.params, jnp.asarray(toks),
+            jnp.asarray(len(ids), jnp.int32)))
 
     def check_numerics(self) -> None:
         """Numerics sanitizer (SURVEY.md §5 race/sanitizer tier).

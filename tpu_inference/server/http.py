@@ -120,6 +120,8 @@ class InferenceServer:
         app.router.add_post("/api/chat", self.handle_chat)
         app.router.add_get("/api/tags", self.handle_tags)
         app.router.add_post("/api/show", self.handle_show)
+        app.router.add_post("/api/embeddings", self.handle_embeddings)
+        app.router.add_post("/api/embed", self.handle_embeddings)
         app.router.add_get("/api/version", self.handle_version)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
@@ -183,6 +185,49 @@ class InferenceServer:
                 "serving.kv_quant": ec.kv_quant,
             },
         })
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        """Ollama /api/embeddings ({"prompt": str} -> {"embedding": [..]})
+        and /api/embed ({"input": str | [str]} -> {"embeddings": [[..]]}).
+        Mean-pooled final hidden states from the loaded model. Runs in a
+        worker thread so compile/forward never stalls the event loop."""
+        try:
+            body = await request.json()
+            assert isinstance(body, dict)
+        except (json.JSONDecodeError, UnicodeDecodeError, AssertionError):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "invalid JSON body"}), content_type="application/json")
+        # Shape is keyed on the ROUTE (not on which keys the client sent):
+        # /api/embeddings takes a single "prompt" string and returns
+        # {"embedding"}; /api/embed takes "input" (str or list) and
+        # returns {"model", "embeddings"}.
+        legacy = request.path.endswith("/embeddings")
+        if legacy:
+            texts = body.get("prompt")
+            if not isinstance(texts, str):
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": "missing 'prompt' string"}),
+                    content_type="application/json")
+            texts = [texts]
+        else:
+            texts = body.get("input")
+            if isinstance(texts, str):
+                texts = [texts]
+            if (not isinstance(texts, list) or not texts
+                    or not all(isinstance(t, str) for t in texts)):
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": "missing 'input' string or list of strings"}),
+                    content_type="application/json")
+
+        def compute():
+            return [self.engine.embed(self.tokenizer.encode(t)).tolist()
+                    for t in texts]
+
+        vecs = await asyncio.to_thread(compute)
+        if legacy:
+            return web.json_response({"embedding": vecs[0]})
+        return web.json_response({"model": self.cfg.server.model_name,
+                                  "embeddings": vecs})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.json_response(self.group.stats_snapshot())
