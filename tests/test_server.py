@@ -512,3 +512,28 @@ def test_embeddings_endpoints(server):
         assert bad.status == 400
 
     _run(server, go)
+
+
+def test_generate_with_context_continuation(server):
+    """Ollama stateful continuation: POSTing a prior response's 'context'
+    array continues that conversation — equivalent to resending the full
+    text, and the returned context extends the submitted one."""
+    async def go(client):
+        first = await (await client.post("/api/generate", json={
+            "prompt": "continue me", "stream": False, "max_tokens": 6,
+            "temperature": 0.0})).json()
+        ctx = first["context"]
+        second = await (await client.post("/api/generate", json={
+            "prompt": " and more", "stream": False, "max_tokens": 6,
+            "temperature": 0.0, "context": ctx})).json()
+        assert second["context"][:len(ctx)] == ctx
+        assert second["eval_count"] == 6 or second["done_reason"] == "stop"
+        # Malformed context 400s.
+        bad = await client.post("/api/generate", json={
+            "prompt": "x", "stream": False, "context": ["nope"]})
+        assert bad.status == 400
+        bad2 = await client.post("/api/generate", json={
+            "prompt": "x", "stream": False, "context": [10**9]})
+        assert bad2.status == 400
+
+    _run(server, go)

@@ -376,6 +376,37 @@ class InferenceServer:
         model_name = body.get("model") or self.cfg.server.model_name
 
         prompt_ids = self.tokenizer.encode(prompt)
+        # Stateful continuation (Ollama /api/generate "context"): a prior
+        # response's context token array prepends to this prompt — the
+        # reference's captured wire format round-trips exactly these ids
+        # (its terminal records carry them). With the prefix cache on,
+        # the continued context's KV pages are reused, not recomputed.
+        # Generate-only, like Ollama: /api/chat never emits a context, so
+        # honoring one there would prepend stale ids into the transcript.
+        ctx_ids = body.get("context") if not chat else None
+        if ctx_ids is not None:
+            # bool is an int subclass; true/false are not token ids.
+            if not (isinstance(ctx_ids, list)
+                    and all(isinstance(t, int) and not isinstance(t, bool)
+                            and 0 <= t for t in ctx_ids)):
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": "'context' must be a list of token ids"}),
+                    content_type="application/json")
+            # Validate against the TOKENIZER vocab (what the server itself
+            # emits in context arrays); it can exceed the model vocab.
+            vocab = max(self.tokenizer.vocab_size,
+                        self.cfg.model.vocab_size)
+            if any(t >= vocab for t in ctx_ids):
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": f"'context' token id out of range "
+                              f"(vocab_size={vocab})"}),
+                    content_type="application/json")
+        if ctx_ids:
+            # The encoder's BOS belongs at the very start, not mid-stream.
+            if (prompt_ids and self.tokenizer.bos_token_id is not None
+                    and prompt_ids[0] == self.tokenizer.bos_token_id):
+                prompt_ids = prompt_ids[1:]
+            prompt_ids = list(ctx_ids) + prompt_ids
         rid = next(self._ids)
         seq = Sequence(request_id=rid, prompt_tokens=prompt_ids,
                        max_new_tokens=max_tokens, temperature=temperature,
