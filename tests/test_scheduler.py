@@ -120,7 +120,10 @@ def test_pipelined_decode_error_recovery():
     ecfg = cfgs.EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=8,
                              max_batch_size=2, prefill_buckets=(16,),
                              decode_steps_per_call=4,
-                             decode_pipeline_depth=2)
+                             decode_pipeline_depth=2,
+                             # Force the pipelined path even for a lone
+                             # request (latency mode would bypass it).
+                             latency_decode_threshold=0)
     params, _ = build_model(model_cfg, seed=0)
     engine = InferenceEngine(model_cfg, ecfg, params=params)
 
@@ -183,3 +186,28 @@ def test_chunked_prefill_interleaves_with_decode():
     assert events[1] == want_short
     assert events[2] == want_long
     assert s2.finish_reason == "length"
+
+
+def test_latency_mode_matches_fused_tokens():
+    """A lone request served through the single-step latency graph must
+    produce exactly the fused-K tokens (same math, shorter scan)."""
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    params, _ = build_model(model_cfg, seed=0)
+    base = dict(page_size=8, num_pages=128, max_pages_per_seq=8,
+                max_batch_size=4, prefill_buckets=(16, 32))
+    prompt = list(range(3, 17))
+
+    def run(threshold):
+        eng = InferenceEngine(
+            model_cfg,
+            cfgs.EngineConfig(**base, latency_decode_threshold=threshold),
+            params=params)
+        sched = EngineScheduler(eng).start()
+        seq = Sequence(request_id=0, prompt_tokens=prompt, max_new_tokens=10)
+        events = _submit_and_wait(sched, [seq])
+        sched.stop()
+        return events[0]
+
+    fused = run(threshold=0)      # always the fused-K path
+    latency = run(threshold=4)    # always the single-step path
+    assert fused == latency and len(fused) == 10

@@ -199,6 +199,12 @@ class EngineConfig:
     # steady-state decode throughput by up to K. Streamed tokens are
     # flushed every K steps (latency cost: K * per-step time).
     decode_steps_per_call: int = 8
+    # Latency mode: when at most this many sequences are decoding (and
+    # nothing is queued or in flight), the scheduler switches to the
+    # single-step decode graph so every token streams out as it is
+    # sampled — a lone interactive chat gets per-token streaming while
+    # loaded batches keep the fused-K throughput path. 0 disables.
+    latency_decode_threshold: int = 1
     # Decode dispatch pipeline depth: >1 keeps that many fused-decode
     # calls in flight (later calls consume earlier calls' device-resident
     # carry tokens), hiding host round-trip/dispatch latency behind

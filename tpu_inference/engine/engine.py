@@ -289,6 +289,13 @@ class InferenceEngine:
             partial(self._prefill_fn), donate_argnums=(1,))
         self._decode_multi_jit = jax.jit(
             partial(self._decode_multi_fn), donate_argnums=(1,))
+        # Single-step decode graph: a 1-iteration scan, so a token leaves
+        # the device every step instead of every K — the scheduler's
+        # latency mode uses it when the batch is nearly empty (streaming
+        # smoothness; fused K-step calls would still run K forwards for
+        # one visible token).
+        self._decode_one_jit = jax.jit(
+            partial(self._decode_multi_fn, k_steps=1), donate_argnums=(1,))
         # Sequence-parallel prefill (ring attention over the sp axis) for
         # fresh full-prompt chunks on an sp>1 mesh.
         self.sp = 1 if mesh is None else int(mesh.shape.get("sp", 1))
@@ -389,7 +396,8 @@ class InferenceEngine:
 
     def _decode_multi_fn(self, params, kv: KVPages, tokens, ctx_lens,
                          block_tables, allowed, eos_ids, key, temperature,
-                         top_p, top_k, seed, rpen, rlast, window):
+                         top_p, top_k, seed, rpen, rlast, window,
+                         k_steps: Optional[int] = None):
         """K fused decode steps under one dispatch (lax.scan on device).
 
         Sampled tokens feed back into the next step without leaving HBM;
@@ -435,7 +443,8 @@ class InferenceEngine:
             ctx_lens = ctx_lens + act.astype(jnp.int32)
             return (kv, toks, ctx_lens, alive, window), out
 
-        k_steps = max(1, ecfg.decode_steps_per_call)
+        if k_steps is None:
+            k_steps = max(1, ecfg.decode_steps_per_call)
         alive0 = jnp.ones(tokens.shape, bool)
         (kv, final_tokens, _, _, final_window), outs = jax.lax.scan(
             step, (kv, tokens, ctx_lens, alive0, window),
@@ -500,16 +509,25 @@ class InferenceEngine:
                 jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32))
             self.kv, self.draft_kv = out.kv, out.draft_kv
         else:
-            self.kv, _, _, _ = self._decode_multi_jit(
-                self.params, self.kv, jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.zeros((b, self.max_pages), jnp.int32),
-                jnp.zeros((b,), jnp.int32),
-                jnp.full((b,), -1, jnp.int32), self._next_key(),
-                jnp.zeros((b,), jnp.float32), jnp.ones((b,), jnp.float32),
-                jnp.zeros((b,), jnp.int32), jnp.full((b,), -1, jnp.int32),
-                jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
-                jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
+            decodes = [self._decode_multi_jit]
+            if (ecfg.latency_decode_threshold > 0
+                    and ecfg.decode_steps_per_call > 1):
+                # The 1-step graph is a second full decode compile; pay
+                # it only when latency mode can actually route to it.
+                decodes.append(self._decode_one_jit)
+            for decode in decodes:
+                self.kv, _, _, _ = decode(
+                    self.params, self.kv, jnp.zeros((b,), jnp.int32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.zeros((b, self.max_pages), jnp.int32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.full((b,), -1, jnp.int32), self._next_key(),
+                    jnp.zeros((b,), jnp.float32),
+                    jnp.ones((b,), jnp.float32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.full((b,), -1, jnp.int32),
+                    jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
+                    jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -1038,7 +1056,11 @@ class InferenceEngine:
             if seq.eos_token_id is not None:
                 eos_ids[seq.slot] = seq.eos_token_id
 
-        self.kv, outs, _, _ = self._decode_multi_jit(
+        # k_steps==1 runs the 1-iteration graph (one forward per visible
+        # token) instead of masking K-1 steps of the fused graph.
+        decode = self._decode_one_jit if k_steps == 1 else \
+            self._decode_multi_jit
+        self.kv, outs, _, _ = decode(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
             self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),

@@ -354,7 +354,18 @@ class EngineScheduler:
                 continue
 
             try:
-                new_tokens = engine.decode_steps_pipelined()
+                # Latency mode: with a near-empty batch and nothing queued
+                # or in flight, run the single-step graph so each token
+                # streams out as sampled (no K-token flush bursts). Spec
+                # decode has its own emission cadence; leave it alone.
+                thresh = engine.engine_cfg.latency_decode_threshold
+                if (0 < len(active) <= thresh and not self._waiting
+                        and self._prefilling is None
+                        and not engine.pipeline_pending
+                        and not engine.spec_enabled):
+                    new_tokens = engine.decode_steps(max_steps=1)
+                else:
+                    new_tokens = engine.decode_steps_pipelined()
             except Exception:  # noqa: BLE001 — keep the engine loop alive
                 import traceback
                 traceback.print_exc()
