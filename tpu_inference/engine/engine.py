@@ -266,11 +266,16 @@ class InferenceEngine:
         spec_on = (draft_cfg is not None
                    and engine_cfg.num_speculative_tokens > 0)
         self.prefix_cache = None
-        # Prefix cache and spec are mutually exclusive for now: cached
-        # target pages have no draft-pool twin, and writing the draft
-        # prompt into shared page ids would corrupt other sequences'
-        # draft KV. (Safe combination = draft-side cache; future work.)
-        if engine_cfg.enable_prefix_cache and not spec_on:
+        # Prefix caching composes with speculative decoding because the
+        # draft pool is a strict positional twin of the target pool: both
+        # write the SAME input-token stream at the same block-table slots
+        # (prompt chunks via _draft_prefill_fn; decode rounds via
+        # spec_round, whose draft scan and target verify consume
+        # identical [last, d_0..d_{gamma-1}] inputs), and cache hits are
+        # full pages below ctx_len, where every row in BOTH pools is
+        # settled. Reusing a cached page therefore reuses a valid draft
+        # twin for free.
+        if engine_cfg.enable_prefix_cache:
             from tpu_inference.engine.prefix_cache import PrefixCache
             self.prefix_cache = PrefixCache(self.allocator,
                                             engine_cfg.page_size)
@@ -1311,21 +1316,24 @@ class InferenceEngine:
                 emit_cap = min(emit_cap, max_steps)
             # The device writes KV for up to s_len positions; provision
             # pages for what fits, clamp emissions to written capacity.
+            # Prefix-cache-held pages are reclaimable capacity here just
+            # as in _grant_decode_steps — counting only the raw free list
+            # would starve spec rounds once the cache warms up.
             want = min(s_len, room)
             need = kvc.pages_needed(want, ecfg.page_size,
                                     already=seq.ctx_len)
-            if need > self.allocator.num_free:
+            grantable = self._free_plus_evictable()
+            if need > grantable:
                 slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
                 emit_cap = min(emit_cap,
-                               slack + self.allocator.num_free
-                               * ecfg.page_size)
-                need = min(need, self.allocator.num_free)
+                               slack + grantable * ecfg.page_size)
+                need = min(need, grantable)
             if emit_cap <= 0:
                 seq.done, seq.finish_reason = True, "oom"
                 seq.finish_time = time.perf_counter()
                 continue
             if need > 0:
-                seq.pages.extend(self.allocator.allocate(need))
+                seq.pages.extend(self._allocate_reclaiming(need))
             emit_by_slot[seq.slot] = emit_cap
         active_seqs = [s for s in active_seqs if not s.done]
         if not active_seqs:
