@@ -79,3 +79,41 @@ def test_incremental_decoder_metaspace_spacing(tmp_path):
     cont = hf.encode(" how is", add_bos=False)
     streamed = "".join(dec.push(i) for i in cont) + dec.flush()
     assert streamed == " how is"
+
+
+def test_chat_template_applied_and_bos_stripped(tmp_path):
+    """HFTokenizer renders /api/chat messages with the checkpoint's own
+    chat template (leading BOS text stripped so encode() doesn't double
+    it); tokenizers without a template return None (role-prefix
+    fallback)."""
+    import json
+
+    pytest.importorskip("transformers")
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    tok = tokenizers.Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.Metaspace()
+    tok.decoder = decoders.Metaspace()
+    trainer = trainers.BpeTrainer(vocab_size=400,
+                                  special_tokens=["<s>", "</s>"])
+    tok.train_from_iterator(["user assistant hello there"] * 20, trainer)
+    tok.save(str(tmp_path / "tokenizer.json"))
+    with open(tmp_path / "tokenizer_config.json", "w") as f:
+        json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                   "bos_token": "<s>", "eos_token": "</s>"}, f)
+
+    from tpu_inference.server.tokenizer import HFTokenizer
+
+    hf = HFTokenizer(str(tmp_path))
+    msgs = [{"role": "user", "content": "hello"}]
+    assert hf.apply_chat_template(msgs) is None    # no template configured
+
+    hf._tok.chat_template = (
+        "{{ bos_token }}{% for m in messages %}[{{ m.role }}] "
+        "{{ m.content }}\n{% endfor %}assistant:")
+    out = hf.apply_chat_template(msgs)
+    assert out == "[user] hello\nassistant:"       # BOS text stripped
+    ids = hf.encode(out)
+    assert ids[0] == hf.bos_token_id               # exactly one BOS
+    assert ids[1] != hf.bos_token_id

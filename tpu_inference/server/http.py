@@ -301,8 +301,10 @@ class InferenceServer:
         """Ollama ``/api/chat``: messages-based wrapper over the same
         engine path (the reference's notebooks drive this via ChatOllama —
         reference notebooks/request_demo.ipynb cell 4d5cf82f). Messages
-        are flattened to a plain-text transcript prompt; responses use the
-        ``message`` record shape instead of ``response``."""
+        render through the checkpoint's own chat template when the
+        tokenizer has one, else flatten to a role-prefix transcript;
+        responses use the ``message`` record shape instead of
+        ``response``."""
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -315,8 +317,17 @@ class InferenceServer:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "missing 'messages'"}),
                 content_type="application/json")
-        prompt = "\n".join(f"{m.get('role', 'user')}: {m['content']}"
-                           for m in msgs) + "\nassistant:"
+        # Prefer the checkpoint's own chat template (instruct models are
+        # trained on their specific format); fall back to a role-prefix
+        # transcript for template-less tokenizers (byte, bare BPE).
+        prompt = None
+        if hasattr(self.tokenizer, "apply_chat_template"):
+            prompt = self.tokenizer.apply_chat_template(
+                [{"role": m.get("role", "user"), "content": m["content"]}
+                 for m in msgs])
+        if prompt is None:
+            prompt = "\n".join(f"{m.get('role', 'user')}: {m['content']}"
+                               for m in msgs) + "\nassistant:"
         body = dict(body)
         body["prompt"] = prompt
         return await self._generate_impl(request, body, chat=True)

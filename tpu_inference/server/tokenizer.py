@@ -65,6 +65,32 @@ class HFTokenizer:
     def decode(self, ids: List[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
+    def apply_chat_template(self, messages: List[dict]) -> Optional[str]:
+        """Render /api/chat messages with the checkpoint's own chat
+        template (tokenizer_config.json), or None when it has none —
+        instruct-tuned models only behave when prompted in their trained
+        format, not a generic role-prefix transcript."""
+        if not getattr(self._tok, "chat_template", None):
+            return None
+        try:
+            rendered = self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+            # Templates usually bake in the BOS text; encode() prepends
+            # the BOS id itself, so strip it here or it doubles.
+            bos = self._tok.bos_token
+            if bos and rendered.startswith(bos):
+                rendered = rendered[len(bos):]
+            return rendered
+        # Broad by intent: template rendering raises jinja2.TemplateError
+        # subclasses (e.g. Llama-2's raise_exception on non-alternating
+        # roles) besides the std ones — ANY render failure falls back to
+        # the transcript format rather than 500ing the chat request.
+        except Exception as e:  # noqa: BLE001
+            import sys
+            print(f"[tokenizer] chat template failed ({e!r}); falling "
+                  "back to role-prefix transcript", file=sys.stderr)
+            return None
+
 
 class IncrementalDecoder:
     """Streams token ids -> text chunks. One instance per request.
