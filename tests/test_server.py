@@ -350,8 +350,16 @@ def test_chat_endpoint(server):
         assert all("message" in l for l in lines)
         assert lines[-1]["done"] and lines[-1]["eval_count"] == 6
 
+        # Empty messages = the Ollama chat-model preload probe: an
+        # immediate load ack, not a 400 (clients use this to warm up).
         resp = await client.post("/api/chat", json={"model": "m",
                                                     "messages": []})
+        assert resp.status == 200
+        ping = await resp.json()
+        assert ping["done"] and ping["done_reason"] == "load"
+        # Malformed (non-list / bad entries) still 400s.
+        resp = await client.post("/api/chat", json={"model": "m",
+                                                    "messages": "nope"})
         assert resp.status == 400
 
     _run(server, scenario)
@@ -535,5 +543,26 @@ def test_generate_with_context_continuation(server):
         bad2 = await client.post("/api/generate", json={
             "prompt": "x", "stream": False, "context": [10**9]})
         assert bad2.status == 400
+
+    _run(server, go)
+
+
+def test_empty_prompt_is_load_ping(server):
+    """Ollama contract: an empty /api/generate is a load/liveness probe
+    answered immediately with done_reason='load' (no engine work); an
+    empty prompt WITH a context still generates (continuation)."""
+    async def go(client):
+        r = await (await client.post("/api/generate", json={
+            "prompt": "", "stream": False})).json()
+        assert r["done"] is True and r["done_reason"] == "load"
+        assert r["response"] == ""
+        first = await (await client.post("/api/generate", json={
+            "prompt": "seed", "stream": False, "max_tokens": 4,
+            "temperature": 0.0})).json()
+        cont = await (await client.post("/api/generate", json={
+            "prompt": "", "stream": False, "max_tokens": 4,
+            "temperature": 0.0, "context": first["context"]})).json()
+        assert cont["done_reason"] in ("length", "stop")
+        assert cont["context"][:len(first["context"])] == first["context"]
 
     _run(server, go)

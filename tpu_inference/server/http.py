@@ -311,6 +311,17 @@ class InferenceServer:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "invalid JSON body"}), content_type="application/json")
         msgs = body.get("messages")
+        if msgs == []:
+            # Ollama load/ping contract, chat flavor: an empty messages
+            # array preloads the model and acks immediately (mirrors the
+            # empty-prompt /api/generate probe).
+            return web.json_response({
+                "model": body.get("model") or self.cfg.server.model_name,
+                "created_at": _now_iso(),
+                "message": {"role": "assistant", "content": ""},
+                "done": True,
+                "done_reason": "load",
+            })
         if (not isinstance(msgs, list) or not msgs
                 or not all(isinstance(m, dict) and "content" in m
                            for m in msgs)):
@@ -348,6 +359,18 @@ class InferenceServer:
         if not isinstance(prompt, str):
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "missing 'prompt'"}), content_type="application/json")
+        if not chat and prompt == "" and not body.get("context"):
+            # Ollama load/ping contract: an empty generate request warms
+            # the model and returns immediately (the ollama CLI and
+            # client libraries use this as a liveness/load probe). The
+            # model here is always resident, so it's a pure ack.
+            return web.json_response({
+                "model": body.get("model") or self.cfg.server.model_name,
+                "created_at": _now_iso(),
+                "response": "",
+                "done": True,
+                "done_reason": "load",
+            })
 
         opts = body.get("options") or {}
         if not isinstance(opts, dict):
