@@ -537,42 +537,64 @@ class InferenceEngine:
         return time.perf_counter() - t0
 
     def embed(self, token_ids: List[int]) -> np.ndarray:
-        """Mean-pooled final hidden state for a token sequence — the
-        engine-side backing for the Ollama /api/embeddings endpoint.
-        Dense (cache-free) forward over a bucketed length, compiled once
-        per bucket; padding sits causally after the valid tokens so the
-        masked mean is padding-invariant."""
+        """Mean-pooled final hidden state for one token sequence (the
+        Ollama /api/embeddings backing). See embed_many."""
+        return self.embed_many([token_ids])[0]
+
+    # Max rows per embedding dispatch; lane counts pad to powers of two,
+    # so compiles are bounded at ~5 batch shapes x sequence buckets and
+    # one huge /api/embed list can't build an unbounded [N, S] forward.
+    EMBED_CHUNK = 16
+
+    def embed_many(self, batch: List[List[int]]) -> np.ndarray:
+        """Mean-pooled final hidden states for N token sequences, batched
+        into dense (cache-free) [n, S] forwards of at most EMBED_CHUNK
+        rows — an /api/embed list input costs ceil(N/chunk) dispatches,
+        not N. Sequence buckets are chosen per chunk; per-row length
+        masks make padding invariant (pad sits causally after each row's
+        valid tokens). Returns [N, d_model] f32."""
         from tpu_inference.models.common import make_dense_attn
 
         ecfg = self.engine_cfg
+        if not batch:
+            return np.zeros((0, self.model_cfg.d_model), np.float32)
         # Cap at the largest compiled bucket (bucket_for saturates there,
         # and the zero-padded buffer is bucket-sized).
         cap = min(ecfg.max_context - 1, ecfg.prefill_buckets[-1])
-        ids = list(token_ids)[-cap:] or [0]
-        bucket = ecfg.bucket_for(len(ids))
+        rows = [list(ids)[-cap:] or [0] for ids in batch]
         with self._embed_lock:
             # Lazy singleton under a lock: concurrent first requests from
             # the server's worker threads must not each pay the compile.
             if self._embed_jit is None:
                 cfg = self.model_cfg
 
-                def fn(params, tokens, length):
+                def fn(params, tokens, lengths):
                     s = tokens.shape[1]
                     pos = jnp.broadcast_to(
                         jnp.arange(s, dtype=jnp.int32)[None], tokens.shape)
                     hidden, _ = self.mod.forward_hidden(
                         params, cfg, tokens, pos, None, make_dense_attn())
-                    mask = (jnp.arange(s) < length)[None, :, None]
+                    mask = (jnp.arange(s)[None, :] <
+                            lengths[:, None])[..., None]
                     pooled = (jnp.sum(hidden * mask, axis=1)
-                              / jnp.maximum(length, 1))
-                    return pooled[0].astype(jnp.float32)
+                              / jnp.maximum(lengths[:, None], 1))
+                    return pooled.astype(jnp.float32)
 
                 self._embed_jit = jax.jit(fn)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :len(ids)] = ids
-        return np.asarray(self._embed_jit(
-            self.params, jnp.asarray(toks),
-            jnp.asarray(len(ids), jnp.int32)))
+        out = []
+        for at in range(0, len(rows), self.EMBED_CHUNK):
+            chunk = rows[at:at + self.EMBED_CHUNK]
+            bucket = ecfg.bucket_for(max(len(r) for r in chunk))
+            n = 1 << (len(chunk) - 1).bit_length()     # pad lanes to 2^k
+            toks = np.zeros((n, bucket), np.int32)
+            lengths = np.zeros((n,), np.int32)
+            for i, r in enumerate(chunk):
+                toks[i, :len(r)] = r
+                lengths[i] = len(r)
+            pooled = self._embed_jit(self.params, jnp.asarray(toks),
+                                     jnp.asarray(lengths))
+            out.append(np.asarray(pooled)[:len(chunk)])
+        return np.concatenate(out, axis=0)
 
     def check_numerics(self) -> None:
         """Numerics sanitizer (SURVEY.md §5 race/sanitizer tier).

@@ -220,8 +220,8 @@ class InferenceServer:
                     content_type="application/json")
 
         def compute():
-            return [self.engine.embed(self.tokenizer.encode(t)).tolist()
-                    for t in texts]
+            ids = [self.tokenizer.encode(t) for t in texts]
+            return self.engine.embed_many(ids).tolist()
 
         vecs = await asyncio.to_thread(compute)
         if legacy:
