@@ -387,16 +387,22 @@ def test_chaos_injection():
     _run(srv, scenario)
 
 
-def test_dp_replica_serving():
+@pytest.mark.parametrize("quant,kv_quant", [("none", "none"),
+                                             ("int8", "int8")])
+def test_dp_replica_serving(quant, kv_quant):
     """dp=2 builds two replica engines on disjoint submeshes; concurrent
-    requests spread across them and all succeed (least-loaded routing)."""
+    requests spread across them and all succeed (least-loaded routing).
+    Parametrized over the quantization tiers: each replica carries its
+    own (possibly int8) weights + KV pool, and /metrics reports the
+    modes."""
     from tpu_inference.config import ParallelConfig
     from tpu_inference.server.http import build_engine_group
 
     cfg = FrameworkConfig(
         model=tiny_llama(vocab_size=512),
         engine=EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=4,
-                            max_batch_size=2, prefill_buckets=(16, 32)),
+                            max_batch_size=2, prefill_buckets=(16, 32),
+                            quant=quant, kv_quant=kv_quant),
         parallel=ParallelConfig(dp=2, tp=2),
         server=ServerConfig(model_name="t", tokenizer="byte"))
     group = build_engine_group(cfg)
@@ -404,6 +410,11 @@ def test_dp_replica_serving():
     d0 = {d for d in group.engines[0].mesh.devices.flat}
     d1 = {d for d in group.engines[1].mesh.devices.flat}
     assert d0.isdisjoint(d1)
+    if quant == "int8":
+        from tpu_inference.models.quant import QuantizedArray
+        for eng in group.engines:
+            assert isinstance(eng.params["blocks"]["wq"], QuantizedArray)
+            assert eng.kv.quantized
     srv = InferenceServer(cfg, group=group)
 
     async def scenario(client):
@@ -417,152 +428,11 @@ def test_dp_replica_serving():
         assert all(b["done"] and b["eval_count"] >= 1 for b in bodies)
         stats = await (await client.get("/metrics")).json()
         assert stats["dp"] == 2
+        assert stats["quant"] == quant
+        assert stats["kv_quant"] == kv_quant
         # Both replicas did work under concurrent load.
         assert all(r["requests_finished"] >= 1 for r in stats["replicas"])
 
     _run(srv, scenario)
 
 
-def test_engine_group_cancel_releases_owner():
-    """Cancelling a QUEUED request must release its EngineGroup owner
-    entry (the finish callback never fires for queued cancels)."""
-    from tpu_inference.engine.engine import Sequence
-    from tpu_inference.server.replicas import EngineGroup
-    from tpu_inference.engine.engine import InferenceEngine
-
-    eng = InferenceEngine(
-        tiny_llama(vocab_size=512),
-        EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
-                     max_batch_size=1, prefill_buckets=(16,)))
-    group = EngineGroup([eng])
-    # Scheduler NOT started: submissions stay queued.
-    seq = Sequence(request_id=7, prompt_tokens=[1, 2, 3], max_new_tokens=4)
-    group.submit(seq, lambda s, t: None, lambda s: None)
-    assert 7 in group._owner
-    group.cancel(7)
-    assert 7 not in group._owner
-    assert seq.finish_reason == "cancelled"
-
-
-def test_pipelined_serving_contract():
-    """Serving with decode_pipeline_depth=2 (dispatch-ahead) keeps the
-    wire contract and greedy determinism."""
-    cfg = FrameworkConfig(
-        model=tiny_llama(vocab_size=512),
-        engine=EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=8,
-                            max_batch_size=4, prefill_buckets=(16, 32),
-                            decode_steps_per_call=4,
-                            decode_pipeline_depth=2),
-        server=ServerConfig(model_name="t", tokenizer="byte"))
-    srv = InferenceServer(cfg)
-
-    async def go(client):
-        outs = []
-        for _ in range(2):
-            resp = await client.post("/api/generate", json={
-                "prompt": "pipelined", "stream": False, "max_tokens": 9,
-                "temperature": 0.0})
-            body = await resp.json()
-            assert body["done"] and body["eval_count"] == 9
-            outs.append(body["context"])
-        assert outs[0] == outs[1]
-        bodies = await asyncio.gather(*[client.post("/api/generate", json={
-            "prompt": f"c{i}", "stream": False, "max_tokens": 5})
-            for i in range(5)])
-        for r in bodies:
-            b = await r.json()
-            assert b["done"] and b["eval_count"] >= 1
-
-    _run(srv, go)
-
-
-def test_repeat_penalty_option(server):
-    """options.repeat_penalty changes greedy output (applied pre-argmax,
-    Ollama semantics); invalid values 400."""
-    async def go(client):
-        base = {"prompt": "repeat repeat repeat", "stream": False,
-                "max_tokens": 16, "temperature": 0.0}
-        plain = (await (await client.post(
-            "/api/generate", json=base)).json())["context"]
-        pen = (await (await client.post("/api/generate", json={
-            **base, "options": {"repeat_penalty": 1.8,
-                                "repeat_last_n": 64}})).json())["context"]
-        assert plain != pen
-        # Penalized greedy decode is still deterministic.
-        pen2 = (await (await client.post("/api/generate", json={
-            **base, "options": {"repeat_penalty": 1.8,
-                                "repeat_last_n": 64}})).json())["context"]
-        assert pen == pen2
-        bad = await client.post("/api/generate", json={
-            **base, "options": {"repeat_penalty": 0}})
-        assert bad.status == 400
-
-    _run(server, go)
-
-
-def test_embeddings_endpoints(server):
-    """/api/embeddings (legacy, prompt->embedding) and /api/embed
-    (input->embeddings): right shapes, deterministic, content-sensitive."""
-    async def go(client):
-        r1 = await (await client.post("/api/embeddings", json={
-            "model": "m", "prompt": "hello world"})).json()
-        vec = r1["embedding"]
-        assert isinstance(vec, list) and len(vec) == 128  # tiny-llama d_model
-        r2 = await (await client.post("/api/embeddings", json={
-            "prompt": "hello world"})).json()
-        assert r2["embedding"] == vec                      # deterministic
-        r3 = await (await client.post("/api/embed", json={
-            "input": ["hello world", "something else"]})).json()
-        assert len(r3["embeddings"]) == 2
-        assert r3["embeddings"][0] == vec                  # same pooling
-        assert r3["embeddings"][1] != vec                  # content-sensitive
-        bad = await client.post("/api/embeddings", json={"nope": 1})
-        assert bad.status == 400
-
-    _run(server, go)
-
-
-def test_generate_with_context_continuation(server):
-    """Ollama stateful continuation: POSTing a prior response's 'context'
-    array continues that conversation — equivalent to resending the full
-    text, and the returned context extends the submitted one."""
-    async def go(client):
-        first = await (await client.post("/api/generate", json={
-            "prompt": "continue me", "stream": False, "max_tokens": 6,
-            "temperature": 0.0})).json()
-        ctx = first["context"]
-        second = await (await client.post("/api/generate", json={
-            "prompt": " and more", "stream": False, "max_tokens": 6,
-            "temperature": 0.0, "context": ctx})).json()
-        assert second["context"][:len(ctx)] == ctx
-        assert second["eval_count"] == 6 or second["done_reason"] == "stop"
-        # Malformed context 400s.
-        bad = await client.post("/api/generate", json={
-            "prompt": "x", "stream": False, "context": ["nope"]})
-        assert bad.status == 400
-        bad2 = await client.post("/api/generate", json={
-            "prompt": "x", "stream": False, "context": [10**9]})
-        assert bad2.status == 400
-
-    _run(server, go)
-
-
-def test_empty_prompt_is_load_ping(server):
-    """Ollama contract: an empty /api/generate is a load/liveness probe
-    answered immediately with done_reason='load' (no engine work); an
-    empty prompt WITH a context still generates (continuation)."""
-    async def go(client):
-        r = await (await client.post("/api/generate", json={
-            "prompt": "", "stream": False})).json()
-        assert r["done"] is True and r["done_reason"] == "load"
-        assert r["response"] == ""
-        first = await (await client.post("/api/generate", json={
-            "prompt": "seed", "stream": False, "max_tokens": 4,
-            "temperature": 0.0})).json()
-        cont = await (await client.post("/api/generate", json={
-            "prompt": "", "stream": False, "max_tokens": 4,
-            "temperature": 0.0, "context": first["context"]})).json()
-        assert cont["done_reason"] in ("length", "stop")
-        assert cont["context"][:len(first["context"])] == first["context"]
-
-    _run(server, go)
