@@ -45,6 +45,27 @@ class SchedulerStats:
     requests_rejected: int = 0
     batch_occupancy_sum: float = 0.0
     peak_pages_in_use: int = 0
+    # Ring of recent decode-dispatch wall times (seconds): the host-side
+    # number decode_steps_per_call / pipeline depth are tuned against.
+    # A fixed list + index (not a deque): the engine thread writes while
+    # /metrics reads, and list item assignment is GIL-atomic whereas
+    # deque iteration raises if mutated mid-scan.
+    decode_call_s: List[float] = dataclasses.field(
+        default_factory=lambda: [0.0] * 512)
+    decode_calls: int = 0
+
+    def record_decode_call(self, seconds: float) -> None:
+        self.decode_call_s[self.decode_calls % len(self.decode_call_s)] = \
+            seconds
+        self.decode_calls += 1
+
+    def _decode_call_percentiles(self) -> Optional[Dict]:
+        n = min(self.decode_calls, len(self.decode_call_s))
+        if n == 0:
+            return None
+        xs = sorted(self.decode_call_s[:n])
+        pick = lambda p: xs[min(n - 1, int(p * n))]  # noqa: E731
+        return {"p50": round(pick(0.50), 6), "p99": round(pick(0.99), 6)}
 
     def snapshot(self, engine: InferenceEngine) -> Dict:
         occ = (self.batch_occupancy_sum / self.steps) if self.steps else 0.0
@@ -68,6 +89,7 @@ class SchedulerStats:
             "quant": engine.engine_cfg.quant,
             "kv_quant": engine.engine_cfg.kv_quant,
             "decode_pipeline_depth": engine.engine_cfg.decode_pipeline_depth,
+            "decode_call_s": self._decode_call_percentiles(),
         }
         if engine.prefix_cache is not None:
             out["prefix_cache"] = engine.prefix_cache.stats()
@@ -359,6 +381,7 @@ class EngineScheduler:
                 # streams out as sampled (no K-token flush bursts). Spec
                 # decode has its own emission cadence; leave it alone.
                 thresh = engine.engine_cfg.latency_decode_threshold
+                t_call = time.perf_counter()
                 if (0 < len(active) <= thresh and not self._waiting
                         and self._prefilling is None
                         and not engine.pipeline_pending
@@ -366,6 +389,7 @@ class EngineScheduler:
                     new_tokens = engine.decode_steps(max_steps=1)
                 else:
                     new_tokens = engine.decode_steps_pipelined()
+                self.stats.record_decode_call(time.perf_counter() - t_call)
             except Exception:  # noqa: BLE001 — keep the engine loop alive
                 import traceback
                 traceback.print_exc()

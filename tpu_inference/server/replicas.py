@@ -107,6 +107,14 @@ class EngineGroup:
             agg["prefix_cache"] = {
                 k: sum(d["prefix_cache"][k] for d in per)
                 for k in per[0]["prefix_cache"]}
+        # Fleet decode-dispatch latency = element-wise worst replica (an
+        # operator alarms on p99; replica 0's copy masquerading as the
+        # fleet number would hide a degraded replica).
+        rings = [d.get("decode_call_s") for d in per]
+        rings = [r for r in rings if r]
+        agg["decode_call_s"] = (
+            {k: max(r[k] for r in rings) for k in rings[0]} if rings
+            else None)
         if "speculative" in per[0]:
             drafted = sum(d["speculative"]["drafted"] for d in per)
             accepted = sum(d["speculative"]["accepted"] for d in per)
