@@ -211,3 +211,34 @@ def test_latency_mode_matches_fused_tokens():
     fused = run(threshold=0)      # always the fused-K path
     latency = run(threshold=4)    # always the single-step path
     assert fused == latency and len(fused) == 10
+
+
+def test_admission_during_incremental_prefill_no_slot_collision():
+    """A request admitted WHILE a multi-chunk prefill is mid-flight must
+    not be handed the prefilling sequence's slot (the slot binds at
+    prefill_begin; before the fix, free_slots still listed it and the
+    finishing prefill overwrote the newcomer, orphaning its stream)."""
+    model_cfg = cfgs.tiny_llama(vocab_size=256)
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=128, max_pages_per_seq=16,
+                             max_batch_size=2,     # only 2 slots: collision-prone
+                             prefill_buckets=(16,),
+                             chunked_prefill_size=16)
+    params, _ = build_model(model_cfg, seed=0)
+    engine = InferenceEngine(model_cfg, ecfg, params=params)
+    sched = EngineScheduler(engine).start()
+    try:
+        rng = np.random.default_rng(3)
+        # 100-token prompt = 7 chunks of 16: many loop iterations mid-prefill.
+        long_seq = Sequence(request_id=1,
+                            prompt_tokens=rng.integers(
+                                0, 256, size=100).tolist(),
+                            max_new_tokens=4)
+        shorts = [Sequence(request_id=10 + i,
+                           prompt_tokens=rng.integers(0, 256, size=6).tolist(),
+                           max_new_tokens=4) for i in range(3)]
+        events = _submit_and_wait(sched, [long_seq] + shorts, timeout=120.0)
+        for s in [long_seq] + shorts:
+            assert s.finish_reason == "length", (s.request_id, s.finish_reason)
+            assert len(events[s.request_id]) == 4
+    finally:
+        sched.stop(drain=False)

@@ -298,9 +298,15 @@ class InferenceEngine:
         # the device every step instead of every K — the scheduler's
         # latency mode uses it when the batch is nearly empty (streaming
         # smoothness; fused K-step calls would still run K forwards for
-        # one visible token).
-        self._decode_one_jit = jax.jit(
-            partial(self._decode_multi_fn, k_steps=1), donate_argnums=(1,))
+        # one visible token). With K == 1 the fused graph IS the 1-step
+        # graph; aliasing keeps one compile cache so warmup covers both
+        # routes.
+        if engine_cfg.decode_steps_per_call <= 1:
+            self._decode_one_jit = self._decode_multi_jit
+        else:
+            self._decode_one_jit = jax.jit(
+                partial(self._decode_multi_fn, k_steps=1),
+                donate_argnums=(1,))
         # Sequence-parallel prefill (ring attention over the sp axis) for
         # fresh full-prompt chunks on an sp>1 mesh.
         self.sp = 1 if mesh is None else int(mesh.shape.get("sp", 1))
@@ -516,7 +522,7 @@ class InferenceEngine:
         else:
             decodes = [self._decode_multi_jit]
             if (ecfg.latency_decode_threshold > 0
-                    and ecfg.decode_steps_per_call > 1):
+                    and self._decode_one_jit is not self._decode_multi_jit):
                 # The 1-step graph is a second full decode compile; pay
                 # it only when latency mode can actually route to it.
                 decodes.append(self._decode_one_jit)
@@ -818,11 +824,20 @@ class InferenceEngine:
     def prefill_begin(self, seq: Sequence,
                       slot: Optional[int] = None) -> int:
         """Set up an incremental prefill (pages, slot, cache lookup);
-        drive it with prefill_step(). Returns the slot."""
+        drive it with prefill_step(). Returns the slot.
+
+        The slot binds into ``self.slots`` HERE, not at finish: batch
+        admission re-reads free_slots() between this sequence's chunks
+        (that interleaving is the point of incremental prefill), and an
+        unreserved slot would be handed to a second sequence, which the
+        finishing prefill then silently overwrites — orphaning it.
+        ``active_sequences`` excludes mid-prefill slots, so decode never
+        touches the half-filled sequence."""
         if slot is None:
             slot = self.free_slots()[0]
         seq.prefill_prompt = self._prefill_setup(seq, slot)
         seq.prefill_offset = seq.cached_tokens
+        self.slots[slot] = seq
         return slot
 
     def prefill_step(self, seq: Sequence) -> bool:
@@ -955,7 +970,11 @@ class InferenceEngine:
             self.slots[seq.slot] = None
 
     def active_sequences(self) -> List[Sequence]:
-        return [s for s in self.slots if s is not None and not s.done]
+        """Sequences decode may advance: bound, not finished, and not
+        still mid-incremental-prefill (those hold their slot but have no
+        complete KV yet)."""
+        return [s for s in self.slots
+                if s is not None and not s.done and s.prefill_prompt is None]
 
     def _sampling_arrays(self, seq: Sequence):
         """(top_k, seed) for one sequence, with engine defaults applied.

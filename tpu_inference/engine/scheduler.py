@@ -242,8 +242,9 @@ class EngineScheduler:
         (no head-of-line blocking behind the long prompt)."""
         admitted = 0
         if self._prefilling is not None:
+            # Advancing an ALREADY-admitted prefill by one chunk is not a
+            # new admission; only fresh requests count below.
             self._step_incremental_prefill()
-            admitted += 1
         batch: List[_Pending] = []
         start_chunked: Optional[_Pending] = None
         reserved = 0

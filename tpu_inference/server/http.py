@@ -221,7 +221,7 @@ class InferenceServer:
 
         def compute():
             ids = [self.tokenizer.encode(t) for t in texts]
-            return self.engine.embed_many(ids).tolist()
+            return self.group.embed_many(ids).tolist()
 
         vecs = await asyncio.to_thread(compute)
         if legacy:

@@ -55,6 +55,12 @@ class EngineGroup:
 
         return min(self.schedulers, key=load)
 
+    def embed_many(self, batch) -> "np.ndarray":  # noqa: F821
+        """Embeddings on the least-loaded replica — pinning them to
+        replica 0 would interleave dense forwards with its decode loop
+        while the other replicas idle."""
+        return self._least_loaded().engine.embed_many(batch)
+
     def submit(self, seq: Sequence, on_token: Callable,
                on_finish: Callable) -> None:
         sched = self._least_loaded()
@@ -85,9 +91,11 @@ class EngineGroup:
         items.sort(key=lambda t: t.get("finished_unix", 0.0))
         return items[-n:]
 
-    # Per-chip gauges that must not be summed across replicas.
+    # Per-chip gauges / config constants that must not be summed across
+    # replicas. KV page counts SUM (total and in_use together, so fleet
+    # utilization = in_use/total stays consistent); depth is config.
     _NON_ADDITIVE = ("model_params", "approx_flops_per_token",
-                     "mean_batch_occupancy", "kv_pages_total")
+                     "mean_batch_occupancy", "decode_pipeline_depth")
 
     def stats_snapshot(self) -> dict:
         """Aggregate counters + per-replica breakdown."""
