@@ -463,3 +463,77 @@ def test_repeat_penalty_pipelined_matches_sync():
         cfg, cfgs.EngineConfig(**base, decode_pipeline_depth=2), seed=0)
     pipe = _gen_with_penalty(pipe_eng, 1.8, use_pipeline=True)
     assert sync == pipe
+
+
+def _drive(engine, prompts, n_new, pipelined):
+    """Minimal serving loop: admit when possible, decode via the
+    pipelined path when requested (engine.generate only exercises the
+    synchronous one), drain before releasing finished slots — the same
+    ordering the production scheduler uses."""
+    seqs = [Sequence(request_id=i, prompt_tokens=list(p),
+                     max_new_tokens=n_new) for i, p in enumerate(prompts)]
+    results = {}
+    pending = list(seqs)
+    while (pending or engine.active_sequences()
+           or engine.pipeline_pending):
+        while pending and engine.free_slots() and engine.can_admit(pending[0]):
+            engine.prefill(pending.pop(0))
+        if pipelined:
+            engine.decode_steps_pipelined()
+        else:
+            engine.decode_steps()
+        done = [s for s in engine.slots if s is not None and s.done]
+        if done and engine.pipeline_pending:
+            engine.drain_pipeline()
+        for s in [s for s in engine.slots if s is not None and s.done]:
+            results[s.request_id] = s.generated
+            engine.release(s)
+    return [results[i] for i in range(len(seqs))]
+
+
+def test_engine_matches_oracle_across_random_configs():
+    """Config-space fuzz of the canonical invariant: engine output ==
+    cache-free full-forward greedy, across randomized paging geometry,
+    GQA ratios, bucket sets, fused-step counts, chunking, and prompt
+    lengths. Catches interactions a single fixed config can't (page
+    boundary off-by-ones, bucket selection, chunk seams)."""
+    rng = np.random.default_rng(2026)
+    for trial in range(5):
+        n_heads = int(rng.choice([2, 4, 8]))
+        n_kv = int(rng.choice([h for h in (1, 2, 4) if n_heads % h == 0]))
+        model_cfg = cfgs.ModelConfig(
+            name=f"fuzz-{trial}", family="llama", vocab_size=256,
+            d_model=64, n_layers=2, n_heads=n_heads, n_kv_heads=n_kv,
+            d_ff=128, max_seq_len=512, rope_theta=10000.0,
+            dtype=jnp.float32)
+        page = int(rng.choice([4, 8, 16]))
+        bucket_hi = int(rng.choice([32, 64]))
+        ecfg = cfgs.EngineConfig(
+            page_size=page, num_pages=96,
+            max_pages_per_seq=max(8, 128 // page),
+            max_batch_size=int(rng.choice([2, 3])),
+            prefill_buckets=(16, bucket_hi),
+            chunked_prefill_size=int(rng.choice([0, 16])),
+            decode_steps_per_call=int(rng.choice([1, 3, 8])),
+            decode_pipeline_depth=int(rng.choice([1, 2])),
+        )
+        params, mod = build_model(model_cfg, seed=trial)
+        engine = InferenceEngine(model_cfg, ecfg, params=params)
+        # Prompt lengths land on/around page and chunk boundaries, but
+        # stay within max_context - n_new so the engine's context cap
+        # (which the cache-free oracle doesn't have) never cuts a run.
+        n_new = int(rng.integers(3, 12))
+        max_len = min(3 * bucket_hi, ecfg.max_context - n_new - 2)
+        lens = [int(rng.integers(1, max_len)) for _ in range(2)]
+        lens.append(page)                     # exactly one page
+        prompts = [rng.integers(0, 256, size=n).tolist() for n in lens]
+        got = _drive(engine, prompts, n_new,
+                     pipelined=ecfg.decode_pipeline_depth > 1)
+        for prompt, gen in zip(prompts, got):
+            want = reference_greedy(params, mod, model_cfg, prompt, n_new)
+            assert gen == want, (
+                f"trial {trial} cfg page={page} heads={n_heads}/{n_kv} "
+                f"k={ecfg.decode_steps_per_call} "
+                f"depth={ecfg.decode_pipeline_depth} "
+                f"chunk={ecfg.chunked_prefill_size} "
+                f"len={len(prompt)}: {gen} != {want}")

@@ -355,6 +355,17 @@ class EngineScheduler:
                 for tok in toks:
                     pending.on_token(pending.seq, tok)
 
+    def _reapable(self) -> List[Sequence]:
+        """Finished sequences the run loop may finish NOW. A sequence
+        still owned by the incremental prefill (cancelled mid-chunks) is
+        excluded — _step_incremental_prefill finishes it, and finishing
+        twice would double-count stats and duplicate /debug timelines
+        (mid-prefill sequences sit in engine.slots since prefill_begin
+        binds the slot)."""
+        own = self._prefilling.seq if self._prefilling is not None else None
+        return [s for s in self.engine.slots
+                if s is not None and s.done and s is not own]
+
     def run(self) -> None:
         engine = self.engine
         while not self._stop.is_set():
@@ -365,7 +376,7 @@ class EngineScheduler:
                 # cancelled-in-flight sequences even when idle.
                 if engine.pipeline_pending:
                     self._deliver(engine.drain_pipeline())
-                for s in [s for s in engine.slots if s is not None and s.done]:
+                for s in self._reapable():
                     self._finish(s)
                 if self._prefilling is not None:
                     continue          # next iteration runs the next chunk
@@ -417,5 +428,5 @@ class EngineScheduler:
                                                in_use)
 
             self._deliver(new_tokens)
-            for s in [s for s in engine.slots if s is not None and s.done]:
+            for s in self._reapable():
                 self._finish(s)
