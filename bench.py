@@ -36,12 +36,27 @@ import traceback
 
 BASELINE_TOK_S = 93.0  # BASELINE.md: reference-side Ollama single-stream rate
 
-# Per-chip peaks for utilization reporting (bf16 FLOP/s, HBM bytes/s).
+
+def _r(x, nd=2):
+    return round(x, nd) if x is not None else None
+
+
+def _ratio(a, b, nd=3):
+    return round(a / b, nd) if a is not None and b else None
+
+# Per-chip peaks for utilization reporting (bf16 FLOP/s, HBM bytes/s)
+# and HBM capacity (bytes) for fits-on-chip gating.
 CHIP_PEAKS = {
     "TPU v5 lite": (394e12, 819e9),
     "TPU v4": (275e12, 1228e9),
     "TPU v5p": (459e12, 2765e9),
     "TPU v6 lite": (918e12, 1640e9),
+}
+CHIP_HBM_BYTES = {
+    "TPU v5 lite": 16e9,
+    "TPU v4": 32e9,
+    "TPU v5p": 95e9,
+    "TPU v6 lite": 32e9,
 }
 
 
@@ -51,6 +66,16 @@ def bench_cfg(platform: str):
 
     if platform != "tpu":
         return tiny_llama()
+    if os.environ.get("BENCH_MODEL") == "8b":
+        # Llama-3-8B dims. bf16 weights (16 GB) don't fit one v5e chip,
+        # so this lane is int8-only (run_backend skips the bf16 lanes
+        # when the bf16 model exceeds HBM); opt-in via BENCH_MODEL=8b.
+        return ModelConfig(
+            name="llama-8b-bench", family="llama", vocab_size=128256,
+            d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            d_ff=14336, max_seq_len=2048, rope_theta=500000.0,
+            dtype=jnp.bfloat16,
+        )
     return ModelConfig(
         name="llama-1b-bench", family="llama", vocab_size=32000, d_model=2048,
         n_layers=22, n_heads=32, n_kv_heads=4, d_ff=5632, max_seq_len=2048,
@@ -133,19 +158,38 @@ def main() -> None:
     cfg = bench_cfg(platform)
     print(f"[bench] platform={platform} model={cfg.name}", file=sys.stderr)
 
-    dense_tok_s, dense_chained, _, _, _, dense_head = run_backend(
-        "dense", cfg, on_tpu)
-    (pallas_tok_s, pallas_chained, n_params, weight_bytes, mean_ctx,
-     pallas_head) = run_backend("pallas", cfg, on_tpu)
-    if dense_head != pallas_head:
-        # Greedy sampling: any drift is a correctness signal, not noise.
-        print(f"[bench] WARNING: backend token mismatch "
-              f"dense={dense_head} pallas={pallas_head}", file=sys.stderr)
+    # bf16 lanes only when the bf16 weights actually fit the chip
+    # (BENCH_MODEL=8b is int8-only on a 16 GB v5e).
+    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    kv_w = cfg.n_kv_heads * cfg.head_dim
+    est_params = (V * d * (1 if cfg.tie_embeddings else 2)
+                  + L * (2 * d * d + 2 * d * kv_w + 3 * d * f))
+    hbm = CHIP_HBM_BYTES.get(jax.devices()[0].device_kind, 16e9)
+    # ~0.9 usable after runtime reservations; bf16 lanes need weights
+    # plus KV pool + activations headroom.
+    bf16_fits = (not on_tpu) or 2 * est_params < 0.85 * hbm
+    if bf16_fits:
+        dense_tok_s, dense_chained, _, _, _, dense_head = run_backend(
+            "dense", cfg, on_tpu)
+        (pallas_tok_s, pallas_chained, n_params, weight_bytes, mean_ctx,
+         pallas_head) = run_backend("pallas", cfg, on_tpu)
+        if dense_head != pallas_head:
+            # Greedy sampling: any drift is a correctness signal, not noise.
+            print(f"[bench] WARNING: backend token mismatch "
+                  f"dense={dense_head} pallas={pallas_head}", file=sys.stderr)
+    else:
+        print(f"[bench] {cfg.name}: bf16 (~{2 * est_params / 1e9:.0f} GB) "
+              "exceeds HBM; int8 lane only", file=sys.stderr)
+        dense_tok_s = dense_chained = pallas_tok_s = pallas_chained = None
+        dense_head = pallas_head = None
     # Weight-only int8 (models/quant.py): halves the HBM weight read that
     # bounds decode. Tokens legitimately differ from bf16 (quantization),
     # so no equality check — test_quant.py pins the error envelope.
-    (int8_tok_s, int8_chained, _, int8_weight_bytes, _,
+    (int8_tok_s, int8_chained, n_params_q, int8_weight_bytes, mean_ctx_q,
      _) = run_backend("pallas", cfg, on_tpu, quant="int8")
+    if not bf16_fits:
+        n_params, mean_ctx = n_params_q, mean_ctx_q
+        weight_bytes = 2 * n_params
 
     batch = 8
     flops_per_token = 2 * n_params
@@ -160,15 +204,19 @@ def main() -> None:
         return (round(tok_s * flops_per_token / peak_flops, 4),
                 round(bw / peak_bw, 4))
 
-    best_bf16 = max(pallas_tok_s, pallas_chained)
+    best_bf16 = max(pallas_tok_s, pallas_chained) if bf16_fits else 0.0
     best_int8 = max(int8_tok_s, int8_chained)
     best = max(best_bf16, best_int8)
     wbytes = int8_weight_bytes if best_int8 >= best_bf16 else weight_bytes
     quant_tag = "int8" if best_int8 >= best_bf16 else "bf16"
-    mode = "dispatch-ahead" if max(pallas_chained, int8_chained) >= \
-        max(pallas_tok_s, int8_tok_s) else "sync"
+    chained_best = max([c for c in (pallas_chained, int8_chained)
+                        if c is not None])
+    sync_best = max([c for c in (pallas_tok_s, int8_tok_s)
+                     if c is not None])
+    mode = "dispatch-ahead" if chained_best >= sync_best else "sync"
     mfu, hbm_util = util(best, wbytes)
-    mfu_bf16, hbm_util_bf16 = util(best_bf16, weight_bytes)
+    mfu_bf16, hbm_util_bf16 = (util(best_bf16, weight_bytes)
+                               if bf16_fits else (None, None))
     print(json.dumps({
         # Name stays stable across rounds (BENCH_r{N}.json diffs by key);
         # the winning lane is reported in best_lane.
@@ -180,20 +228,21 @@ def main() -> None:
         "vs_baseline": round(best / batch / BASELINE_TOK_S, 3),
         "vs_baseline_aggregate": round(best / BASELINE_TOK_S, 3),
         "per_stream_tok_s": round(best / batch, 2),
-        "sync_tok_s": round(pallas_tok_s, 2),
-        "chained_tok_s": round(pallas_chained, 2),
-        "dense_tok_s": round(dense_tok_s, 2),
-        "dense_chained_tok_s": round(dense_chained, 2),
+        "model": cfg.name,
+        "sync_tok_s": _r(pallas_tok_s),
+        "chained_tok_s": _r(pallas_chained),
+        "dense_tok_s": _r(dense_tok_s),
+        "dense_chained_tok_s": _r(dense_chained),
         "int8_tok_s": round(int8_tok_s, 2),
         "int8_chained_tok_s": round(int8_chained, 2),
         # Mode-matched kernel comparisons (sync/sync and chained/chained).
-        "pallas_speedup_vs_dense_sync": round(pallas_tok_s / dense_tok_s, 3),
-        "pallas_speedup_vs_dense_chained": round(
-            pallas_chained / dense_chained, 3),
-        "int8_speedup_vs_bf16": round(best_int8 / best_bf16, 3),
+        "pallas_speedup_vs_dense_sync": _ratio(pallas_tok_s, dense_tok_s),
+        "pallas_speedup_vs_dense_chained": _ratio(pallas_chained,
+                                                  dense_chained),
+        "int8_speedup_vs_bf16": _ratio(best_int8, best_bf16 or None),
         "mfu": mfu,
         "hbm_util": hbm_util,
-        "bf16_tok_s": round(best_bf16, 2),
+        "bf16_tok_s": _r(best_bf16) if bf16_fits else None,
         "bf16_mfu": mfu_bf16,
         "bf16_hbm_util": hbm_util_bf16,
         "weight_bytes_bf16": weight_bytes,
@@ -201,7 +250,8 @@ def main() -> None:
         "mean_ctx": round(mean_ctx, 1),
         "chip": jax.devices()[0].device_kind,
         "platform": platform,
-        "backends_token_equal": dense_head == pallas_head,
+        "backends_token_equal": (dense_head == pallas_head
+                                 if bf16_fits else None),
     }))
 
 

@@ -413,7 +413,7 @@ class EngineScheduler:
                 continue
             self.stats.steps += 1
             self.stats.batch_occupancy_sum += len(active)
-            done_seqs = [s for s in engine.slots if s is not None and s.done]
+            done_seqs = self._reapable()
             if done_seqs and engine.pipeline_pending:
                 # A finish releases pages a newer in-flight call may still
                 # write: drain first so release happens against settled
