@@ -159,6 +159,7 @@ def main() -> dict:
     p.add_argument("--decode-steps-per-call", type=int, default=8)
     p.add_argument("--decode-pipeline-depth", type=int, default=1)
     p.add_argument("--quant", default="none", choices=("none", "int8"))
+    p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--compare", action="store_true",
                    help="also run with the prefix cache disabled and "
