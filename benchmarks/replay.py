@@ -160,9 +160,23 @@ def main() -> dict:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--quant", default="none", choices=("none", "int8"))
     p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
+    p.add_argument("--platform", default="auto",
+                   choices=("auto", "cpu", "tpu"),
+                   help="jax platform; 'cpu' forces the CPU backend "
+                        "(tp virtual devices) before any computation")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     args = p.parse_args()
+
+    if args.platform != "auto":
+        # Before any jax computation (env vars are read too early in
+        # some images; jax.config is the reliable override — same
+        # pattern as the server CLI and tests/conftest.py).
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu":
+            jax.config.update("jax_num_cpu_devices", max(1, args.tp))
 
     from traffic_generator.data import DataLoader
     from traffic_generator.generator import TrafficGenerator
