@@ -437,15 +437,16 @@ def test_dp_replica_serving(quant, kv_quant):
 
 
 
-
 def test_context_continuation_hits_prefix_cache(server):
     """A continuation request (prior response's context + new prompt) is
     a strict prefix extension, so its prefill must reuse the cached KV
     pages of the first request (tokens_prefix_cached grows)."""
     async def go(client):
-        first = await (await client.post("/api/generate", json={
+        resp = await client.post("/api/generate", json={
             "prompt": "cache me please", "stream": False, "max_tokens": 10,
-            "temperature": 0.0})).json()
+            "temperature": 0.0})
+        assert resp.status == 200
+        first = await resp.json()
         before = (await (await client.get("/metrics")).json()
                   )["tokens_prefix_cached"]
         cont = await (await client.post("/api/generate", json={
