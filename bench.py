@@ -1,13 +1,13 @@
 """Benchmark: batched decode throughput through the serving engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Emits JSON lines on stdout; the LAST line is the authoritative record:
+{"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Headline metric: aggregate tokens/s of continuous-batching decode (batch=8)
 on a 1B-class Llama-shape model (TinyLlama-1.1B dims) with the paged KV
 cache and the **Pallas paged-attention kernel** — the engine's steady-state
 serving path on TPU. The dense gather backend is timed too and reported as
-``dense_tok_s`` so the kernel's delta is visible (ADVICE.md r2: name the
-backend in the metric).
+``dense_tok_s`` so the kernel's delta is visible.
 
 Baseline: the only decode-rate number recorded anywhere in the reference,
 Ollama serving `mistral` at ~93 tok/s **single-stream** (BASELINE.md,
@@ -15,14 +15,44 @@ reference notebooks/aiohttp_tracing.ipynb cell e01c6727 output).
 ``vs_baseline`` compares like-for-like per-stream rate against it;
 the aggregate ratio is reported separately as ``vs_baseline_aggregate``.
 
+Resilience (round-3 lesson — BENCH_r03.json was rc=124 with ZERO output
+after the TPU tunnel wedged): the parent process never imports jax, so
+jax device init cannot hang it. Every jax-touching step runs in a child
+subprocess in its own process group (killpg on timeout — a timeout-killed
+direct child must not leave orphaned runtime helpers holding the TPU, the
+very thing that wedged the round-3 tunnel) with stdout to a temp file (a
+pipe could block the parent on orphan EOF). Steps:
+
+  1. ``--probe`` child (120 s): init jax, report platform/device_kind.
+     If the probe hangs twice, the parent retries it with the axon
+     sitecustomize bypassed (``PYTHONPATH= JAX_PLATFORMS=cpu``) and runs
+     the lanes on CPU at test scale, marked ``degraded``.
+  2. One ``--lane backend:quant`` child per measurement lane
+     (pallas/bf16 first — the headline — then pallas/int8, then
+     dense/bf16), each under a ~4.5-minute deadline. After EVERY lane a
+     full snapshot record is printed+flushed, so even a driver-level kill
+     mid-run leaves a parseable line with the lanes measured so far.
+  3. A lane failure on TPU triggers a 60 s re-probe: tunnel gone →
+     remaining lanes are skipped; tunnel fine → the lane is retried once
+     (transient dial errors shouldn't cost the round its headline lane).
+  4. A hard overall budget (TOTAL_BUDGET_S): no lane launches unless it
+     can finish inside it, so total wall time is provably bounded at
+     ~budget + one lane timeout ≈ 17 min — typical healthy-TPU runs
+     finish in ~6, tunnel-dead-from-the-start runs in ~8.
+
+If nothing can initialize at all the script still prints
+``{"metric": ..., "value": null, "skipped": "tpu-unavailable"}`` and
+exits 0 — a missing artifact is the one unacceptable outcome. Residual
+risk this file cannot remove: in the deepest wedge state the axon
+sitecustomize blocks every python interpreter at start, parent included,
+before any line here runs (round-3 memory); killing whole process groups
+on timeout is what keeps *this* script from creating that state.
+
 Extras: ``mfu`` and ``hbm_util`` situate the number against chip peaks
 (v5e: 394 bf16 TFLOP/s, 819 GB/s HBM) — decode at small batch is HBM-bound,
-so ``hbm_util`` is the honest utilization figure.
-
-On non-TPU platforms (driver smoke runs) the model drops to test scale so
-the script stays fast; ratios are only meaningful on TPU. Transient TPU
-runtime failures (tunnel dial) are retried with backoff before giving up
-with a parseable {"error": ...} line on stdout and rc=1.
+so ``hbm_util`` is the honest utilization figure. On non-TPU platforms the
+model drops to test scale so the script stays fast; ratios are only
+meaningful on TPU.
 """
 
 from __future__ import annotations
@@ -30,19 +60,18 @@ from __future__ import annotations
 import gc
 import json
 import os
+import subprocess
 import sys
 import time
-import traceback
 
 BASELINE_TOK_S = 93.0  # BASELINE.md: reference-side Ollama single-stream rate
+METRIC = "decode_tok_s_llama1b_bs8_pallas"
+BATCH = 8
 
-
-def _r(x, nd=2):
-    return round(x, nd) if x is not None else None
-
-
-def _ratio(a, b, nd=3):
-    return round(a / b, nd) if a is not None and b else None
+PROBE_TIMEOUT_S = 120
+LANE_TIMEOUT_S = 280
+REPROBE_TIMEOUT_S = 60
+TOTAL_BUDGET_S = 780  # no lane launches that can't finish inside this
 
 # Per-chip peaks for utilization reporting (bf16 FLOP/s, HBM bytes/s)
 # and HBM capacity (bytes) for fits-on-chip gating.
@@ -60,6 +89,18 @@ CHIP_HBM_BYTES = {
 }
 
 
+def _r(x, nd=2):
+    return round(x, nd) if x is not None else None
+
+
+def _ratio(a, b, nd=3):
+    return round(a / b, nd) if a is not None and b else None
+
+
+# ---------------------------------------------------------------------------
+# Child bodies (the only code that imports jax).
+# ---------------------------------------------------------------------------
+
 def bench_cfg(platform: str):
     import jax.numpy as jnp
     from tpu_inference.config import ModelConfig, tiny_llama
@@ -68,8 +109,8 @@ def bench_cfg(platform: str):
         return tiny_llama()
     if os.environ.get("BENCH_MODEL") == "8b":
         # Llama-3-8B dims. bf16 weights (16 GB) don't fit one v5e chip,
-        # so this lane is int8-only (run_backend skips the bf16 lanes
-        # when the bf16 model exceeds HBM); opt-in via BENCH_MODEL=8b.
+        # so this lane is int8-only (bf16 lanes report skipped when the
+        # bf16 model exceeds HBM); opt-in via BENCH_MODEL=8b.
         return ModelConfig(
             name="llama-8b-bench", family="llama", vocab_size=128256,
             d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
@@ -83,13 +124,25 @@ def bench_cfg(platform: str):
     )
 
 
-def run_backend(backend: str, cfg, on_tpu: bool, quant: str = "none"):
-    """Time steady-state batched decode for one attention backend.
+def _est_params(cfg) -> int:
+    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    kv_w = cfg.n_kv_heads * cfg.head_dim
+    return (V * d * (1 if cfg.tie_embeddings else 2)
+            + L * (2 * d * d + 2 * d * kv_w + 3 * d * f))
 
-    Returns (sync tok/s, chained tok/s, model param count, weight bytes
-    actually resident (int8 shrinks this), mean context length, first 8
-    greedy tokens of lane 0 for cross-backend equality).
-    """
+
+def probe_child() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    print(json.dumps({"platform": dev.platform,
+                      "device_kind": dev.device_kind}), flush=True)
+
+
+def lane_child(spec: str) -> None:
+    """Measure one (backend, quant) lane; print ONE JSON record."""
+    backend, quant = spec.split(":")
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -97,7 +150,21 @@ def run_backend(backend: str, cfg, on_tpu: bool, quant: str = "none"):
     from tpu_inference.config import EngineConfig
     from tpu_inference.engine.engine import InferenceEngine, Sequence
 
-    batch = 8
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bench_cfg(platform)
+
+    if quant != "int8" and on_tpu:
+        # bf16 lanes need weights + KV pool + activations headroom inside
+        # the chip's HBM, gated at 0.85 * capacity to leave room for the
+        # runtime's own reservations.
+        hbm = CHIP_HBM_BYTES.get(jax.devices()[0].device_kind, 16e9)
+        if 2 * _est_params(cfg) >= 0.85 * hbm:
+            print(json.dumps({"lane": spec, "skipped": "bf16-exceeds-hbm",
+                              "model": cfg.name}), flush=True)
+            return
+
+    batch = BATCH
     prompt_len = 120
     k = 8                                    # fused decode steps per dispatch
     timed_calls = 32 if on_tpu else 2
@@ -109,8 +176,7 @@ def run_backend(backend: str, cfg, on_tpu: bool, quant: str = "none"):
                         attn_backend=backend, quant=quant)
     engine = InferenceEngine(cfg, ecfg)
     t = engine.warmup()
-    print(f"[bench] {backend}/{quant}: warmup (XLA compile) {t:.1f}s",
-          file=sys.stderr)
+    print(f"[bench] {spec}: warmup (XLA compile) {t:.1f}s", file=sys.stderr)
 
     rng = np.random.default_rng(0)
     for i in range(batch):
@@ -140,160 +206,259 @@ def run_backend(backend: str, cfg, on_tpu: bool, quant: str = "none"):
 
     mean_ctx = float(np.mean([s.ctx_len for s in engine.slots
                               if s is not None]))
-    head = list(engine.slots[0].generated[:8])
-    n_params = engine.n_params
+    head = [int(t) for t in engine.slots[0].generated[:8]]
     weight_bytes = int(sum(x.size * x.dtype.itemsize
                            for x in jax.tree.leaves(engine.params)))
-    # Free HBM before the next backend's engine materializes.
+    print(json.dumps({
+        "lane": spec, "model": cfg.name, "platform": platform,
+        "sync_tok_s": sync_tok_s, "chained_tok_s": chained_tok_s,
+        "n_params": int(engine.n_params), "weight_bytes": weight_bytes,
+        "mean_ctx": mean_ctx, "head": head,
+        "kv_bytes_per_token": 2 * 2 * cfg.n_layers * cfg.n_kv_heads
+                              * cfg.head_dim,
+    }), flush=True)
     del engine
     gc.collect()
-    return sync_tok_s, chained_tok_s, n_params, weight_bytes, mean_ctx, head
 
 
-def main() -> None:
-    import jax
+# ---------------------------------------------------------------------------
+# Parent orchestrator (never imports jax — cannot hang on the tunnel).
+# ---------------------------------------------------------------------------
 
-    platform = jax.devices()[0].platform
-    on_tpu = platform == "tpu"
-    cfg = bench_cfg(platform)
-    print(f"[bench] platform={platform} model={cfg.name}", file=sys.stderr)
+def _run_child(args, timeout, env=None):
+    """Run a child, return the last JSON object on its stdout (or None).
 
-    # bf16 lanes only when the bf16 weights actually fit the chip
-    # (BENCH_MODEL=8b is int8-only on a 16 GB v5e).
-    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
-    kv_w = cfg.n_kv_heads * cfg.head_dim
-    est_params = (V * d * (1 if cfg.tie_embeddings else 2)
-                  + L * (2 * d * d + 2 * d * kv_w + 3 * d * f))
-    hbm = CHIP_HBM_BYTES.get(jax.devices()[0].device_kind, 16e9)
-    # ~0.9 usable after runtime reservations; bf16 lanes need weights
-    # plus KV pool + activations headroom.
-    bf16_fits = (not on_tpu) or 2 * est_params < 0.85 * hbm
-    if bf16_fits:
-        dense_tok_s, dense_chained, _, _, _, dense_head = run_backend(
-            "dense", cfg, on_tpu)
-        (pallas_tok_s, pallas_chained, n_params, weight_bytes, mean_ctx,
-         pallas_head) = run_backend("pallas", cfg, on_tpu)
-        if dense_head != pallas_head:
+    The child gets its own process group and its stdout goes to a temp
+    file, not a pipe: on timeout the WHOLE group is SIGKILLed (a
+    timeout-killed direct child leaving an orphaned TPU-runtime helper
+    alive is how the round-3 tunnel wedged), and a temp file can't block
+    the parent waiting for an orphan to close the write end.
+    """
+    import signal
+    import tempfile
+
+    with tempfile.TemporaryFile() as out:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            env=env, stdout=out, stderr=sys.stderr,
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] child {args} timed out after {timeout}s; "
+                  "killing its process group", file=sys.stderr)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            rc = -1
+        out.seek(0)
+        stdout = out.read().decode(errors="replace")
+    rec = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    return rc, rec
+
+
+def _cpu_env():
+    """Bypass the axon sitecustomize (a wedged relay hangs jax device
+    init); cleared PYTHONPATH skips plugin registration entirely and
+    JAX_PLATFORMS=cpu gives a clean CPU fallback."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _snapshot(probe, lanes, degraded, partial, t_start):
+    """Assemble the full record from whatever lanes have completed."""
+    def lane(spec):
+        rec = lanes.get(spec)
+        return rec if rec and "sync_tok_s" in rec else None
+
+    pallas, int8, dense = lane("pallas:none"), lane("pallas:int8"), \
+        lane("dense:none")
+    any_lane = pallas or int8 or dense
+
+    pallas_tok_s = pallas and pallas["sync_tok_s"]
+    pallas_chained = pallas and pallas["chained_tok_s"]
+    int8_tok_s = int8 and int8["sync_tok_s"]
+    int8_chained = int8 and int8["chained_tok_s"]
+    dense_tok_s = dense and dense["sync_tok_s"]
+    dense_chained = dense and dense["chained_tok_s"]
+
+    # The headline is the production serving path (Pallas lanes); the
+    # dense lane is comparison-only and never sets ``value`` unless no
+    # Pallas lane produced a number at all.
+    best_bf16 = max(pallas_tok_s or 0.0, pallas_chained or 0.0)
+    best_int8 = max(int8_tok_s or 0.0, int8_chained or 0.0)
+    best = (max(best_bf16, best_int8)
+            or max(dense_tok_s or 0.0, dense_chained or 0.0) or None)
+
+    # mfu / hbm_util from the winning lane's resident weight bytes.
+    mfu = hbm_util = mfu_bf16 = hbm_util_bf16 = None
+    quant_tag = None
+    if any_lane and best:
+        win = int8 if best_int8 >= best_bf16 and int8 else (pallas or dense)
+        quant_tag = "int8" if win is int8 else "bf16"
+        n_params = win["n_params"]
+        kv_bpt = win["kv_bytes_per_token"]
+        peak_flops, peak_bw = CHIP_PEAKS.get(
+            probe.get("device_kind"), (394e12, 819e9))
+
+        def util(tok_s, wbytes):
+            if not tok_s:
+                return None, None
+            steps_per_s = tok_s / BATCH
+            bw = steps_per_s * (wbytes + BATCH * kv_bpt * win["mean_ctx"])
+            return (round(tok_s * 2 * n_params / peak_flops, 4),
+                    round(bw / peak_bw, 4))
+
+        mfu, hbm_util = util(best, win["weight_bytes"])
+        if pallas:
+            mfu_bf16, hbm_util_bf16 = util(best_bf16,
+                                           pallas["weight_bytes"])
+
+    # Mode label follows the lanes that actually supplied ``best``:
+    # pallas lanes normally, the dense lane only in fallback.
+    if best_bf16 or best_int8:
+        chained_cands = [c for c in (pallas_chained, int8_chained) if c]
+        sync_cands = [c for c in (pallas_tok_s, int8_tok_s) if c]
+    else:
+        chained_cands = [c for c in (dense_chained,) if c]
+        sync_cands = [c for c in (dense_tok_s,) if c]
+    mode = ("dispatch-ahead" if chained_cands and
+            max(chained_cands) >= max(sync_cands or [0.0]) else "sync")
+
+    heads_equal = None
+    if pallas and dense:
+        heads_equal = pallas["head"] == dense["head"]
+        if not heads_equal:
             # Greedy sampling: any drift is a correctness signal, not noise.
             print(f"[bench] WARNING: backend token mismatch "
-                  f"dense={dense_head} pallas={pallas_head}", file=sys.stderr)
-    else:
-        print(f"[bench] {cfg.name}: bf16 (~{2 * est_params / 1e9:.0f} GB) "
-              "exceeds HBM; int8 lane only", file=sys.stderr)
-        dense_tok_s = dense_chained = pallas_tok_s = pallas_chained = None
-        dense_head = pallas_head = None
-    # Weight-only int8 (models/quant.py): halves the HBM weight read that
-    # bounds decode. Tokens legitimately differ from bf16 (quantization),
-    # so no equality check — test_quant.py pins the error envelope.
-    (int8_tok_s, int8_chained, n_params_q, int8_weight_bytes, mean_ctx_q,
-     _) = run_backend("pallas", cfg, on_tpu, quant="int8")
-    if not bf16_fits:
-        n_params, mean_ctx = n_params_q, mean_ctx_q
-        weight_bytes = 2 * n_params
+                  f"dense={dense['head']} pallas={pallas['head']}",
+                  file=sys.stderr)
 
-    batch = 8
-    flops_per_token = 2 * n_params
-    kv_bytes_per_token = (2 * 2 * cfg.n_layers * mean_ctx
-                          * cfg.n_kv_heads * cfg.head_dim)  # K+V, bf16
-    peak_flops, peak_bw = CHIP_PEAKS.get(
-        jax.devices()[0].device_kind, (394e12, 819e9))
-
-    def util(tok_s, wbytes):
-        steps_per_s = tok_s / batch
-        bw = steps_per_s * (wbytes + batch * kv_bytes_per_token)
-        return (round(tok_s * flops_per_token / peak_flops, 4),
-                round(bw / peak_bw, 4))
-
-    best_bf16 = max(pallas_tok_s, pallas_chained) if bf16_fits else 0.0
-    best_int8 = max(int8_tok_s, int8_chained)
-    best = max(best_bf16, best_int8)
-    wbytes = int8_weight_bytes if best_int8 >= best_bf16 else weight_bytes
-    quant_tag = "int8" if best_int8 >= best_bf16 else "bf16"
-    chained_best = max([c for c in (pallas_chained, int8_chained)
-                        if c is not None])
-    sync_best = max([c for c in (pallas_tok_s, int8_tok_s)
-                     if c is not None])
-    mode = "dispatch-ahead" if chained_best >= sync_best else "sync"
-    mfu, hbm_util = util(best, wbytes)
-    mfu_bf16, hbm_util_bf16 = (util(best_bf16, weight_bytes)
-                               if bf16_fits else (None, None))
-    print(json.dumps({
+    skipped = {spec: rec.get("skipped") for spec, rec in lanes.items()
+               if rec and rec.get("skipped")}
+    rec = {
         # Name stays stable across rounds (BENCH_r{N}.json diffs by key);
         # the winning lane is reported in best_lane.
-        "metric": "decode_tok_s_llama1b_bs8_pallas",
+        "metric": METRIC,
         "best_lane": quant_tag,
-        "value": round(best, 2),
-        "unit": f"tokens/s (aggregate, batch=8, {mode})",
+        "value": _r(best),
+        "unit": f"tokens/s (aggregate, batch={BATCH}, {mode})",
         # Like-for-like: per-stream rate vs the reference's single-stream 93.
-        "vs_baseline": round(best / batch / BASELINE_TOK_S, 3),
-        "vs_baseline_aggregate": round(best / BASELINE_TOK_S, 3),
-        "per_stream_tok_s": round(best / batch, 2),
-        "model": cfg.name,
+        "vs_baseline": _ratio(best and best / BATCH, BASELINE_TOK_S),
+        "vs_baseline_aggregate": _ratio(best, BASELINE_TOK_S),
+        "per_stream_tok_s": _r(best and best / BATCH),
+        "model": (any_lane or {}).get("model") if any_lane else None,
         "sync_tok_s": _r(pallas_tok_s),
         "chained_tok_s": _r(pallas_chained),
         "dense_tok_s": _r(dense_tok_s),
         "dense_chained_tok_s": _r(dense_chained),
-        "int8_tok_s": round(int8_tok_s, 2),
-        "int8_chained_tok_s": round(int8_chained, 2),
+        "int8_tok_s": _r(int8_tok_s),
+        "int8_chained_tok_s": _r(int8_chained),
         # Mode-matched kernel comparisons (sync/sync and chained/chained).
         "pallas_speedup_vs_dense_sync": _ratio(pallas_tok_s, dense_tok_s),
         "pallas_speedup_vs_dense_chained": _ratio(pallas_chained,
                                                   dense_chained),
-        "int8_speedup_vs_bf16": _ratio(best_int8, best_bf16 or None),
+        "int8_speedup_vs_bf16": _ratio(best_int8 or None, best_bf16 or None),
         "mfu": mfu,
         "hbm_util": hbm_util,
-        "bf16_tok_s": _r(best_bf16) if bf16_fits else None,
+        "bf16_tok_s": _r(best_bf16 or None),
         "bf16_mfu": mfu_bf16,
         "bf16_hbm_util": hbm_util_bf16,
-        "weight_bytes_bf16": weight_bytes,
-        "weight_bytes_int8": int8_weight_bytes,
-        "mean_ctx": round(mean_ctx, 1),
-        "chip": jax.devices()[0].device_kind,
-        "platform": platform,
-        "backends_token_equal": (dense_head == pallas_head
-                                 if bf16_fits else None),
-    }))
+        "weight_bytes_bf16": pallas["weight_bytes"] if pallas else None,
+        "weight_bytes_int8": int8["weight_bytes"] if int8 else None,
+        "mean_ctx": _r((any_lane or {}).get("mean_ctx"), 1),
+        "chip": probe.get("device_kind"),
+        "platform": probe.get("platform"),
+        "backends_token_equal": heads_equal,
+        "partial": partial,
+        "wall_s": _r(time.perf_counter() - t_start, 1),
+    }
+    if degraded:
+        rec["degraded"] = degraded
+    if skipped:
+        rec["lanes_skipped"] = skipped
+    print(json.dumps(rec), flush=True)
 
 
-def _supervise() -> None:
-    """Watchdog: run the measurement in a CHILD process with a hard
-    timeout + retries. The TPU tunnel's failure mode is a HANG (a dead
-    relay blocks ``import jax`` inside the axon plugin registration), so
-    an in-process try/except can never fire — only killing the process
-    works."""
-    import subprocess
+def orchestrate() -> None:
+    t_start = time.perf_counter()
+    env = None
+    degraded = None
 
-    attempts = 3
-    for i in range(attempts):
-        try:
-            rc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                timeout=1200).returncode
-        except subprocess.TimeoutExpired:
-            rc = -1
-            print(f"[bench] attempt {i + 1} timed out (hung TPU tunnel?)",
-                  file=sys.stderr)
-        if rc == 0:
-            return
-        if i + 1 == attempts:
-            print(json.dumps({"metric": "decode_tok_s_llama1b_bs8_pallas",
-                              "value": None, "unit": "tokens/s",
-                              "vs_baseline": None,
-                              "error": f"all {attempts} attempts failed "
-                                       f"(last rc={rc})"}))
-            sys.exit(1)
-        wait = 20 * (i + 1)
-        print(f"[bench] attempt {i + 1} failed (rc={rc}); retrying in "
-              f"{wait}s", file=sys.stderr)
-        time.sleep(wait)
+    rc, probe = _run_child(["--probe"], PROBE_TIMEOUT_S)
+    if probe is None:
+        print("[bench] probe failed; retrying once in 15s", file=sys.stderr)
+        time.sleep(15)
+        rc, probe = _run_child(["--probe"], PROBE_TIMEOUT_S)
+    if probe is None:
+        print("[bench] TPU tunnel unreachable; falling back to CPU "
+              "(sitecustomize bypass) at test scale", file=sys.stderr)
+        env = _cpu_env()
+        degraded = "tpu-tunnel-wedged; CPU fallback at test scale"
+        rc, probe = _run_child(["--probe"], REPROBE_TIMEOUT_S, env)
+    if probe is None:
+        # Nothing can initialize: still emit a well-formed record.
+        print(json.dumps({"metric": METRIC, "value": None,
+                          "unit": "tokens/s", "vs_baseline": None,
+                          "skipped": "tpu-unavailable",
+                          "wall_s": _r(time.perf_counter() - t_start, 1)}),
+              flush=True)
+        return
+
+    on_tpu = probe["platform"] == "tpu"
+    print(f"[bench] platform={probe['platform']} "
+          f"chip={probe.get('device_kind')}", file=sys.stderr)
+    lane_timeout = LANE_TIMEOUT_S if on_tpu else 240
+    lanes = {}
+    give_up = False
+
+    def budget_left():
+        return TOTAL_BUDGET_S - (time.perf_counter() - t_start)
+
+    # Headline lane first so even the first snapshot carries the number
+    # the round is judged on.
+    for spec in ("pallas:none", "pallas:int8", "dense:none"):
+        if give_up:
+            lanes[spec] = {"lane": spec, "skipped": "tpu-wedged-midrun"}
+            continue
+        if budget_left() < lane_timeout:
+            lanes[spec] = {"lane": spec, "skipped": "budget-exhausted"}
+            continue
+        rc, rec = _run_child(["--lane", spec], lane_timeout, env)
+        if rec is None and on_tpu:
+            # Distinguish a dead tunnel (skip the rest) from a transient
+            # dial error (the lane deserves one retry).
+            _, p2 = _run_child(["--probe"], REPROBE_TIMEOUT_S)
+            if p2 is None:
+                print("[bench] tunnel lost mid-run; skipping remaining "
+                      "lanes", file=sys.stderr)
+                give_up = True
+            elif budget_left() >= lane_timeout:
+                print(f"[bench] retrying lane {spec} (tunnel healthy)",
+                      file=sys.stderr)
+                rc, rec = _run_child(["--lane", spec], lane_timeout, env)
+        if rec is None:
+            rec = {"lane": spec, "skipped": f"lane-failed rc={rc}"}
+        lanes[spec] = rec
+        _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
+    _snapshot(probe, lanes, degraded, partial=False, t_start=t_start)
 
 
 if __name__ == "__main__":
-    if "--child" in sys.argv:
-        try:
-            main()
-        except Exception:  # noqa: BLE001 — parent retries
-            traceback.print_exc()
-            sys.exit(2)
+    if "--probe" in sys.argv:
+        probe_child()
+    elif "--lane" in sys.argv:
+        lane_child(sys.argv[sys.argv.index("--lane") + 1])
     else:
-        _supervise()
+        orchestrate()
