@@ -306,7 +306,10 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
     quant_tag = None
     if any_lane and best:
         win = int8 if best_int8 >= best_bf16 and int8 else (pallas or dense)
-        quant_tag = "int8" if win is int8 else "bf16"
+        # "dense" marks the no-Pallas-lane fallback so BENCH_r{N}.json
+        # never attributes a dense-gather number to the Pallas kernel.
+        quant_tag = ("int8" if win is int8 else
+                     "bf16" if win is pallas else "dense")
         n_params = win["n_params"]
         kv_bpt = win["kv_bytes_per_token"]
         peak_flops, peak_bw = CHIP_PEAKS.get(
@@ -339,8 +342,9 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
     heads_equal = None
     if pallas and dense:
         heads_equal = pallas["head"] == dense["head"]
-        if not heads_equal:
-            # Greedy sampling: any drift is a correctness signal, not noise.
+        if not heads_equal and not partial:
+            # Greedy sampling: any drift is a correctness signal, not
+            # noise. Warn once (final snapshot), not per-snapshot.
             print(f"[bench] WARNING: backend token mismatch "
                   f"dense={dense['head']} pallas={pallas['head']}",
                   file=sys.stderr)
@@ -358,7 +362,7 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "vs_baseline": _ratio(best and best / BATCH, BASELINE_TOK_S),
         "vs_baseline_aggregate": _ratio(best, BASELINE_TOK_S),
         "per_stream_tok_s": _r(best and best / BATCH),
-        "model": (any_lane or {}).get("model") if any_lane else None,
+        "model": any_lane["model"] if any_lane else None,
         "sync_tok_s": _r(pallas_tok_s),
         "chained_tok_s": _r(pallas_chained),
         "dense_tok_s": _r(dense_tok_s),
@@ -377,7 +381,7 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "bf16_hbm_util": hbm_util_bf16,
         "weight_bytes_bf16": pallas["weight_bytes"] if pallas else None,
         "weight_bytes_int8": int8["weight_bytes"] if int8 else None,
-        "mean_ctx": _r((any_lane or {}).get("mean_ctx"), 1),
+        "mean_ctx": _r(any_lane.get("mean_ctx") if any_lane else None, 1),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
         "backends_token_equal": heads_equal,

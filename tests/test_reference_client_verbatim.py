@@ -1,0 +1,170 @@
+"""The reference's OWN client, byte-for-byte, drives this server unchanged.
+
+North-star compatibility claim (SURVEY §0/§2c): a user of the reference
+switches inference endpoints by editing only the module-level ``config``
+dict (reference: traffic_generator/main.py:302-313) — every class, the
+asyncio pipeline, the aiohttp TraceConfig hooks, and the log schema run
+as-is. That exercises the exact request shape the rewritten in-repo
+harness no longer sends: top-level ``max_tokens``/``temperature`` with
+no ``options`` object (reference: traffic_generator/main.py:241-247).
+
+``tests/fixtures/reference_client_verbatim.py`` is an exact byte copy of
+the reference client, vendored (see fixtures/README.md) so this claim is
+executable; ``test_fixture_is_verbatim`` pins it against the reference
+tree when present.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib.util
+import json
+import os
+import socket
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "reference_client_verbatim.py")
+REFERENCE = "/root/reference/traffic_generator/main.py"
+
+
+def test_fixture_is_verbatim():
+    """The vendored client must stay byte-identical to the reference."""
+    if not os.path.exists(REFERENCE):
+        pytest.skip("reference tree not present")
+    with open(FIXTURE, "rb") as f, open(REFERENCE, "rb") as g:
+        assert f.read() == g.read(), (
+            "fixtures/reference_client_verbatim.py has drifted from the "
+            "reference client; re-vendor it byte-for-byte")
+
+
+def _import_reference_client():
+    """Import the verbatim client as a module (``__name__`` !=
+    "__main__", so only the classes + module ``config`` are defined —
+    the driver block at its line 315 stays ours to invoke)."""
+    spec = importlib.util.spec_from_file_location(
+        "reference_client_verbatim", FIXTURE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _start_server():
+    """Boot the real HTTP server on a background event loop (the
+    verbatim client owns the main thread via ``asyncio.run``); mirrors
+    benchmarks/replay.py:start_server at test scale."""
+    from aiohttp import web
+
+    from tpu_inference.server.http import build_server
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    # Sized for trace1.csv's first rows: prompts clamp to the client's
+    # MAX_PROMPT_LEN=1024 byte-tokens + config max_tokens=200 decode.
+    srv = build_server(model="tiny-llama", tokenizer="byte", warmup=False,
+                       page_size=16, num_pages=448, max_pages_per_seq=128,
+                       max_batch_size=4, prefill_buckets=(256, 1024))
+    loop = asyncio.new_event_loop()
+    ready = threading.Event()
+    boot_err: list = []
+
+    def run():
+        asyncio.set_event_loop(loop)
+        try:
+            runner = web.AppRunner(srv.make_app())
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            loop.run_until_complete(site.start())
+        except BaseException as e:
+            boot_err.append(e)
+            ready.set()
+            return
+        ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, name="verbatim-server", daemon=True)
+    t.start()
+    assert ready.wait(timeout=120), "server failed to start"
+    if boot_err:
+        raise boot_err[0]
+
+    def stop():
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=30)
+
+    return port, stop
+
+
+# The per-request field set the reference writes to logs/log.json
+# (reference: traffic_generator/main.py:274-289 — number_of_input_tokens
+# at issue time, the TraceConfig hook at 206, the tail fields at 274-277).
+REFERENCE_LOG_FIELDS = {
+    "number_of_input_tokens",
+    "request_start_time",
+    "response_headers_received_time",
+    "first_token_arrive_time",
+    "response_end_time",
+    "scheduled_start_time",
+    "success",
+}
+
+N_TRACE = 6
+
+
+def test_verbatim_reference_client_replays_unchanged(tmp_path):
+    mod = _import_reference_client()
+    port, stop = _start_server()
+    log_path = tmp_path / "log.json"
+    try:
+        # The ONLY permitted change: retarget the module-level config
+        # dict (url was a hardcoded LAN address, reference main.py:306).
+        mod.config.update({
+            "trace_path": os.path.join(REPO, "data", "trace1.csv"),
+            "data_path": os.path.join(REPO, "data", "conversations.json"),
+            "max_trace": N_TRACE,
+            "url": f"http://127.0.0.1:{port}/api/generate",
+            "model": "tiny-llama",
+            "save_log": True,
+            "log_path": str(log_path),
+        })
+
+        # Statement-for-statement, the client's own __main__ block
+        # (reference main.py:315-343, commented-out lines elided).
+        data = mod.DataLoader().get_data_from_path(
+            data_path=mod.config["data_path"])
+        schedule = mod.Scheduler().get_schedule_from_trace(
+            trace_path=mod.config["trace_path"],
+            max_trace=mod.config["max_trace"])
+        logger = mod.MetricCollector()
+        # Running as __main__ would bind ``logger`` as a module global
+        # (its exception tracer at line 220 reads it that way).
+        mod.logger = logger
+        generator = mod.TrafficGenerator(data=data, schedule=schedule,
+                                         config=mod.config, logger=logger)
+        generator.start_profile()
+        logger.save(path=mod.config["log_path"])
+    finally:
+        stop()
+
+    # The artifact the reference ships (logs/log.json): int query ids
+    # serialize as string keys, one record per trace row.
+    saved = json.loads(log_path.read_text())
+    assert set(saved) == {str(i) for i in range(N_TRACE)}
+    for qid, rec in saved.items():
+        assert set(rec) == REFERENCE_LOG_FIELDS, (
+            f"query {qid}: log schema mismatch: {sorted(rec)}")
+        assert rec["success"] is True, f"query {qid} failed"
+        # Causal ordering, and the deferred-header TTFT contract: the
+        # server releases headers with the first token, never before
+        # the request was sent.
+        assert (rec["scheduled_start_time"] <= rec["request_start_time"]
+                <= rec["response_headers_received_time"]
+                <= rec["first_token_arrive_time"]
+                <= rec["response_end_time"])
+        assert rec["number_of_input_tokens"] > 0
