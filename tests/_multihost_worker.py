@@ -1,0 +1,56 @@
+"""Worker process for tests/test_multihost_2proc.py — NOT a pytest file.
+
+Each of the two worker processes joins the jax.distributed runtime via
+``multihost.initialize`` (the rendezvous path under test), builds the
+hybrid ICI/DCN mesh over the 4 global CPU devices (2 local to each
+process), and runs a real cross-process psum through it. Prints one JSON
+line with what this process observed; the parent test asserts on it.
+"""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_inference.config import ParallelConfig
+from tpu_inference.parallel import multihost
+
+
+def main() -> None:
+    coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    multihost.initialize(coordinator_address=coord, num_processes=nproc,
+                         process_id=pid)
+    # Idempotency: a second call must be a no-op, not a crash.
+    multihost.initialize(coordinator_address=coord, num_processes=nproc,
+                         process_id=pid)
+    assert jax.process_count() == nproc
+    assert len(jax.devices()) == 2 * nproc
+
+    # dp spans the two processes (the DCN-like boundary), tp stays within
+    # a process — the serving layout build_hybrid_mesh exists for.
+    pcfg = ParallelConfig(dp=2, tp=2, sp=1)
+    mesh = multihost.build_hybrid_mesh(pcfg, num_slices=2)
+    role = multihost.process_local_engine_role(mesh)
+
+    # Cross-process collective through the mesh: every element is 1, so
+    # the full psum must see all 16 — impossible without real
+    # inter-process reduction over the dp axis.
+    sh = NamedSharding(mesh, P("dp", "tp"))
+    x = jax.make_array_from_callback(
+        (4, 4), sh, lambda idx: np.ones((2, 2), np.float32))
+    f = jax.jit(jax.shard_map(
+        lambda a: jax.lax.psum(jnp.sum(a), ("dp", "tp")),
+        mesh=mesh, in_specs=P("dp", "tp"), out_specs=P()))
+    psum = float(f(x))
+
+    print(json.dumps({"pid": pid, "process_count": jax.process_count(),
+                      "global_devices": len(jax.devices()),
+                      "mesh_shape": dict(mesh.shape), "psum": psum,
+                      "role": role}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,6 @@ import asyncio
 import importlib.util
 import json
 import os
-import socket
 import threading
 
 import pytest
@@ -60,27 +59,30 @@ def _start_server():
 
     from tpu_inference.server.http import build_server
 
-    sock = socket.socket()
-    sock.bind(("127.0.0.1", 0))
-    port = sock.getsockname()[1]
-    sock.close()
-
     # Sized for trace1.csv's first rows: prompts clamp to the client's
     # MAX_PROMPT_LEN=1024 byte-tokens + config max_tokens=200 decode.
+    # warmup=False keeps the test fast; the committed artifact
+    # (benchmarks/results/config0_verbatim_reference_client.json) records
+    # a warmup=True run of this same path, so its TTFTs measure serving,
+    # not XLA compiles.
     srv = build_server(model="tiny-llama", tokenizer="byte", warmup=False,
                        page_size=16, num_pages=448, max_pages_per_seq=128,
                        max_batch_size=4, prefill_buckets=(256, 1024))
     loop = asyncio.new_event_loop()
     ready = threading.Event()
     boot_err: list = []
+    state: dict = {}
 
     def run():
         asyncio.set_event_loop(loop)
         try:
             runner = web.AppRunner(srv.make_app())
             loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, "127.0.0.1", port)
+            # Port 0 (race-free pick, same as tests/test_harness.py).
+            site = web.TCPSite(runner, "127.0.0.1", 0)
             loop.run_until_complete(site.start())
+            state["runner"] = runner
+            state["port"] = site._server.sockets[0].getsockname()[1]
         except BaseException as e:
             boot_err.append(e)
             ready.set()
@@ -95,10 +97,13 @@ def _start_server():
         raise boot_err[0]
 
     def stop():
+        # Release the socket + engine before the rest of the session.
+        asyncio.run_coroutine_threadsafe(
+            state["runner"].cleanup(), loop).result(timeout=30)
         loop.call_soon_threadsafe(loop.stop)
         t.join(timeout=30)
 
-    return port, stop
+    return state["port"], stop
 
 
 # The per-request field set the reference writes to logs/log.json
