@@ -73,19 +73,14 @@ LANE_TIMEOUT_S = 280
 REPROBE_TIMEOUT_S = 60
 TOTAL_BUDGET_S = 780  # no lane launches that can't finish inside this
 
-# Per-chip peaks for utilization reporting (bf16 FLOP/s, HBM bytes/s)
-# and HBM capacity (bytes) for fits-on-chip gating.
+# Per-chip peaks for utilization reporting (bf16 FLOP/s, HBM bytes/s).
+# HBM capacities live in tpu_inference/engine/autosize.py (the canonical
+# table); the lane child imports it for its fits-on-chip gate.
 CHIP_PEAKS = {
     "TPU v5 lite": (394e12, 819e9),
     "TPU v4": (275e12, 1228e9),
     "TPU v5p": (459e12, 2765e9),
     "TPU v6 lite": (918e12, 1640e9),
-}
-CHIP_HBM_BYTES = {
-    "TPU v5 lite": 16e9,
-    "TPU v4": 32e9,
-    "TPU v5p": 95e9,
-    "TPU v6 lite": 32e9,
 }
 
 
@@ -124,13 +119,6 @@ def bench_cfg(platform: str):
     )
 
 
-def _est_params(cfg) -> int:
-    d, f, L, V = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
-    kv_w = cfg.n_kv_heads * cfg.head_dim
-    return (V * d * (1 if cfg.tie_embeddings else 2)
-            + L * (2 * d * d + 2 * d * kv_w + 3 * d * f))
-
-
 def probe_child() -> None:
     import jax
 
@@ -157,9 +145,14 @@ def lane_child(spec: str) -> None:
     if quant != "int8" and on_tpu:
         # bf16 lanes need weights + KV pool + activations headroom inside
         # the chip's HBM, gated at 0.85 * capacity to leave room for the
-        # runtime's own reservations.
-        hbm = CHIP_HBM_BYTES.get(jax.devices()[0].device_kind, 16e9)
-        if 2 * _est_params(cfg) >= 0.85 * hbm:
+        # runtime's own reservations (tables/estimator: autosize.py).
+        from tpu_inference.engine.autosize import (HBM_BY_DEVICE_KIND,
+                                                   DEFAULT_HBM_BYTES,
+                                                   weight_bytes)
+
+        hbm = HBM_BY_DEVICE_KIND.get(jax.devices()[0].device_kind,
+                                     DEFAULT_HBM_BYTES)
+        if weight_bytes(cfg) >= 0.85 * hbm:
             print(json.dumps({"lane": spec, "skipped": "bf16-exceeds-hbm",
                               "model": cfg.name}), flush=True)
             return

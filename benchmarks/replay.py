@@ -151,8 +151,18 @@ def main() -> dict:
     p.add_argument("--trace", default="data/trace1.csv")
     p.add_argument("--data", default="data/conversations.json")
     p.add_argument("--max-trace", type=int, default=100)
-    p.add_argument("--max-batch-size", type=int, default=8)
-    p.add_argument("--num-pages", type=int, default=512)
+    from tpu_inference.engine.autosize import int_or_auto
+
+    p.add_argument("--max-batch-size", type=int_or_auto, default=8,
+                   help="decode slots, or 'auto' (size from chip HBM — "
+                        "engine/autosize.py)")
+    p.add_argument("--num-pages", type=int_or_auto, default=512,
+                   help="KV pool pages, or 'auto'")
+    p.add_argument("--target-ctx", type=int, default=0,
+                   help="auto sizing: expected typical context per "
+                        "sequence (0 = half the per-sequence max)")
+    p.add_argument("--batch-cap", type=int, default=32,
+                   help="upper bound for --max-batch-size auto")
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-pages-per-seq", type=int, default=64)
     p.add_argument("--decode-steps-per-call", type=int, default=8)
@@ -177,6 +187,10 @@ def main() -> dict:
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu":
             jax.config.update("jax_num_cpu_devices", max(1, args.tp))
+
+    from tpu_inference.engine.autosize import resolve_sizing_args
+
+    args.max_batch_size, args.num_pages = resolve_sizing_args(args)
 
     from traffic_generator.data import DataLoader
     from traffic_generator.generator import TrafficGenerator

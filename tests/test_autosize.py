@@ -1,0 +1,86 @@
+"""HBM-aware auto-sizing (engine/autosize.py) — pure arithmetic, no
+devices needed. Pins the sizing decisions VERDICT r3 asked for: a 1B
+model on a 16 GB chip must serve well above batch 8, an 8B bf16 model
+must refuse to pretend it fits, and int8 levers must buy the expected
+capacity."""
+
+import jax.numpy as jnp
+import pytest
+
+from tpu_inference.config import ModelConfig, tiny_llama, tiny_mixtral
+from tpu_inference.engine import autosize
+
+
+def llama_1b():
+    return ModelConfig(name="llama-1b", family="llama", vocab_size=32000,
+                       d_model=2048, n_layers=22, n_heads=32, n_kv_heads=4,
+                       d_ff=5632, max_seq_len=2048, dtype=jnp.bfloat16)
+
+
+def llama_8b():
+    return ModelConfig(name="llama-8b", family="llama", vocab_size=128256,
+                       d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+                       d_ff=14336, max_seq_len=8192, dtype=jnp.bfloat16)
+
+
+def test_param_estimate_close_to_known_sizes():
+    # TinyLlama-1.1B and Llama-3-8B: estimates within 10% of the names.
+    assert 0.9e9 < autosize.estimate_param_count(llama_1b()) < 1.3e9
+    assert 7e9 < autosize.estimate_param_count(llama_8b()) < 9e9
+
+
+def test_moe_params_count_all_experts():
+    dense = autosize.estimate_param_count(tiny_llama())
+    moe = autosize.estimate_param_count(tiny_mixtral())
+    assert moe > dense * 1.5  # 4 experts' FFNs vs 1
+
+
+def test_1b_on_v5e_serves_well_above_batch_8():
+    sz = autosize.auto_size(llama_1b(), hbm_bytes=16e9)
+    assert sz.max_batch_size >= 16        # the VERDICT r3 complaint
+    assert sz.max_batch_size <= 32        # default cap
+    assert sz.num_pages > 512             # pool sized by HBM, not default
+    # Residents actually fit inside the stated budget.
+    assert (sz.weight_bytes_per_chip + sz.kv_pool_bytes_per_chip
+            < 0.85 * 16e9)
+
+
+def test_8b_bf16_refuses_single_v5e():
+    with pytest.raises(ValueError, match="int8"):
+        autosize.auto_size(llama_8b(), hbm_bytes=16e9)
+
+
+def test_8b_int8_fits_single_v5e():
+    sz = autosize.auto_size(llama_8b(), hbm_bytes=16e9, quant="int8",
+                            kv_quant="int8")
+    assert sz.max_batch_size >= 8
+    assert (sz.weight_bytes_per_chip + sz.kv_pool_bytes_per_chip
+            < 0.85 * 16e9)
+
+
+def test_8b_bf16_fits_with_tp4():
+    sz = autosize.auto_size(llama_8b(), hbm_bytes=16e9, tp=4)
+    assert sz.max_batch_size >= 8
+
+
+def test_int8_kv_roughly_doubles_pool_tokens():
+    a = autosize.auto_size(llama_8b(), hbm_bytes=16e9, quant="int8")
+    b = autosize.auto_size(llama_8b(), hbm_bytes=16e9, quant="int8",
+                           kv_quant="int8")
+    assert b.num_pages > 1.8 * a.num_pages
+
+
+def test_pool_floor_one_full_sequence():
+    # A budget too small for even one max-length sequence must raise,
+    # not deadlock admission later.
+    with pytest.raises(ValueError, match="pages"):
+        autosize.auto_size(llama_1b(), hbm_bytes=3.5e9,
+                           max_pages_per_seq=4096)
+
+
+def test_target_ctx_shapes_batch():
+    wide = autosize.auto_size(llama_1b(), hbm_bytes=16e9, batch_cap=512,
+                              target_ctx=512)
+    narrow = autosize.auto_size(llama_1b(), hbm_bytes=16e9, batch_cap=512,
+                                target_ctx=2048)
+    assert wide.max_batch_size > narrow.max_batch_size

@@ -458,3 +458,58 @@ def test_context_continuation_hits_prefix_cache(server):
         assert after > before
 
     _run(server, go)
+
+
+def test_sampling_warnings_surface(server):
+    """Options accepted but not honored exactly are reported in a
+    terminal-record ``warnings`` list (ADVICE r3): repeat_last_n beyond
+    the static penalty window is clamped — the client learns instead of
+    silently getting different sampling. Honored options add no field."""
+    async def go(client):
+        rec = await (await client.post("/api/generate", json={
+            "prompt": "hi", "stream": False, "max_tokens": 4,
+            "temperature": 0.0,
+            "options": {"repeat_penalty": 1.1, "repeat_last_n": 512}})).json()
+        assert rec["done"]
+        assert any("repeat_last_n" in w and "clamped" in w
+                   for w in rec["warnings"])
+
+        clean = await (await client.post("/api/generate", json={
+            "prompt": "hi", "stream": False, "max_tokens": 4,
+            "temperature": 0.0,
+            "options": {"repeat_penalty": 1.1, "repeat_last_n": 32}})).json()
+        assert "warnings" not in clean
+
+    _run(server, go)
+
+
+def test_context_ids_validate_against_model_vocab(server):
+    """An id the model cannot embed must 400 — the XLA gather would
+    clamp it silently into a wrong embedding (ADVICE r3). tiny-llama
+    model vocab is 512; the byte tokenizer's is smaller."""
+    async def go(client):
+        resp = await client.post("/api/generate", json={
+            "prompt": "hi", "stream": False, "max_tokens": 2,
+            "temperature": 0.0, "context": [0, 511]})
+        assert resp.status == 200
+        resp = await client.post("/api/generate", json={
+            "prompt": "hi", "stream": False, "max_tokens": 2,
+            "temperature": 0.0, "context": [512]})
+        assert resp.status == 400
+        assert "out of range" in (await resp.json())["error"]
+
+    _run(server, go)
+
+
+def test_boot_rejects_tokenizer_model_vocab_mismatch():
+    """A tokenizer that can emit ids the model cannot embed must fail at
+    boot (one loud error), not clamp embeddings one request at a time:
+    the byte tokenizer needs 258 ids, so a 200-entry model vocab is a
+    broken deployment."""
+    cfg = FrameworkConfig(
+        model=tiny_llama(vocab_size=200),
+        engine=EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
+                            max_batch_size=2, prefill_buckets=(16,)),
+        server=ServerConfig(tokenizer="byte"))
+    with pytest.raises(ValueError, match="tokenizer vocab"):
+        InferenceServer(cfg)

@@ -521,10 +521,12 @@ class InferenceEngine:
             self.kv, self.draft_kv = out.kv, out.draft_kv
         else:
             decodes = [self._decode_multi_jit]
-            if (ecfg.latency_decode_threshold > 0
-                    and self._decode_one_jit is not self._decode_multi_jit):
-                # The 1-step graph is a second full decode compile; pay
-                # it only when latency mode can actually route to it.
+            if self._decode_one_jit is not self._decode_multi_jit:
+                # The 1-step graph is a second full decode compile, but
+                # decode_step()/decode_steps(max_steps=1) route to it
+                # regardless of latency mode — warm it whenever it's a
+                # distinct graph or a first single-step call pays a full
+                # XLA compile mid-serving (ADVICE r3).
                 decodes.append(self._decode_one_jit)
             for decode in decodes:
                 self.kv, _, _, _ = decode(

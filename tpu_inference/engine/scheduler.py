@@ -59,13 +59,19 @@ class SchedulerStats:
             seconds
         self.decode_calls += 1
 
-    def _decode_call_percentiles(self) -> Optional[Dict]:
+    def _decode_call_percentiles(self, pipelined: bool) -> Optional[Dict]:
         n = min(self.decode_calls, len(self.decode_call_s))
         if n == 0:
             return None
         xs = sorted(self.decode_call_s[:n])
         pick = lambda p: xs[min(n - 1, int(p * n))]  # noqa: E731
-        return {"p50": round(pick(0.50), 6), "p99": round(pick(0.99), 6)}
+        return {"p50": round(pick(0.50), 6), "p99": round(pick(0.99), 6),
+                # With pipeline depth > 1 decode_steps_pipelined returns
+                # after a NON-blocking dispatch, so these percentiles
+                # measure host dispatch overhead, not decode wall time —
+                # label the semantics so operators don't compare across
+                # modes (ADVICE r3).
+                "measures": "dispatch" if pipelined else "call"}
 
     def snapshot(self, engine: InferenceEngine) -> Dict:
         occ = (self.batch_occupancy_sum / self.steps) if self.steps else 0.0
@@ -89,7 +95,8 @@ class SchedulerStats:
             "quant": engine.engine_cfg.quant,
             "kv_quant": engine.engine_cfg.kv_quant,
             "decode_pipeline_depth": engine.engine_cfg.decode_pipeline_depth,
-            "decode_call_s": self._decode_call_percentiles(),
+            "decode_call_s": self._decode_call_percentiles(
+                engine.engine_cfg.decode_pipeline_depth > 1),
         }
         if engine.prefix_cache is not None:
             out["prefix_cache"] = engine.prefix_cache.stats()

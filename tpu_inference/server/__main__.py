@@ -28,8 +28,21 @@ def main() -> None:
                    help="HF safetensors directory (random init if omitted)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=11434)
-    p.add_argument("--max-batch-size", type=int, default=8)
-    p.add_argument("--num-pages", type=int, default=512)
+    from tpu_inference.engine.autosize import int_or_auto
+
+    p.add_argument("--max-batch-size", type=int_or_auto, default=8,
+                   help="decode slots in the batched graph, or 'auto': "
+                        "size from the chip's HBM after weights "
+                        "(engine/autosize.py)")
+    p.add_argument("--num-pages", type=int_or_auto, default=512,
+                   help="KV pool pages, or 'auto': fill the HBM left "
+                        "after weights + activation headroom")
+    p.add_argument("--target-ctx", type=int, default=0,
+                   help="with auto sizing: expected typical context "
+                        "tokens per sequence (0 = half the per-sequence "
+                        "max); batch = KV tokens / this, capped")
+    p.add_argument("--batch-cap", type=int, default=32,
+                   help="upper bound for --max-batch-size auto")
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-pages-per-seq", type=int, default=64,
                    help="max context = page-size * this")
@@ -106,6 +119,10 @@ def main() -> None:
 
         jax.config.update("jax_debug_nans", True)
 
+    from tpu_inference.engine.autosize import resolve_sizing_args
+
+    max_batch_size, num_pages = resolve_sizing_args(args)
+
     from tpu_inference.server.http import build_server
 
     server = build_server(model=args.model, tokenizer=args.tokenizer,
@@ -117,8 +134,8 @@ def main() -> None:
                           enable_debug=args.debug,
                           attn_backend=args.attn_backend,
                           quant=args.quant, kv_quant=args.kv_quant,
-                          max_batch_size=args.max_batch_size,
-                          num_pages=args.num_pages, page_size=args.page_size,
+                          max_batch_size=max_batch_size,
+                          num_pages=num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
                           decode_pipeline_depth=args.decode_pipeline_depth,
                           num_speculative_tokens=(

@@ -21,6 +21,13 @@ generator must run unchanged against this server):
 Also serves ``GET /api/tags``, ``/api/version``, ``/healthz``, and
 ``/metrics`` (scheduler counters: batch occupancy, KV-page utilization —
 SURVEY.md §5 observability).
+
+Documented sampling divergences from Ollama: ``repeat_penalty`` defaults
+to 1.0 (off), not Ollama's 1.1 — send ``options.repeat_penalty`` for
+parity. Options accepted but not honored exactly (``repeat_last_n``
+beyond the static penalty window; ``repeat_penalty`` under speculative
+decoding, where rejection sampling needs the unmodified target
+distribution) are reported in a ``warnings`` list on the terminal record.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ from aiohttp import web
 
 from tpu_inference.config import FrameworkConfig, PRESETS
 from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.sampling import PENALTY_WINDOW
 from tpu_inference.server.tokenizer import (IncrementalDecoder, StopMatcher,
                                             build_tokenizer)
 
@@ -107,6 +115,19 @@ class InferenceServer:
         self.engine = group.engine            # primary replica (tests/bench)
         self.tokenizer = build_tokenizer(cfg.server.tokenizer,
                                          vocab_size=cfg.model.vocab_size)
+        if self.tokenizer.vocab_size > cfg.model.vocab_size:
+            # A tokenizer that can emit ids the model cannot embed is a
+            # broken deployment: the XLA gather would clamp those ids
+            # silently on the prompt path, and request validation
+            # (context ids < model vocab) would reject the server's own
+            # context arrays. Fail loudly at boot, not one wrong
+            # embedding at a time.
+            raise ValueError(
+                f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
+                f"model vocab ({cfg.model.vocab_size}): prompts could "
+                "encode to ids the model cannot embed; use the "
+                "checkpoint's own tokenizer or a model with a matching "
+                "embedding table")
         self.load_duration_ns = (load_duration_ns if load_duration_ns
                                  is not None else
                                  int((time.perf_counter() - t0) * 1e9))
@@ -389,10 +410,28 @@ class InferenceServer:
             top_k = int(top_k) if top_k is not None else None
             seed = opts.get("seed", body.get("seed"))
             seed = int(seed) if seed is not None else None
+            # Documented divergence from Ollama: repeat_penalty defaults
+            # to 1.0 (off) here, not Ollama's 1.1 — an inference engine
+            # shouldn't silently reshape the model's distribution; send
+            # options.repeat_penalty=1.1 for bug-for-bug parity. Requests
+            # whose penalty options can't be honored exactly get a
+            # "warnings" field in the terminal record (ADVICE r3).
+            warnings: list = []
             repeat_penalty = float(opts.get("repeat_penalty", 1.0))
             if repeat_penalty <= 0:
                 raise ValueError("'repeat_penalty' must be > 0")
             repeat_last_n = int(opts.get("repeat_last_n", 64))
+            if repeat_penalty != 1.0:
+                # With the penalty off, clamping/ignoring its window is
+                # a no-op — warn only when sampling actually diverges.
+                if repeat_last_n > PENALTY_WINDOW:
+                    warnings.append(
+                        f"repeat_last_n={repeat_last_n} clamped to the "
+                        f"static penalty window {PENALTY_WINDOW}")
+                if self.engine.spec_enabled:
+                    warnings.append(
+                        "repeat_penalty ignored: speculative decoding "
+                        "samples from the unmodified target distribution")
             stop = opts.get("stop", body.get("stop"))
             if stop is None:
                 stop = []
@@ -426,10 +465,13 @@ class InferenceServer:
                 raise web.HTTPBadRequest(text=json.dumps(
                     {"error": "'context' must be a list of token ids"}),
                     content_type="application/json")
-            # Validate against the TOKENIZER vocab (what the server itself
-            # emits in context arrays); it can exceed the model vocab.
-            vocab = max(self.tokenizer.vocab_size,
-                        self.cfg.model.vocab_size)
+            # Validate against the MODEL vocab: the XLA embedding gather
+            # clamps out-of-range ids silently, so an id the model can't
+            # embed must 400 here, not "work" with a wrong embedding
+            # (ADVICE r3). The server's own context arrays only contain
+            # ids the model produced or the tokenizer encoded, both
+            # < model vocab in a consistent deployment.
+            vocab = self.cfg.model.vocab_size
             if any(t >= vocab for t in ctx_ids):
                 raise web.HTTPBadRequest(text=json.dumps(
                     {"error": f"'context' token id out of range "
@@ -463,9 +505,9 @@ class InferenceServer:
             if stream:
                 return await self._stream_response(request, queue, seq,
                                                    model_name, recv_t, chat,
-                                                   stop)
+                                                   stop, warnings)
             return await self._unary_response(request, queue, seq, model_name,
-                                              recv_t, chat, stop)
+                                              recv_t, chat, stop, warnings)
         except asyncio.TimeoutError:
             # Request exceeded request_timeout_s: free the slot and pages.
             self.group.cancel(rid)
@@ -487,7 +529,8 @@ class InferenceServer:
         return line
 
     def _final_record(self, seq: Sequence, model_name: str,
-                      recv_t: float, chat: bool = False) -> dict:
+                      recv_t: float, chat: bool = False,
+                      warnings: Optional[list] = None) -> dict:
         now = time.perf_counter()
         prompt_eval_ns = max(0, int((seq.first_token_time - seq.prefill_start)
                                     * 1e9)) if seq.first_token_time else 0
@@ -507,6 +550,10 @@ class InferenceServer:
             "eval_count": len(seq.generated),
             "eval_duration": eval_ns,
         }
+        if warnings:
+            # Options accepted but not honored exactly (clamped/ignored);
+            # additive field, absent when everything applied as sent.
+            rec["warnings"] = list(warnings)
         if chat:
             # Ollama chat records use `message` and omit `context`.
             del rec["response"], rec["context"]
@@ -516,7 +563,8 @@ class InferenceServer:
     async def _stream_response(self, request: web.Request, queue: asyncio.Queue,
                                seq: Sequence, model_name: str,
                                recv_t: float, chat: bool = False,
-                               stop: Optional[list] = None
+                               stop: Optional[list] = None,
+                               warnings: Optional[list] = None
                                ) -> web.StreamResponse:
         resp = web.StreamResponse(status=200, headers={
             "Content-Type": "application/x-ndjson"})
@@ -533,7 +581,8 @@ class InferenceServer:
                 model_name, text, chat)).encode() + b"\n")
 
         async def finish(stopped: bool) -> web.StreamResponse:
-            final = self._final_record(seq, model_name, recv_t, chat)
+            final = self._final_record(seq, model_name, recv_t, chat,
+                                       warnings)
             if stopped:
                 # The engine thread may still be appending to
                 # seq.generated until the cancel lands; report only what
@@ -579,7 +628,8 @@ class InferenceServer:
     async def _unary_response(self, request: web.Request, queue: asyncio.Queue,
                               seq: Sequence, model_name: str,
                               recv_t: float, chat: bool = False,
-                              stop: Optional[list] = None
+                              stop: Optional[list] = None,
+                              warnings: Optional[list] = None
                               ) -> web.Response:
         decoder = IncrementalDecoder(self.tokenizer,
                                      prompt_tail=seq.prompt_tokens[-8:])
@@ -589,7 +639,8 @@ class InferenceServer:
         timeout = self.cfg.server.request_timeout_s
 
         def respond(payload, stopped: bool) -> web.Response:
-            final = self._final_record(payload, model_name, recv_t, chat)
+            final = self._final_record(payload, model_name, recv_t, chat,
+                                       warnings)
             if stopped:
                 # Snapshot only handler-consumed tokens (the engine thread
                 # may append more before the cancel lands).
@@ -639,20 +690,10 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
 
     from tpu_inference.config import EngineConfig, ParallelConfig, ServerConfig
 
-    def resolve(name, ckpt):
-        """(model_cfg, checkpoint_path) from a preset name or HF dir."""
-        if name in PRESETS:
-            return PRESETS[name](), ckpt
-        from tpu_inference.models import weights
-
-        src = ckpt if (name == "auto" and ckpt) else name
-        if not (isinstance(src, str)
-                and os.path.exists(os.path.join(src, "config.json"))):
-            raise ValueError(
-                f"unknown model {name!r}: not a preset "
-                f"({', '.join(sorted(PRESETS))}) and not a HF checkpoint "
-                f"directory with a config.json")
-        return weights.config_from_hf(src), (ckpt or src)
+    # Single model-resolution rule, shared with the pre-boot auto-sizing
+    # path so the model that gets sized is the model that boots.
+    from tpu_inference.engine.autosize import resolve_model_and_checkpoint
+    resolve = resolve_model_and_checkpoint
 
     model_cfg, checkpoint = resolve(model, checkpoint)
     if tokenizer == "auto":
