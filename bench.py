@@ -146,13 +146,10 @@ def lane_child(spec: str) -> None:
         # bf16 lanes need weights + KV pool + activations headroom inside
         # the chip's HBM, gated at 0.85 * capacity to leave room for the
         # runtime's own reservations (tables/estimator: autosize.py).
-        from tpu_inference.engine.autosize import (HBM_BY_DEVICE_KIND,
-                                                   DEFAULT_HBM_BYTES,
+        from tpu_inference.engine.autosize import (detect_hbm_bytes,
                                                    weight_bytes)
 
-        hbm = HBM_BY_DEVICE_KIND.get(jax.devices()[0].device_kind,
-                                     DEFAULT_HBM_BYTES)
-        if weight_bytes(cfg) >= 0.85 * hbm:
+        if weight_bytes(cfg) >= 0.85 * detect_hbm_bytes():
             print(json.dumps({"lane": spec, "skipped": "bf16-exceeds-hbm",
                               "model": cfg.name}), flush=True)
             return

@@ -35,8 +35,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-# Per-chip HBM capacities (bytes). Canonical table; bench.py mirrors the
-# values for its fits-on-chip gate.
+# Per-chip HBM capacities (bytes). The canonical table — bench.py's
+# fits-on-chip gate imports it via detect_hbm_bytes().
 HBM_BY_DEVICE_KIND = {
     "TPU v5 lite": 16e9,
     "TPU v4": 32e9,

@@ -243,10 +243,19 @@ class InferenceEngine:
             return quantize_params(p, engine_cfg.quant)
 
         if params is None:
-            params, _ = build_model(model_cfg, seed=seed)
+            if engine_cfg.quant != "none":
+                # Leaf-by-leaf init+quantize: peak device memory stays
+                # ~quantized-model-sized (8B random-init int8 fits one
+                # 16 GB chip; init-everything-then-quantize would OOM
+                # at the full-precision peak).
+                from tpu_inference.models.quant import init_quantized_params
+                params = init_quantized_params(model_cfg, seed,
+                                               engine_cfg.quant)
+            else:
+                params, _ = build_model(model_cfg, seed=seed)
         if shard_fn is not None:
             params = shard_fn(params)
-        params = maybe_quantize(params)
+        params = maybe_quantize(params)  # no-op on already-quantized leaves
         self.mesh = mesh
         kv_sh = kv_scale_sh = None
         if mesh is not None:

@@ -136,3 +136,55 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
         return leaf
 
     return jax.tree_util.tree_map_with_path(maybe_quant, params)
+
+
+def init_quantized_params(model_cfg, seed: int = 0,
+                          mode: str = "int8") -> dict:
+    """Random init + quantize ONE LEAF AT A TIME.
+
+    ``build_model`` then ``quantize_params`` peaks at the full
+    model-dtype tree plus the quantized copy — an 8B-dims engine would
+    OOM a 16 GB chip it comfortably serves int8. Here each QUANT_KEYS
+    leaf is initialized and quantized inside a single jit (XLA frees the
+    full-precision intermediate on exit), so peak device memory is
+    ~quantized-model-sized plus one full-precision leaf.
+
+    Leaf VALUES differ from build_model's (independent per-leaf keys);
+    random-init weights carry no meaning, so only shapes, dtypes, and
+    determinism-per-seed matter. Norm-scale leaves are ones (as in every
+    family's init_params); everything else draws the same 0.02-std
+    normal.
+    """
+    if mode == "none":
+        raise ValueError("init_quantized_params needs a quant mode; use "
+                         "build_model for full-precision init")
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quant mode {mode!r}; one of {QUANT_MODES}")
+    from tpu_inference.models.registry import get_model_fns
+
+    mod = get_model_fns(model_cfg)
+    shapes = jax.eval_shape(
+        lambda k: mod.init_params(model_cfg, k), jax.random.PRNGKey(0))
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(shapes)
+    key = jax.random.PRNGKey(seed)
+
+    def name_of(path):
+        last = path[-1]
+        return last.key if hasattr(last, "key") else str(last)
+
+    out = []
+    for path, sds in leaves:
+        name = name_of(path)
+        key, sub = jax.random.split(key)
+        if name in QUANT_KEYS:
+            out.append(jax.jit(
+                lambda k, s=sds: quantize_array(
+                    (0.02 * jax.random.normal(k, s.shape, jnp.float32)
+                     ).astype(s.dtype)))(sub))
+        elif "norm" in name:
+            out.append(jnp.ones(sds.shape, sds.dtype))
+        else:
+            out.append(jax.jit(
+                lambda k, s=sds: (0.02 * jax.random.normal(
+                    k, s.shape, jnp.float32)).astype(s.dtype))(sub))
+    return jax.tree_util.tree_unflatten(treedef, out)

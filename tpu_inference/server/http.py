@@ -42,7 +42,7 @@ from typing import Any, Optional
 
 from aiohttp import web
 
-from tpu_inference.config import FrameworkConfig, PRESETS
+from tpu_inference.config import FrameworkConfig
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.sampling import PENALTY_WINDOW
 from tpu_inference.server.tokenizer import (IncrementalDecoder, StopMatcher,
@@ -107,12 +107,9 @@ class InferenceServer:
         from tpu_inference.server.replicas import EngineGroup
 
         self.cfg = cfg
-        t0 = time.perf_counter()
-        if group is None:
-            group = (EngineGroup([engine]) if engine is not None
-                     else build_engine_group(cfg))
-        self.group = group
-        self.engine = group.engine            # primary replica (tests/bench)
+        # Tokenizer first: its consistency check needs no engine, so a
+        # broken deployment fails in milliseconds, not after minutes of
+        # weight load + XLA compile.
         self.tokenizer = build_tokenizer(cfg.server.tokenizer,
                                          vocab_size=cfg.model.vocab_size)
         if self.tokenizer.vocab_size > cfg.model.vocab_size:
@@ -128,6 +125,12 @@ class InferenceServer:
                 "encode to ids the model cannot embed; use the "
                 "checkpoint's own tokenizer or a model with a matching "
                 "embedding table")
+        t0 = time.perf_counter()
+        if group is None:
+            group = (EngineGroup([engine]) if engine is not None
+                     else build_engine_group(cfg))
+        self.group = group
+        self.engine = group.engine            # primary replica (tests/bench)
         self.load_duration_ns = (load_duration_ns if load_duration_ns
                                  is not None else
                                  int((time.perf_counter() - t0) * 1e9))
@@ -424,7 +427,9 @@ class InferenceServer:
             if repeat_penalty != 1.0:
                 # With the penalty off, clamping/ignoring its window is
                 # a no-op — warn only when sampling actually diverges.
-                if repeat_last_n > PENALTY_WINDOW:
+                # -1 is Ollama's "whole context"; the engine clamps both
+                # cases to its static window (engine._penalty_arrays).
+                if repeat_last_n > PENALTY_WINDOW or repeat_last_n < 0:
                     warnings.append(
                         f"repeat_last_n={repeat_last_n} clamped to the "
                         f"static penalty window {PENALTY_WINDOW}")
