@@ -65,8 +65,15 @@ import sys
 import time
 
 BASELINE_TOK_S = 93.0  # BASELINE.md: reference-side Ollama single-stream rate
-METRIC = "decode_tok_s_llama1b_bs8_pallas"
-BATCH = 8
+# Decode slots. The default stays 8 so BENCH_r{N}.json compares across
+# rounds; BENCH_BATCH=32 is the chip-sized lane (engine/autosize.py).
+BATCH = int(os.environ.get("BENCH_BATCH", "8"))
+# Metric key encodes model + batch so a BENCH_BATCH/BENCH_MODEL lane can
+# never be diffed against default-lane history by accident; the default
+# spelling stays exactly "decode_tok_s_llama1b_bs8_pallas".
+METRIC = ("decode_tok_s_"
+          f"{'llama8b' if os.environ.get('BENCH_MODEL') == '8b' else 'llama1b'}"
+          f"_bs{BATCH}_pallas")
 
 PROBE_TIMEOUT_S = 120
 LANE_TIMEOUT_S = 280
@@ -160,7 +167,11 @@ def lane_child(spec: str) -> None:
     timed_calls = 32 if on_tpu else 2
     ramp_calls = 2
     budget = (timed_calls + ramp_calls + 1) * k
-    ecfg = EngineConfig(page_size=16, num_pages=512, max_pages_per_seq=32,
+    ecfg = EngineConfig(page_size=16,
+                        # Pool scales with the lane's batch so BENCH_BATCH
+                        # lanes never hit page-pressure mid-measurement.
+                        num_pages=max(512, 32 * batch),
+                        max_pages_per_seq=32,
                         max_batch_size=batch, prefill_buckets=(128,),
                         decode_steps_per_call=k, max_new_tokens=budget,
                         attn_backend=backend, quant=quant)

@@ -1,0 +1,76 @@
+#!/bin/bash
+# Round-4 TPU measurement battery (VERDICT r3 items 1-4). Run when the
+# axon tunnel is healthy; every stage is individually time-bounded and
+# failures don't stop later stages. Artifacts land in benchmarks/results/.
+#
+#   bash benchmarks/run_tpu_round4.sh [stage ...]   # default: all stages
+#
+# Stages:
+#   bench     hardened bench.py (pallas bf16 / int8 / dense lanes, 1B dims)
+#   bench8b   BENCH_MODEL=8b int8 lane (BASELINE.md config-1 row)
+#   replay    saturated BurstGPT replay: real 1B checkpoint, int8+int8,
+#             auto batch sizing (VERDICT: >=370 tok/s, TTFT p50 < 5 s)
+#   sweep     decode_steps_per_call x pipeline-depth mini-sweep for the
+#             hbm_util push (short bench lanes)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+STAGES=${@:-"bench bench32 bench8b replay sweep"}
+CKPT=/tmp/real-llama-1b
+
+probe() {
+  timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" 2>/dev/null
+}
+
+echo "== probe: $(probe || echo UNREACHABLE)"
+
+for s in $STAGES; do case $s in
+bench)
+  echo "== bench.py (3 lanes)"
+  timeout 1100 python bench.py 2>benchmarks/results/bench_r4_tpu.err \
+    | tee benchmarks/results/bench_r4_tpu.jsonl
+  ;;
+bench32)
+  echo "== bench.py BENCH_BATCH=32 (chip-sized batch lane)"
+  BENCH_BATCH=32 timeout 1100 python bench.py \
+    2>benchmarks/results/bench_r4_bs32.err \
+    | tee benchmarks/results/bench_r4_bs32.jsonl
+  ;;
+bench8b)
+  echo "== bench.py BENCH_MODEL=8b (int8-only lane)"
+  BENCH_MODEL=8b timeout 1100 python bench.py \
+    2>benchmarks/results/bench_r4_8b.err \
+    | tee benchmarks/results/bench_r4_8b.jsonl
+  ;;
+replay)
+  if [ -d "$CKPT" ]; then
+    echo "== saturated BurstGPT replay (real 1B, int8+int8, auto batch)"
+    timeout 1500 python benchmarks/replay.py \
+      --model "$CKPT" --tokenizer auto \
+      --quant int8 --kv-quant int8 \
+      --max-batch-size auto --num-pages auto --batch-cap 32 \
+      --trace data/BurstGPT_1.csv --max-trace 100 \
+      --decode-pipeline-depth 2 \
+      --out benchmarks/results/real1b_burstgpt_r4_int8_auto.json \
+      2>&1 | tail -5
+  else
+    echo "== replay SKIPPED: $CKPT missing"
+  fi
+  ;;
+sweep)
+  echo "== K x depth sweep on the int8 replay config (hbm_util push)"
+  for K in 8 16; do for D in 1 2 4; do
+    [ -d "$CKPT" ] || break 2
+    echo "-- K=$K depth=$D"
+    timeout 900 python benchmarks/replay.py \
+      --model "$CKPT" --tokenizer auto --quant int8 --kv-quant int8 \
+      --max-batch-size auto --num-pages auto --batch-cap 32 \
+      --trace data/BurstGPT_1.csv --max-trace 40 \
+      --decode-steps-per-call $K --decode-pipeline-depth $D \
+      --out benchmarks/results/sweep_r4_K${K}_D${D}.json \
+      2>&1 | tail -2
+  done; done
+  ;;
+*) echo "unknown stage $s";;
+esac; done
+echo "== done"

@@ -28,15 +28,43 @@ def setup():
     return model_cfg, engine_cfg, params, mod
 
 
+# One compiled oracle forward per (family, config, bucket) — the old
+# eager per-step forward compiled a fresh XLA graph for EVERY decoded
+# token at every new length, dominating the whole suite's wall time.
+_ORACLE_FWD: dict = {}
+
+
+def _oracle_forward(mod, cfg, pad):
+    key = (mod.__name__, cfg, pad)
+    if key not in _ORACLE_FWD:
+        def fwd(params, toks, n):
+            """Logits at position n-1 of a [1, pad] right-padded batch
+            (causal attention: padding after n-1 cannot leak in)."""
+            pos = jnp.broadcast_to(jnp.arange(pad), (1, pad))
+            logits, _ = mod.forward(params, cfg, toks, pos, None,
+                                    common.make_dense_attn())
+            return logits[0, n - 1]
+
+        _ORACLE_FWD[key] = jax.jit(fwd)
+    return _ORACLE_FWD[key]
+
+
 def reference_greedy(params, mod, cfg, prompt, n_new):
-    """Greedy decode via repeated full forwards (no cache)."""
+    """Greedy decode via repeated full forwards (no cache), padded to a
+    shared 64-token bucket so all steps/prompts reuse one compile."""
+    total = len(prompt) + n_new
+    pad = min(-(-total // 64) * 64, cfg.max_seq_len)
+    assert pad >= total, "prompt + n_new exceeds max_seq_len"
+    fwd = _oracle_forward(mod, cfg, pad)
     toks = list(prompt)
-    for _ in range(n_new):
-        t = jnp.asarray(np.array(toks)[None])
-        pos = jnp.broadcast_to(jnp.arange(len(toks)), (1, len(toks)))
-        logits, _ = mod.forward(params, cfg, t, pos, None,
-                                common.make_dense_attn())
-        toks.append(int(jnp.argmax(logits[0, -1])))
+    buf = np.zeros((1, pad), np.int32)
+    buf[0, :len(toks)] = toks
+    for i in range(n_new):
+        n = len(toks)
+        logits = fwd(params, jnp.asarray(buf), jnp.asarray(n))
+        tok = int(jnp.argmax(logits))
+        buf[0, n] = tok
+        toks.append(tok)
     return toks[len(prompt):]
 
 
@@ -491,6 +519,7 @@ def _drive(engine, prompts, n_new, pipelined):
     return [results[i] for i in range(len(seqs))]
 
 
+@pytest.mark.slow   # config-space fuzz; the canonical invariant runs fast in test_engine_matches_full_forward
 def test_engine_matches_oracle_across_random_configs():
     """Config-space fuzz of the canonical invariant: engine output ==
     cache-free full-forward greedy, across randomized paging geometry,

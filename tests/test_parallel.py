@@ -141,6 +141,7 @@ def test_sp_engine_ring_prefill_matches_unsharded():
     assert got == want
 
 
+@pytest.mark.slow   # 2k-token ring prefill; short-ring coverage in test_sp_engine_ring_prefill_matches_unsharded
 def test_sp_long_context_prefill():
     """Long-context serving: a 2k-token prompt prefills through ring
     attention (sp=4) with per-chip sequence shards and decodes on the

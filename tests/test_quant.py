@@ -129,6 +129,7 @@ def test_tp_sharded_int8_matches_unsharded():
     assert base == tp
 
 
+@pytest.mark.slow   # EP x int8 combination sweep; EP and int8 each covered separately
 def test_tp_sharded_int8_mixtral_ep():
     from tpu_inference.parallel.mesh import build_mesh
     cfg = tiny_mixtral()

@@ -387,8 +387,11 @@ def test_chaos_injection():
     _run(srv, scenario)
 
 
-@pytest.mark.parametrize("quant,kv_quant", [("none", "none"),
-                                             ("int8", "int8")])
+@pytest.mark.parametrize("quant,kv_quant", [
+    ("none", "none"),
+    # The quantized-replica combination re-proves what test_quant and
+    # test_kv_quant cover per-component; slow-marked as a sweep.
+    pytest.param("int8", "int8", marks=pytest.mark.slow)])
 def test_dp_replica_serving(quant, kv_quant):
     """dp=2 builds two replica engines on disjoint submeshes; concurrent
     requests spread across them and all succeed (least-loaded routing).

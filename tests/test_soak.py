@@ -85,6 +85,7 @@ async def _one(client, body):
     return final
 
 
+@pytest.mark.slow   # randomized soak sweep
 def test_randomized_option_soak(soak_server):
     rng = random.Random(7)
 
