@@ -19,7 +19,12 @@ STAGES=${@:-"bench bench32 bench8b replay sweep"}
 CKPT=/tmp/real-llama-1b
 
 probe() {
-  timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" 2>/dev/null
+  # Shared wedge-safe probe (bench.py child runner: own process group,
+  # SIGKILL on timeout — never orphans a runtime helper on the chip).
+  python -c "
+import json, sys, bench
+rc, rec = bench._run_child(['--probe'], 120)
+print(json.dumps(rec)) if rec else sys.exit(1)" 2>/dev/null
 }
 
 echo "== probe: $(probe || echo UNREACHABLE)"

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it heals, then run the round-4
+# measurement battery exactly once. Intended to run in the background:
+#   bash benchmarks/tpu_watch.sh >> benchmarks/results/tpu_watch.log 2>&1
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${TPU_WATCH_INTERVAL_S:-600}
+DEADLINE=${TPU_WATCH_DEADLINE_S:-28800}   # give up after 8h
+start=$(date +%s)
+n=0
+while :; do
+  n=$((n + 1))
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$DEADLINE" ]; then
+    echo "[watch] $(date -u +%H:%M:%S) deadline reached after $n probes; giving up"
+    exit 1
+  fi
+  # One shared, wedge-safe probe: bench.py's hardened child runner
+  # (own process group, SIGKILL on timeout, stdout via temp file) — a
+  # naive `timeout python -c "import jax..."` can orphan axon runtime
+  # helpers that hold the TPU and keep the tunnel wedged (round-3 mode).
+  if python -c "
+import sys, bench
+rc, rec = bench._run_child(['--probe'], 120)
+sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)" 2>/dev/null; then
+    echo "[watch] $(date -u +%H:%M:%S) tunnel healthy after $n probes; running battery"
+    bash benchmarks/run_tpu_round4.sh
+    exit 0
+  fi
+  echo "[watch] $(date -u +%H:%M:%S) probe $n: tunnel still wedged; sleeping ${INTERVAL}s"
+  sleep "$INTERVAL"
+done
