@@ -21,10 +21,12 @@ CKPT=/tmp/real-llama-1b
 probe() {
   # Shared wedge-safe probe (bench.py child runner: own process group,
   # SIGKILL on timeout — never orphans a runtime helper on the chip).
-  python -c "
+  # Outer timeout bounds the parent interpreter too (deepest wedge mode
+  # blocks python at startup, before the child's 120s deadline exists).
+  timeout -k 10 300 python -c "
 import json, sys, bench
 rc, rec = bench._run_child(['--probe'], 120)
-print(json.dumps(rec)) if rec else sys.exit(1)" 2>/dev/null
+print(json.dumps(rec)) if rec else sys.exit(1)"
 }
 
 echo "== probe: $(probe || echo UNREACHABLE)"

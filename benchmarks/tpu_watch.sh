@@ -19,10 +19,15 @@ while :; do
   # (own process group, SIGKILL on timeout, stdout via temp file) — a
   # naive `timeout python -c "import jax..."` can orphan axon runtime
   # helpers that hold the TPU and keep the tunnel wedged (round-3 mode).
-  if python -c "
+  # Outer timeout bounds the PARENT interpreter (the deepest wedge mode
+  # blocks python at startup, before _run_child's 120s can start); it is
+  # well above the child's own deadline so it never kills a live child.
+  # stderr flows to the watch log — a broken probe must look broken,
+  # not like "still wedged" for 8 hours.
+  if timeout -k 10 300 python -c "
 import sys, bench
 rc, rec = bench._run_child(['--probe'], 120)
-sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)" 2>/dev/null; then
+sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)"; then
     echo "[watch] $(date -u +%H:%M:%S) tunnel healthy after $n probes; running battery"
     bash benchmarks/run_tpu_round4.sh
     exit 0

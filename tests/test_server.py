@@ -516,3 +516,37 @@ def test_boot_rejects_tokenizer_model_vocab_mismatch():
         server=ServerConfig(tokenizer="byte"))
     with pytest.raises(ValueError, match="tokenizer vocab"):
         InferenceServer(cfg)
+
+
+def test_spec_decode_repeat_penalty_warning():
+    """With a draft model configured, a request asking for repeat_penalty
+    gets a warning that the penalty is ignored (rejection sampling needs
+    the unmodified target distribution) — never a silent divergence."""
+    import dataclasses
+
+    from tpu_inference.engine.engine import InferenceEngine
+    from tpu_inference.models import build_model
+
+    target = tiny_llama(vocab_size=512)
+    # Derive the draft from the target (same idiom as test_kv_quant) so
+    # the configs can't drift apart.
+    draft = dataclasses.replace(target, name="draft", n_layers=1)
+    params, _ = build_model(target, seed=0)
+    dparams, _ = build_model(draft, seed=9)
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=8,
+                        max_batch_size=2, prefill_buckets=(16, 32),
+                        num_speculative_tokens=2)
+    eng = InferenceEngine(target, ecfg, params=params,
+                          draft_cfg=draft, draft_params=dparams)
+    srv = InferenceServer(FrameworkConfig(
+        model=target, engine=ecfg, server=ServerConfig(tokenizer="byte")),
+        engine=eng)
+
+    async def go(client):
+        rec = await (await client.post("/api/generate", json={
+            "prompt": "hi", "stream": False, "max_tokens": 4,
+            "temperature": 0.0, "options": {"repeat_penalty": 1.2}})).json()
+        assert rec["done"]
+        assert any("speculative" in w for w in rec["warnings"])
+
+    _run(srv, go)

@@ -86,13 +86,18 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
             return _block_attend(qf, kc, vc, q_pos, k_pos, scale, n_rep)
 
         def skip(ops):
-            # pvary: mark the constants as device-varying so both cond
-            # branches agree under shard_map's varying-axis typing.
-            return jax.lax.pvary(
-                (jnp.full((b, hq, s_loc), NEG_INF, jnp.float32),
-                 jnp.zeros((b, hq, s_loc), jnp.float32),
-                 jnp.zeros((b, s_loc, hq, d), jnp.float32)),
-                (axis_name,))
+            # Mark the constants as device-varying so both cond branches
+            # agree under shard_map's varying-axis typing. pcast is the
+            # current spelling; fall back to pvary on older jax (touch
+            # the deprecated name only when pcast is absent — the
+            # attribute access alone raises the DeprecationWarning).
+            vals = (jnp.full((b, hq, s_loc), NEG_INF, jnp.float32),
+                    jnp.zeros((b, hq, s_loc), jnp.float32),
+                    jnp.zeros((b, s_loc, hq, d), jnp.float32))
+            pcast = getattr(jax.lax, "pcast", None)
+            if pcast is None:
+                return jax.lax.pvary(vals, (axis_name,))
+            return pcast(vals, (axis_name,), to="varying")
 
         # Chunks entirely in the causal future contribute nothing; skip
         # their einsums (the ring still rotates them — wall-clock per step
