@@ -250,3 +250,35 @@ def test_tp_engine_pipelined_decode_matches():
             break
     eng.drain_pipeline()
     assert [s.generated for s in seqs] == want
+
+
+def test_sp_engine_ulysses_prefill_matches_unsharded():
+    """Serving prefill through Ulysses all-to-all SP (sp=2, composed
+    with tp=2) produces the same greedy tokens as the single-device
+    engine (the same contract the ring path satisfies)."""
+    cfg = tp_llama_cfg()
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=8,
+                        max_batch_size=4, prefill_buckets=(16, 32),
+                        sp_attn="ulysses")
+    prompts = [list(range(1, 29)), [7, 8, 9], list(range(100, 117))]
+
+    base = InferenceEngine(cfg, ecfg, seed=0)
+    want = base.generate(prompts, max_new_tokens=8)
+
+    mesh = build_mesh(ParallelConfig(tp=2, sp=2))
+    eng = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+    assert eng.sp == 2
+    got = eng.generate(prompts, max_new_tokens=8)
+    assert got == want
+
+
+def test_sp_ulysses_rejects_indivisible_heads():
+    """n_kv_heads=4 can't split across tp*sp=8 head groups — explicit
+    error steering to the ring, not a wrong-shape crash mid-prefill."""
+    cfg = tp_llama_cfg()
+    ecfg = EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=8,
+                        max_batch_size=2, prefill_buckets=(16,),
+                        sp_attn="ulysses")
+    mesh = build_mesh(ParallelConfig(tp=2, sp=4))
+    with pytest.raises(ValueError, match="ulysses"):
+        InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)

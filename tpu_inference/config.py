@@ -194,6 +194,12 @@ class EngineConfig:
     # of the same byte size. Dequant is in-kernel (Pallas) or at gather
     # (dense path).
     kv_quant: str = "none"
+    # Sequence-parallel prefill algorithm on an sp>1 mesh: "ring"
+    # (ppermute K/V rotation, O((S/n)^2) memory — the long-context
+    # default) or "ulysses" (two all-to-alls, full-sequence attention
+    # per head group — fewer collective hops, balanced causal load;
+    # needs head counts divisible by sp after tp sharding).
+    sp_attn: str = "ring"
     # Device-side decode steps fused per host call (lax.scan): each host
     # round trip costs ~dispatch latency, so K steps per call multiply
     # steady-state decode throughput by up to K. Streamed tokens are

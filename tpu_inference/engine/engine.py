@@ -45,7 +45,7 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
                     positions: jax.Array, valid: jax.Array,
                     q_offset: jax.Array, kv_len: jax.Array,
                     attn_backend: str = "dense", mesh: Optional[Any] = None,
-                    sp_ring: bool = False):
+                    sp_mode: Optional[str] = None):
     """AttentionFn that writes new K/V into the paged pool then attends.
 
     block_tables [B, MP]; positions/valid [B, S]; q_offset/kv_len [B].
@@ -57,24 +57,32 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
     head-local and needs no collective; the following wo matmul's
     all-reduce (placed by GSPMD) combines chips as usual.
 
-    ``sp_ring``: sequence-parallel prefill — the chunk's self-attention
-    runs as ring attention over the mesh's ``sp`` axis (q/k/v sequence-
-    sharded, K/V shards rotating by ppermute over ICI), composed with tp
-    head sharding. Valid only for a fresh full-prompt chunk (no cached
-    prefix); the engine routes eligible prefills here.
+    ``sp_mode``: sequence-parallel prefill — the chunk's self-attention
+    runs sequence-sharded over the mesh's ``sp`` axis, composed with tp
+    head sharding. "ring" rotates K/V shards by ppermute over ICI
+    (kernels/ring_attention.py, O((S/n)²) memory); "ulysses" re-shards
+    via two all-to-alls and attends full-sequence per head group
+    (kernels/ulysses_attention.py, fewer collective hops, needs head
+    counts divisible by sp). Valid only for a fresh full-prompt chunk
+    (no cached prefix); the engine routes eligible prefills here.
     """
     from tpu_inference.models.common import dense_causal_attention
 
-    def _ring_prefill(q, k, v):
+    def _sp_prefill(q, k, v):
         from functools import partial as _partial
 
         from jax.sharding import PartitionSpec as P
 
-        from tpu_inference.kernels.ring_attention import ring_attention_local
+        if sp_mode == "ulysses":
+            from tpu_inference.kernels.ulysses_attention import (
+                ulysses_attention_local as sp_local)
+        else:
+            from tpu_inference.kernels.ring_attention import (
+                ring_attention_local as sp_local)
 
         spec = P(None, "sp", "tp", None)       # [B, S, H, D]: seq × heads
         return jax.shard_map(
-            _partial(ring_attention_local, axis_name="sp"),
+            _partial(sp_local, axis_name="sp"),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
 
@@ -146,10 +154,10 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
         kv = kvc.write_kv(kv, layer_idx, k, v, slots)
         if attn_backend == "pallas" and q.shape[1] == 1:
             return _pallas_decode(q[:, 0], kv, layer_idx)[:, None], kv
-        if sp_ring and q.shape[1] > 1:
+        if sp_mode and q.shape[1] > 1:
             # Fresh full-prompt chunk: attention is pure self-attention
             # over (q, k, v) — no need to read back through the pool.
-            return _ring_prefill(q, k, v), kv
+            return _sp_prefill(q, k, v), kv
         if attn_backend == "pallas" and q.shape[1] > 1:
             # Flash prefill over pool pages: O(S·page) memory, no gather.
             return _pallas_prefill(q, kv, layer_idx), kv
@@ -324,8 +332,21 @@ class InferenceEngine:
         self._prefill_batch_sizes = sorted(
             {1, max(1, engine_cfg.max_prefill_batch)})
         if self.sp > 1:
+            if engine_cfg.sp_attn not in ("ring", "ulysses"):
+                raise ValueError(f"sp_attn={engine_cfg.sp_attn!r}: "
+                                 "one of ('ring', 'ulysses')")
+            if engine_cfg.sp_attn == "ulysses":
+                tp = int(mesh.shape.get("tp", 1))
+                if (model_cfg.n_heads % (tp * self.sp)
+                        or model_cfg.n_kv_heads % (tp * self.sp)):
+                    raise ValueError(
+                        f"sp_attn='ulysses' needs n_heads "
+                        f"({model_cfg.n_heads}) and n_kv_heads "
+                        f"({model_cfg.n_kv_heads}) divisible by tp*sp "
+                        f"({tp}*{self.sp}); use sp_attn='ring'")
             self._prefill_sp_jit = jax.jit(
-                partial(self._prefill_fn, sp_ring=True), donate_argnums=(1,))
+                partial(self._prefill_fn, sp_mode=engine_cfg.sp_attn),
+                donate_argnums=(1,))
 
         # Speculative decoding (BASELINE.json config 4): a draft model with
         # its own KV pool but the SAME page geometry + block tables, so one
@@ -364,7 +385,7 @@ class InferenceEngine:
 
     def _prefill_fn(self, params, kv: KVPages, tokens, prompt_len, prefix_len,
                     block_table, key, temperature, top_p, top_k, seed,
-                    rpen, rlast, window, sp_ring: bool = False):
+                    rpen, rlast, window, sp_mode=None):
         """One sequence, tokens [1, S_bucket] right-padded.
 
         prefix_len > 0 means ``prefix_len`` tokens are already cached in this
@@ -382,7 +403,7 @@ class InferenceEngine:
                                positions, valid, q_offset=prefix_len,
                                kv_len=total_len, mesh=self.mesh,
                                attn_backend=self.attn_backend,
-                               sp_ring=sp_ring)
+                               sp_mode=sp_mode)
         hidden, kv = self.mod.forward_hidden(params, cfg, tokens, positions,
                                              kv, attn)
         last = jnp.take_along_axis(

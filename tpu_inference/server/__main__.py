@@ -49,8 +49,14 @@ def main() -> None:
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree (devices in the mesh)")
     p.add_argument("--sp", type=int, default=1,
-                   help="sequence-parallel degree: ring-attention prefill "
-                        "over this many devices (long prompts)")
+                   help="sequence-parallel degree: sharded-sequence "
+                        "prefill over this many devices (long prompts)")
+    p.add_argument("--sp-attn", default="ring",
+                   choices=("ring", "ulysses"),
+                   help="sequence-parallel algorithm: 'ring' (ppermute "
+                        "K/V rotation, O((S/n)^2) memory) or 'ulysses' "
+                        "(two all-to-alls, balanced causal load; needs "
+                        "head counts divisible by tp*sp)")
     p.add_argument("--dp", type=int, default=1,
                    help="data-parallel replicas: each gets its own tp*sp "
                         "submesh, KV pool and scheduler; requests route "
@@ -133,6 +139,7 @@ def main() -> None:
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,
                           attn_backend=args.attn_backend,
+                          sp_attn=args.sp_attn,
                           quant=args.quant, kv_quant=args.kv_quant,
                           max_batch_size=max_batch_size,
                           num_pages=num_pages, page_size=args.page_size,
