@@ -122,20 +122,26 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     return (acc / denom).astype(q.dtype)
 
 
+def seq_sharded_call(body, q, k, v, mesh: Mesh, axis_name: str):
+    """Shared wrapper for sequence-parallel attention kernels: reshard
+    q/k/v so the sequence dim shards over ``axis_name`` (batch/head dims
+    replicated), run the per-shard ``body`` under shard_map, return with
+    the same sequence sharding. Used by ring and ulysses."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(functools.partial(body, axis_name=axis_name),
+                       mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    sh = NamedSharding(mesh, spec)
+    return fn(jax.device_put(q, sh), jax.device_put(k, sh),
+              jax.device_put(v, sh))
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "axis_name"))
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                    mesh: Mesh, axis_name: str = "sp") -> jax.Array:
     """Full-sequence causal attention, sequence-sharded over ``axis_name``.
 
     q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] with S divisible by the axis
-    size. Activations are resharded onto the mesh (batch/head dims
-    replicated over the axis), the ring runs under shard_map, and the
-    result comes back with the same sequence sharding.
+    size.
     """
-    spec = P(None, axis_name, None, None)
-    body = functools.partial(ring_attention_local, axis_name=axis_name)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
-    sh = NamedSharding(mesh, spec)
-    return fn(jax.device_put(q, sh), jax.device_put(k, sh),
-              jax.device_put(v, sh))
+    return seq_sharded_call(ring_attention_local, q, k, v, mesh, axis_name)

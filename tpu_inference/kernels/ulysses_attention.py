@@ -41,7 +41,7 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                             axis_name: str = "sp") -> jax.Array:
@@ -81,10 +81,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                       mesh: Mesh, axis_name: str = "sp") -> jax.Array:
     """Full-sequence causal attention, sequence-sharded over
     ``axis_name`` (same call surface as kernels.ring_attention)."""
-    spec = P(None, axis_name, None, None)
-    body = functools.partial(ulysses_attention_local, axis_name=axis_name)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec)
-    sh = NamedSharding(mesh, spec)
-    return fn(jax.device_put(q, sh), jax.device_put(k, sh),
-              jax.device_put(v, sh))
+    from tpu_inference.kernels.ring_attention import seq_sharded_call
+
+    return seq_sharded_call(ulysses_attention_local, q, k, v, mesh,
+                            axis_name)
