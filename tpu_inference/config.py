@@ -41,6 +41,11 @@ class ModelConfig:
     n_experts_per_tok: int = 2
     # Static per-expert token capacity = ceil(k*T/E * factor); overflow drops.
     expert_capacity_factor: float = 2.0
+    # Sliding-window attention (Mistral): each token attends to itself
+    # and the window-1 tokens before it. 0 = full causal attention.
+    # Served on the dense backend (the Pallas kernels stream the full
+    # context; engine.__init__ routes/guards accordingly).
+    sliding_window: int = 0
     # GPT-2 family uses learned positional embeddings + LayerNorm with bias.
     use_learned_pos: bool = False
     use_bias: bool = False

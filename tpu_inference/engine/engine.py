@@ -163,7 +163,8 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             return _pallas_prefill(q, kv, layer_idx), kv
         k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
         out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,
-                                     kv_len=kv_len)
+                                     kv_len=kv_len,
+                                     sliding_window=cfg.sliding_window)
         return out, kv
 
     return attn
@@ -231,9 +232,27 @@ class InferenceEngine:
         if backend == "auto":
             backend = ("pallas" if jax.default_backend() == "tpu"
                        else "dense")
+            if model_cfg.sliding_window:
+                # SWA (Mistral): the Pallas kernels stream the whole
+                # context; the dense path applies the window mask.
+                backend = "dense"
         if backend not in ("dense", "pallas"):
             raise ValueError(f"unknown attn_backend {backend!r}; "
                              "expected 'auto', 'dense' or 'pallas'")
+        if backend == "pallas" and model_cfg.sliding_window:
+            raise ValueError(
+                f"{model_cfg.name}: sliding_window="
+                f"{model_cfg.sliding_window} is served by the dense "
+                "backend (the Pallas kernels don't window yet); use "
+                "--attn-backend auto or dense")
+        if (model_cfg.sliding_window and mesh is not None
+                and int(mesh.shape.get("sp", 1)) > 1):
+            # Before materializing params — a 70B-scale load must not
+            # run for minutes just to hit a config error.
+            raise ValueError(
+                f"{model_cfg.name}: sequence-parallel prefill doesn't "
+                "apply sliding_window masks yet; serve SWA models with "
+                "sp=1")
         # Validate mesh compatibility BEFORE materializing params —
         # at 70B scale a post-init failure wastes minutes (or OOMs).
         if mesh is not None:
@@ -611,7 +630,8 @@ class InferenceEngine:
                     pos = jnp.broadcast_to(
                         jnp.arange(s, dtype=jnp.int32)[None], tokens.shape)
                     hidden, _ = self.mod.forward_hidden(
-                        params, cfg, tokens, pos, None, make_dense_attn())
+                        params, cfg, tokens, pos, None,
+                        make_dense_attn(cfg.sliding_window))
                     mask = (jnp.arange(s)[None, :] <
                             lengths[:, None])[..., None]
                     pooled = (jnp.sum(hidden * mask, axis=1)
@@ -658,9 +678,9 @@ class InferenceEngine:
         cfg = self.model_cfg
 
         def fwd(params, tokens, positions):
-            hidden, _ = self.mod.forward_hidden(params, cfg, tokens,
-                                                positions, None,
-                                                make_dense_attn())
+            hidden, _ = self.mod.forward_hidden(
+                params, cfg, tokens, positions, None,
+                make_dense_attn(cfg.sliding_window))
             return self.mod.unembed(params, cfg, hidden)
 
         toks = jnp.zeros((1, 8), jnp.int32)

@@ -78,14 +78,17 @@ def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
 
 def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                            q_offset: jax.Array | int = 0,
-                           kv_len: jax.Array | None = None) -> jax.Array:
+                           kv_len: jax.Array | None = None,
+                           sliding_window: int = 0) -> jax.Array:
     """Dense causal attention; the correctness reference for all kernels.
 
     q: [B, Sq, Hq, D]; k, v: [B, Skv, Hkv, D] (GQA expanded internally).
     ``q_offset`` (scalar or [B]) is the absolute position of q's first token
     within the KV sequence (for chunked prefill / decode against a cache).
     ``kv_len`` (scalar or [B]) masks out cache slots beyond the valid length.
-    Softmax in float32.
+    ``sliding_window`` > 0 additionally masks keys more than window-1
+    positions behind the query (Mistral-style SWA: each token attends to
+    itself and the window-1 tokens before it). Softmax in float32.
     """
     b, sq, hq, d = q.shape
     skv, hkv = k.shape[1], k.shape[2]
@@ -99,6 +102,9 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q_pos = offs[:, None] + jnp.arange(sq)[None, :]             # [B, Sq]
     k_pos = jnp.arange(skv)                                     # [Skv]
     mask = k_pos[None, None, :] <= q_pos[:, :, None]            # [B, Sq, Skv]
+    if sliding_window:
+        mask = jnp.logical_and(
+            mask, k_pos[None, None, :] > q_pos[:, :, None] - sliding_window)
     if kv_len is not None:
         lens = jnp.broadcast_to(jnp.asarray(kv_len), (b,))
         mask = jnp.logical_and(mask, k_pos[None, None, :] < lens[:, None, None])
@@ -108,12 +114,15 @@ def dense_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
-def make_dense_attn(theta_unused: float = 0.0) -> AttentionFn:
-    """AttentionFn for cache-free full-sequence forward (tests, parity)."""
+def make_dense_attn(sliding_window: int = 0) -> AttentionFn:
+    """AttentionFn for cache-free full-sequence forward (tests, parity).
+    ``sliding_window`` mirrors ModelConfig.sliding_window for SWA models
+    (Mistral)."""
 
     def attn(layer_idx: int, q, k, v, kv):
         del layer_idx
-        return dense_causal_attention(q, k, v), kv
+        return dense_causal_attention(q, k, v,
+                                      sliding_window=sliding_window), kv
 
     return attn
 
