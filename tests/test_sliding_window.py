@@ -117,34 +117,77 @@ def test_config_from_hf_reads_mistral_sliding_window(tmp_path):
     assert config_from_hf(str(tmp_path)).sliding_window == 0
 
 
-def test_swa_backend_routing():
-    """auto -> dense for SWA models; forcing pallas is an explicit error
-    (the Pallas kernels stream the full context, no window mask yet)."""
-    cfg = _swa_cfg(8)
-    ecfg = cfgs.EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
-                             max_batch_size=2, prefill_buckets=(16,))
-    eng = InferenceEngine(cfg, ecfg, seed=0)
-    assert eng.attn_backend == "dense"
-    import dataclasses
-
-    with pytest.raises(ValueError, match="sliding_window"):
-        InferenceEngine(cfg, dataclasses.replace(ecfg,
-                                                 attn_backend="pallas"),
-                        seed=0)
-
-
-def test_swa_auto_routes_dense_even_on_tpu(monkeypatch):
-    """The auto->dense-for-SWA override, pinned with a faked TPU backend
-    (on CPU auto resolves to dense anyway, which would mask a deleted
-    override)."""
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_windowed_paged_decode_kernel_matches_dense(kv_quant):
+    """The Pallas decode kernel's O(window) page walk (relative-page
+    grid + offset index maps) == the window-masked dense reference, for
+    ragged kv_lens crossing page boundaries, GQA, and the int8 pool."""
     import jax
 
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    from tpu_inference.engine import kv_cache as kvc
+    from tpu_inference.kernels.paged_attention import paged_attention
+
+    rng = np.random.default_rng(11)
+    page, mp, hq, hkv, d, window = 8, 6, 4, 2, 16, 11
+    b = 3
+    n_pages = 32
+    kv_lens = np.array([5, 17, 41], np.int32)      # <W, >W, >>W
+    k_pool = rng.standard_normal((n_pages, page, hkv, d)).astype(np.float32)
+    v_pool = rng.standard_normal((n_pages, page, hkv, d)).astype(np.float32)
+    bt = rng.permutation(np.arange(1, 1 + b * mp)).reshape(b, mp).astype(
+        np.int32)
+    q = rng.standard_normal((b, hq, d)).astype(np.float32)
+
+    ks = vs = None
+    if kv_quant == "int8":
+        kq, ks_ = kvc.quantize_kv(jnp.asarray(k_pool))
+        vq, vs_ = kvc.quantize_kv(jnp.asarray(v_pool))
+        k_in, v_in, ks, vs = kq, vq, ks_, vs_
+        # Dense reference sees the dequantized pool.
+        k_pool = np.asarray(kq, np.float32) * np.asarray(ks_)[..., None]
+        v_pool = np.asarray(vq, np.float32) * np.asarray(vs_)[..., None]
+    else:
+        k_in, v_in = jnp.asarray(k_pool), jnp.asarray(v_pool)
+
+    got = paged_attention(jnp.asarray(q), k_in, v_in, jnp.asarray(bt),
+                          jnp.asarray(kv_lens), ks, vs,
+                          sliding_window=window, interpret=True)
+
+    # Dense reference: gather each sequence's pages, window-masked
+    # attention with the query at position kv_len-1.
+    for i in range(b):
+        n = int(kv_lens[i])
+        flat = np.concatenate([k_pool[bt[i, j]] for j in range(mp)])[:n]
+        flatv = np.concatenate([v_pool[bt[i, j]] for j in range(mp)])[:n]
+        want = common.dense_causal_attention(
+            jnp.asarray(q[i][None, None]),                 # [1, 1, Hq, D]
+            jnp.asarray(flat[None]), jnp.asarray(flatv[None]),
+            q_offset=n - 1, kv_len=n, sliding_window=window)
+        np.testing.assert_allclose(np.asarray(got[i]),
+                                   np.asarray(want[0, 0]),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"seq {i} kv_len {n}")
+
+
+def test_swa_pallas_engine_matches_dense_engine():
+    """Serving with the windowed Pallas decode (prefill on the masked
+    dense path) produces exactly the dense backend's tokens."""
     cfg = _swa_cfg(8)
-    ecfg = cfgs.EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
-                             max_batch_size=2, prefill_buckets=(16,))
-    eng = InferenceEngine(cfg, ecfg, seed=0)
-    assert eng.attn_backend == "dense"
+    ecfg = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
+                max_batch_size=2, prefill_buckets=(16, 32))
+    params, _ = build_model(cfg, seed=0)
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (6, 21)]
+
+    dense = InferenceEngine(cfg, cfgs.EngineConfig(**ecfg,
+                                                   attn_backend="dense"),
+                            params=params)
+    want = dense.generate(prompts, max_new_tokens=14)
+    pallas = InferenceEngine(cfg, cfgs.EngineConfig(**ecfg,
+                                                    attn_backend="pallas"),
+                             params=params)
+    got = pallas.generate(prompts, max_new_tokens=14)
+    assert got == want
 
 
 def test_swa_sp_mesh_rejected_before_weights_load():

@@ -43,8 +43,9 @@ class ModelConfig:
     expert_capacity_factor: float = 2.0
     # Sliding-window attention (Mistral): each token attends to itself
     # and the window-1 tokens before it. 0 = full causal attention.
-    # Served on the dense backend (the Pallas kernels stream the full
-    # context; engine.__init__ routes/guards accordingly).
+    # Decode runs on the window-aware Pallas kernel (O(window) page
+    # reads); prefill uses the window-masked dense path. sp>1 prefill
+    # doesn't window yet (engine.__init__ guards).
     sliding_window: int = 0
     # GPT-2 family uses learned positional embeddings + LayerNorm with bias.
     use_learned_pos: bool = False

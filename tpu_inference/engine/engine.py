@@ -112,16 +112,19 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
 
     def _pallas_decode(q1, kv: KVPages, layer_idx):
         from tpu_inference.kernels.paged_attention import paged_attention
+        win = cfg.sliding_window
         if mesh is None:
             ks, vs = _scales(kv, layer_idx)
             return paged_attention(q1, kv.k[layer_idx], kv.v[layer_idx],
-                                   block_tables, kv_len, ks, vs)
+                                   block_tables, kv_len, ks, vs,
+                                   sliding_window=win)
         from jax.sharding import PartitionSpec as P
         head_p = P(None, "tp", None)                   # q/out [B, H*, D]
 
         def kernel(q_, bt_, kl_, k_, v_, *scales):
             ks_, vs_ = scales if scales else (None, None)
-            return paged_attention(q_, k_, v_, bt_, kl_, ks_, vs_)
+            return paged_attention(q_, k_, v_, bt_, kl_, ks_, vs_,
+                                   sliding_window=win)
 
         return _sharded_paged_call(
             kernel, kv, layer_idx,
@@ -158,8 +161,12 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             # Fresh full-prompt chunk: attention is pure self-attention
             # over (q, k, v) — no need to read back through the pool.
             return _sp_prefill(q, k, v), kv
-        if attn_backend == "pallas" and q.shape[1] > 1:
+        if (attn_backend == "pallas" and q.shape[1] > 1
+                and not cfg.sliding_window):
             # Flash prefill over pool pages: O(S·page) memory, no gather.
+            # (SWA prefill routes to the window-masked dense path below —
+            # prefill is one-shot per request; windowed DECODE, the
+            # steady state, stays on the Pallas kernel.)
             return _pallas_prefill(q, kv, layer_idx), kv
         k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
         out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,
@@ -232,19 +239,9 @@ class InferenceEngine:
         if backend == "auto":
             backend = ("pallas" if jax.default_backend() == "tpu"
                        else "dense")
-            if model_cfg.sliding_window:
-                # SWA (Mistral): the Pallas kernels stream the whole
-                # context; the dense path applies the window mask.
-                backend = "dense"
         if backend not in ("dense", "pallas"):
             raise ValueError(f"unknown attn_backend {backend!r}; "
                              "expected 'auto', 'dense' or 'pallas'")
-        if backend == "pallas" and model_cfg.sliding_window:
-            raise ValueError(
-                f"{model_cfg.name}: sliding_window="
-                f"{model_cfg.sliding_window} is served by the dense "
-                "backend (the Pallas kernels don't window yet); use "
-                "--attn-backend auto or dense")
         if (model_cfg.sliding_window and mesh is not None
                 and int(mesh.shape.get("sp", 1)) > 1):
             # Before materializing params — a 70B-scale load must not

@@ -34,7 +34,8 @@ NEG_INF = -1e30
 
 
 def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
-                   *rest, page_size: int, scale: float, quantized: bool):
+                   *rest, page_size: int, scale: float, quantized: bool,
+                   sliding_window: int = 0):
     if quantized:
         ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -50,7 +51,15 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     kv_len = kv_len_ref[b]
-    page_start = p * page_size
+    if sliding_window:
+        # Grid position p is RELATIVE to the window's first page (the
+        # BlockSpec index maps apply the same offset), so decode reads
+        # O(window) pages however long the context is — the property
+        # SWA models (Mistral) are built around.
+        win_start = jnp.maximum(kv_len - sliding_window, 0)
+        page_start = (win_start // page_size + p) * page_size
+    else:
+        page_start = p * page_size
 
     @pl.when(page_start < kv_len)
     def _accumulate():
@@ -71,7 +80,11 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32) * scale    # [Hkv, R, pg]
         pos = page_start + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=2)
-        s = jnp.where(pos < kv_len, s, NEG_INF)
+        valid = pos < kv_len
+        if sliding_window:
+            # Window edge can fall inside this page.
+            valid = jnp.logical_and(valid, pos >= kv_len - sliding_window)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:]                                  # [Hkv, R]
         l_prev = l_ref[:]
@@ -93,12 +106,13 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
         out_ref[0] = (acc_ref[:] / denom).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
                     block_tables: jax.Array, kv_len: jax.Array,
                     k_scale: jax.Array | None = None,
                     v_scale: jax.Array | None = None,
-                    interpret: bool | None = None) -> jax.Array:
+                    interpret: bool | None = None,
+                    sliding_window: int = 0) -> jax.Array:
     """Decode attention over the paged KV pool.
 
     q:            [B, Hq, D]   (one query token per sequence)
@@ -108,6 +122,11 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     k/v_scale:    [P, page_size, Hkv] f32 — present when the pool holds
                   int8 codes (engine/kv_cache.py quantize_kv); dequant
                   happens in VMEM after each page's DMA.
+    sliding_window > 0 (SWA, Mistral): only the pages overlapping the
+    last ``sliding_window`` positions are streamed — the grid's page
+    axis shrinks to the window's page span and the index maps offset
+    into the block table from the window's first page, so decode cost
+    is O(window), not O(context).
     Returns [B, Hq, D] in q.dtype.
     """
     if interpret is None:
@@ -121,8 +140,25 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
 
     q_g = q.reshape(b, hkv, n_rep, d)
 
+    if sliding_window:
+        # A window of W positions spans at most ceil(W/page)+1 pages
+        # when unaligned to page boundaries.
+        n_page_axis = min(mp, -(-sliding_window // page_size) + 1)
+
+        def page_idx(i, p, bt, kl):
+            start = jnp.maximum(kl[i] - sliding_window, 0) // page_size
+            # Clamp: relative pages past the sequence's last page are
+            # compute-masked in the kernel; the DMA just needs a legal id.
+            return bt[i, jnp.minimum(start + p, mp - 1)]
+    else:
+        n_page_axis = mp
+
+        def page_idx(i, p, bt, kl):
+            return bt[i, p]
+
     page_spec = pl.BlockSpec((1, page_size, hkv, d),
-                             lambda i, p, bt, kl: (bt[i, p], 0, 0, 0))
+                             lambda i, p, bt, kl: (page_idx(i, p, bt, kl),
+                                                   0, 0, 0))
     in_specs = [
         pl.BlockSpec((1, hkv, n_rep, d), lambda i, p, bt, kl: (i, 0, 0, 0)),
         page_spec,
@@ -131,13 +167,14 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     operands = [q_g, k_pages, v_pages]
     if quantized:
         scale_spec = pl.BlockSpec((1, page_size, hkv),
-                                  lambda i, p, bt, kl: (bt[i, p], 0, 0))
+                                  lambda i, p, bt, kl: (
+                                      page_idx(i, p, bt, kl), 0, 0))
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,          # block_tables, kv_len
-        grid=(b, mp),
+        grid=(b, n_page_axis),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, hkv, n_rep, d),
                                lambda i, p, bt, kl: (i, 0, 0, 0)),
@@ -149,7 +186,8 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, page_size=page_size, scale=scale,
-                          quantized=quantized),
+                          quantized=quantized,
+                          sliding_window=sliding_window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),
         interpret=interpret,

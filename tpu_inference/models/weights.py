@@ -218,8 +218,8 @@ def config_from_hf(path: str) -> ModelConfig:
         n_experts_per_tok=hf.get("num_experts_per_tok", 2),
         # Mistral-style SWA; HF uses null for "no window" (v0.2+), and
         # mixtral configs carry the field without the models using it.
-        sliding_window=int(hf.get("sliding_window") or 0
-                           if model_type == "mistral" else 0),
+        sliding_window=(int(hf.get("sliding_window") or 0)
+                        if model_type == "mistral" else 0),
         dtype=dtype)
 
 
