@@ -5,7 +5,8 @@ feature is a sliding attention window (each token attends to itself and
 the window-1 tokens before it). Tests pin: the mask semantics against a
 naive numpy oracle, engine serving equality with a windowed full-forward
 oracle (prefill + paged decode both windowed), the HF config mapping,
-and the backend routing guards (Pallas kernels don't window yet)."""
+and the window-aware Pallas kernels (decode + prefill) against the
+dense reference on both KV tiers."""
 
 import json
 
@@ -170,8 +171,8 @@ def test_windowed_paged_decode_kernel_matches_dense(kv_quant):
 
 
 def test_swa_pallas_engine_matches_dense_engine():
-    """Serving with the windowed Pallas decode (prefill on the masked
-    dense path) produces exactly the dense backend's tokens."""
+    """Serving on the full windowed Pallas path (flash prefill + paged
+    decode) produces exactly the dense backend's tokens."""
     cfg = _swa_cfg(8)
     ecfg = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
                 max_batch_size=2, prefill_buckets=(16, 32))
@@ -200,3 +201,52 @@ def test_swa_sp_mesh_rejected_before_weights_load():
     mesh = build_mesh(ParallelConfig(sp=2))
     with pytest.raises(ValueError, match="sp=1"):
         InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+def test_windowed_paged_prefill_kernel_matches_dense(kv_quant):
+    """The windowed Pallas prefill (per-query-block relative pages) ==
+    the window-masked dense reference, including a chunked-prefill
+    q_offset > 0 and the int8 pool."""
+    from tpu_inference.engine import kv_cache as kvc
+    from tpu_inference.kernels.prefill_attention import (
+        paged_prefill_attention)
+
+    rng = np.random.default_rng(13)
+    page, mp, hq, hkv, d, window = 8, 8, 4, 2, 16, 10
+    b, s = 2, 24                 # current chunk length
+    q_off = np.array([0, 16], np.int32)      # fresh + continued chunk
+    kv_lens = q_off + s
+    n_pages = 40
+    k_pool = rng.standard_normal((n_pages, page, hkv, d)).astype(np.float32)
+    v_pool = rng.standard_normal((n_pages, page, hkv, d)).astype(np.float32)
+    bt = rng.permutation(np.arange(1, 1 + b * mp)).reshape(b, mp).astype(
+        np.int32)
+    q = rng.standard_normal((b, s, hq, d)).astype(np.float32)
+
+    ks = vs = None
+    if kv_quant == "int8":
+        kq, ks_ = kvc.quantize_kv(jnp.asarray(k_pool))
+        vq, vs_ = kvc.quantize_kv(jnp.asarray(v_pool))
+        k_in, v_in, ks, vs = kq, vq, ks_, vs_
+        k_pool = np.asarray(kq, np.float32) * np.asarray(ks_)[..., None]
+        v_pool = np.asarray(vq, np.float32) * np.asarray(vs_)[..., None]
+    else:
+        k_in, v_in = jnp.asarray(k_pool), jnp.asarray(v_pool)
+
+    got = paged_prefill_attention(
+        jnp.asarray(q), k_in, v_in, jnp.asarray(bt), jnp.asarray(kv_lens),
+        jnp.asarray(q_off), ks, vs, block_q=8, sliding_window=window,
+        interpret=True)
+
+    for i in range(b):
+        n = int(kv_lens[i])
+        flat = np.concatenate([k_pool[bt[i, j]] for j in range(mp)])[:n]
+        flatv = np.concatenate([v_pool[bt[i, j]] for j in range(mp)])[:n]
+        want = common.dense_causal_attention(
+            jnp.asarray(q[i][None]), jnp.asarray(flat[None]),
+            jnp.asarray(flatv[None]), q_offset=int(q_off[i]), kv_len=n,
+            sliding_window=window)
+        np.testing.assert_allclose(np.asarray(got[i]), np.asarray(want[0]),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"seq {i} q_off {q_off[i]}")

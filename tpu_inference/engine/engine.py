@@ -134,18 +134,20 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
     def _pallas_prefill(q, kv: KVPages, layer_idx):
         from tpu_inference.kernels.prefill_attention import (
             paged_prefill_attention)
+        win = cfg.sliding_window
         if mesh is None:
             ks, vs = _scales(kv, layer_idx)
             return paged_prefill_attention(q, kv.k[layer_idx],
                                            kv.v[layer_idx], block_tables,
-                                           kv_len, q_offset, ks, vs)
+                                           kv_len, q_offset, ks, vs,
+                                           sliding_window=win)
         from jax.sharding import PartitionSpec as P
         head_p = P(None, None, "tp", None)             # q/out [B, S, H*, D]
 
         def kernel(q_, bt_, kl_, qo_, k_, v_, *scales):
             ks_, vs_ = scales if scales else (None, None)
             return paged_prefill_attention(q_, k_, v_, bt_, kl_, qo_,
-                                           ks_, vs_)
+                                           ks_, vs_, sliding_window=win)
 
         return _sharded_paged_call(
             kernel, kv, layer_idx,
@@ -161,12 +163,10 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             # Fresh full-prompt chunk: attention is pure self-attention
             # over (q, k, v) — no need to read back through the pool.
             return _sp_prefill(q, k, v), kv
-        if (attn_backend == "pallas" and q.shape[1] > 1
-                and not cfg.sliding_window):
-            # Flash prefill over pool pages: O(S·page) memory, no gather.
-            # (SWA prefill routes to the window-masked dense path below —
-            # prefill is one-shot per request; windowed DECODE, the
-            # steady state, stays on the Pallas kernel.)
+        if attn_backend == "pallas" and q.shape[1] > 1:
+            # Flash prefill over pool pages: O(S·page) memory, no gather
+            # (window-aware when cfg.sliding_window is set: each query
+            # block touches O(block+window) pages).
             return _pallas_prefill(q, kv, layer_idx), kv
         k_all, v_all = kvc.gather_kv(kv, layer_idx, block_tables)
         out = dense_causal_attention(q, k_all, v_all, q_offset=q_offset,

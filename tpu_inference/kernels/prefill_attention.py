@@ -15,8 +15,11 @@ Layout mirrors the decode kernel (kernels/paged_attention.py): grid
 whole query block for every kv head — q viewed [Hkv, bq*R, D] so each
 page contributes one head-batched [bq*R, pg] MXU contraction per head.
 Causality and cache validity fuse into one mask (k_pos <= q_pos and
-k_pos < kv_len); pages entirely in the causal future or past kv_len are
-skipped via @pl.when.
+k_pos < kv_len, plus k_pos > q_pos - sliding_window for SWA models);
+pages entirely in the causal future or past kv_len are skipped via
+@pl.when. With a sliding window the page axis is RELATIVE per query
+block (scalar-prefetch index maps offset from the block's window
+start), so each block touches O(block_q + window) pages, not O(S).
 
 Reference has no analogue (client-only, SURVEY.md §0); this is the
 prefill half of the vLLM-style PagedAttention pair, re-designed for
@@ -37,7 +40,7 @@ NEG_INF = -1e30
 
 def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
                     v_ref, *rest, page_size: int, block_q: int, n_rep: int,
-                    scale: float, quantized: bool):
+                    scale: float, quantized: bool, sliding_window: int = 0):
     if quantized:
         ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -55,9 +58,17 @@ def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
 
     kv_len = kv_len_ref[b]
     q_off = q_offset_ref[b]
-    page_start = p * page_size
+    q_lo = q_off + qb * block_q
+    if sliding_window:
+        # Page index is RELATIVE to the first page this query block's
+        # window can reach (BlockSpec index maps apply the same offset):
+        # pages touched per block are O(block_q + window), not O(S).
+        win_first = jnp.maximum(q_lo - sliding_window + 1, 0)
+        page_start = (win_first // page_size + p) * page_size
+    else:
+        page_start = p * page_size
     # Highest query position in this block; later pages are all-masked.
-    q_hi = q_off + qb * block_q + block_q - 1
+    q_hi = q_lo + block_q - 1
 
     @pl.when((page_start < kv_len) & (page_start <= q_hi))
     def _accumulate():
@@ -74,9 +85,12 @@ def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale   # [Hkv, bq*R, pg]
         row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // n_rep
-        q_pos = q_off + qb * block_q + row
+        q_pos = q_lo + row
         k_pos = page_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        s = jnp.where((k_pos <= q_pos) & (k_pos < kv_len), s, NEG_INF)
+        valid = (k_pos <= q_pos) & (k_pos < kv_len)
+        if sliding_window:
+            valid &= k_pos > q_pos - sliding_window
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[:]                                 # [Hkv, bq*R, 1]
         l_prev = l_ref[:]
@@ -99,14 +113,16 @@ def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
         out_ref[0, 0] = (acc_ref[:] / denom).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block_q", "interpret",
+                                             "sliding_window"))
 def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
                             v_pages: jax.Array, block_tables: jax.Array,
                             kv_len: jax.Array, q_offset: jax.Array,
                             k_scale: jax.Array | None = None,
                             v_scale: jax.Array | None = None,
                             block_q: int = 128,
-                            interpret: bool | None = None) -> jax.Array:
+                            interpret: bool | None = None,
+                            sliding_window: int = 0) -> jax.Array:
     """Prefill attention over the paged KV pool.
 
     q:            [B, S, Hq, D]  (the current chunk's queries)
@@ -138,8 +154,26 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
            .transpose(0, 1, 3, 2, 4, 5)
            .reshape(b, n_qb, hkv, bq * n_rep, d))
 
+    if sliding_window:
+        # A query block's window reaches back window-1 positions from
+        # its first query and forward to its last: bq + window - 1
+        # positions -> at most that many pages + 1 for misalignment.
+        n_page_axis = min(mp, -(-(bq + sliding_window - 1) // page_size) + 1)
+
+        def page_idx(i, qb, p, bt, kl, qo):
+            first = jnp.maximum(qo[i] + qb * bq - sliding_window + 1, 0)
+            # Clamp: relative pages past the block table are compute-
+            # masked in the kernel; the DMA just needs a legal id.
+            return bt[i, jnp.minimum(first // page_size + p, mp - 1)]
+    else:
+        n_page_axis = mp
+
+        def page_idx(i, qb, p, bt, kl, qo):
+            return bt[i, p]
+
     page_spec = pl.BlockSpec((1, page_size, hkv, d),
-                             lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0, 0))
+                             lambda i, qb, p, bt, kl, qo: (
+                                 page_idx(i, qb, p, bt, kl, qo), 0, 0, 0))
     in_specs = [
         pl.BlockSpec((1, 1, hkv, bq * n_rep, d),
                      lambda i, qb, p, bt, kl, qo: (i, qb, 0, 0, 0)),
@@ -150,13 +184,14 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
     if quantized:
         scale_spec = pl.BlockSpec(
             (1, page_size, hkv),
-            lambda i, qb, p, bt, kl, qo: (bt[i, p], 0, 0))
+            lambda i, qb, p, bt, kl, qo: (
+                page_idx(i, qb, p, bt, kl, qo), 0, 0))
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,        # block_tables, kv_len, q_offset
-        grid=(b, n_qb, mp),
+        grid=(b, n_qb, n_page_axis),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, hkv, bq * n_rep, d),
@@ -169,7 +204,8 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_prefill_kernel, page_size=page_size, block_q=bq,
-                          n_rep=n_rep, scale=scale, quantized=quantized),
+                          n_rep=n_rep, scale=scale, quantized=quantized,
+                          sliding_window=sliding_window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_qb, hkv, bq * n_rep, d),
                                        q.dtype),
