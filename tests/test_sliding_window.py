@@ -250,3 +250,61 @@ def test_windowed_paged_prefill_kernel_matches_dense(kv_quant):
         np.testing.assert_allclose(np.asarray(got[i]), np.asarray(want[0]),
                                    rtol=2e-5, atol=2e-5,
                                    err_msg=f"seq {i} q_off {q_off[i]}")
+
+
+def test_swa_disables_prefix_cache():
+    """SWA + prefix caching don't compose (evicted holes in cached
+    prefixes); the engine makes the vLLM-style exclusion and turns on
+    behind-window eviction instead."""
+    eng = InferenceEngine(_swa_cfg(8), cfgs.EngineConfig(
+        page_size=8, num_pages=32, max_pages_per_seq=4, max_batch_size=2,
+        prefill_buckets=(16,), enable_prefix_cache=True), seed=0)
+    assert eng.prefix_cache is None
+    assert eng.swa_evict
+
+
+def test_swa_eviction_bounds_live_pages_and_preserves_tokens():
+    """A sequence decoding far past its window holds O(window) live KV
+    pages (behind-window pages return to the pool mid-flight), and the
+    tokens still match the windowed full-forward oracle."""
+    from tpu_inference.engine.engine import Sequence
+
+    window, page = 8, 8
+    cfg = _swa_cfg(window)
+    ecfg = cfgs.EngineConfig(page_size=page, num_pages=64,
+                             max_pages_per_seq=16, max_batch_size=2,
+                             prefill_buckets=(16, 32))
+    params, mod = build_model(cfg, seed=0)
+    engine = InferenceEngine(cfg, ecfg, params=params)
+    assert engine.swa_evict
+
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 256, size=20).tolist()
+    seq = Sequence(request_id=0, prompt_tokens=prompt, max_new_tokens=40)
+    free_at_prefill = engine.allocator.num_free
+    engine.prefill(seq)
+    max_live = 0
+    while engine.active_sequences():
+        engine.decode_step()
+        live = sum(1 for p in seq.pages if p)
+        max_live = max(max_live, live)
+    # Window spans at most ceil(W/page)+1 pages; +1 more for the page
+    # being written at the head.
+    assert max_live <= -(-window // page) + 2, max_live
+    # Behind-window pages really went back to the pool mid-flight: at
+    # the end the sequence holds far fewer than its ctx would need.
+    assert sum(1 for p in seq.pages if p) < (seq.ctx_len // page)
+
+    got = list(seq.generated)
+    engine.release(seq)
+    assert engine.allocator.num_free == free_at_prefill
+
+    # Token equality with the windowed no-cache oracle.
+    attn = common.make_dense_attn(sliding_window=window)
+    toks = list(prompt)
+    for _ in range(40):
+        t = jnp.asarray(np.array(toks)[None])
+        pos = jnp.broadcast_to(jnp.arange(len(toks)), (1, len(toks)))
+        logits, _ = mod.forward(params, cfg, t, pos, None, attn)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    assert got == toks[len(prompt):]

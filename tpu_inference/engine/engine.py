@@ -198,6 +198,9 @@ class Sequence:
     slot: int = -1
     pages: List[int] = dataclasses.field(default_factory=list)
     ctx_len: int = 0                       # tokens currently in KV
+    # SWA eviction cursor: pages[:evicted_pages] are behind the window,
+    # freed, and zeroed (engine._evict_behind_window).
+    evicted_pages: int = 0
     cached_tokens: int = 0                 # prefix-cache hit length
     # Incremental multi-chunk prefill state (prefill_begin/prefill_step).
     prefill_prompt: Optional[List[int]] = None
@@ -308,7 +311,12 @@ class InferenceEngine:
         # full pages below ctx_len, where every row in BOTH pools is
         # settled. Reusing a cached page therefore reuses a valid draft
         # twin for free.
-        if engine_cfg.enable_prefix_cache:
+        if engine_cfg.enable_prefix_cache and not model_cfg.sliding_window:
+            # SWA models run WITHOUT the prefix cache (vLLM makes the
+            # same exclusion): behind-window pages are evicted while a
+            # sequence runs (_evict_behind_window), and a cached prefix
+            # with holes would hand garbage KV to a shorter follow-up
+            # request whose own window lands inside the evicted region.
             from tpu_inference.engine.prefix_cache import PrefixCache
             self.prefix_cache = PrefixCache(self.allocator,
                                             engine_cfg.page_size)
@@ -370,6 +378,12 @@ class InferenceEngine:
         self.spec_enabled = spec_on
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # Behind-window page eviction (SWA): a running sequence holds
+        # O(window) KV pages instead of O(context). Off under spec
+        # decode — a window-less DRAFT model still attends to the full
+        # context, so the target's behind-window pages stay live.
+        self.swa_evict = (bool(model_cfg.sliding_window)
+                          and self.prefix_cache is None and not spec_on)
         if self.spec_enabled:
             assert draft_cfg.vocab_size == model_cfg.vocab_size, \
                 "draft and target must share a tokenizer/vocab"
@@ -1002,6 +1016,27 @@ class InferenceEngine:
             seq.done, seq.finish_reason = True, "length"
         if seq.done:
             seq.finish_time = time.perf_counter()
+        elif self.swa_evict:
+            self._evict_behind_window(seq)
+
+    def _evict_behind_window(self, seq: Sequence) -> None:
+        """Free KV pages entirely behind the sliding window; the block-
+        table slot becomes the trash page (0). No windowed reader ever
+        touches them: the Pallas kernels' page grids start at the
+        window's first page, and the dense path gathers-then-masks.
+        In-flight dispatch-ahead calls staged with higher predicted ctx
+        have even later window starts, so reuse-after-free can't race a
+        reader. The per-sequence cursor makes total work O(pages freed)
+        over a sequence's life, not O(pages) per accepted token."""
+        win = self.model_cfg.sliding_window
+        first_needed = max(0, seq.ctx_len - win) // self.engine_cfg.page_size
+        j = seq.evicted_pages
+        while j < min(first_needed, len(seq.pages)):
+            if seq.pages[j]:
+                self.allocator.free([seq.pages[j]])
+                seq.pages[j] = 0
+            j += 1
+        seq.evicted_pages = j
 
     def release(self, seq: Sequence) -> None:
         """Free a finished sequence's pages and slot, publishing its full
