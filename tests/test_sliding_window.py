@@ -308,3 +308,67 @@ def test_swa_eviction_bounds_live_pages_and_preserves_tokens():
         logits, _ = mod.forward(params, cfg, t, pos, None, attn)
         toks.append(int(jnp.argmax(logits[0, -1])))
     assert got == toks[len(prompt):]
+
+
+def test_mistral_preset_registered():
+    """'mistral' is what the reference's endpoint served; the preset
+    carries its sliding window into the windowed serving path."""
+    cfg = cfgs.PRESETS["mistral-7b"]()
+    assert cfg.sliding_window == 4096 and cfg.family == "llama"
+    from tpu_inference.engine.autosize import auto_size
+
+    # And it sizes onto one 16 GB chip with int8 (the reference's
+    # Ollama served it quantized too).
+    sz = auto_size(cfg, hbm_bytes=16e9, quant="int8", kv_quant="int8")
+    assert sz.max_batch_size >= 8
+
+
+def test_spec_decode_serves_swa_target():
+    """Speculative decoding with a window-less draft over an SWA target:
+    emitted tokens must equal the plain SWA engine's (the verify pass
+    windows the target's logits; rejection sampling is exact)."""
+    import dataclasses
+
+    cfg = _swa_cfg(8)
+    params, _ = build_model(cfg, seed=0)
+    base_kw = dict(page_size=8, num_pages=96, max_pages_per_seq=8,
+                   max_batch_size=2, prefill_buckets=(16, 32))
+    plain = InferenceEngine(cfg, cfgs.EngineConfig(**base_kw),
+                            params=params)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (6, 18)]
+    want = plain.generate(prompts, max_new_tokens=12)
+
+    draft_cfg = dataclasses.replace(cfg, name="draft", n_layers=1,
+                                    sliding_window=0)
+    draft_params, _ = build_model(draft_cfg, seed=9)
+    spec = InferenceEngine(
+        cfg, cfgs.EngineConfig(**base_kw, num_speculative_tokens=3),
+        params=params, draft_cfg=draft_cfg, draft_params=draft_params)
+    assert not spec.swa_evict        # window-less draft reads full ctx
+    got = spec.generate(prompts, max_new_tokens=12)
+    assert got == want
+
+
+def test_swa_admission_reserves_window_not_generation():
+    """Admission must charge an SWA-evict sequence its true peak (full
+    prompt at prefill, O(window) during decode) — not prompt+max_new.
+    A long-generation Mistral-style request fits a small pool."""
+    from tpu_inference.engine.engine import Sequence
+
+    cfg = _swa_cfg(8)
+    ecfg = cfgs.EngineConfig(page_size=8, num_pages=16, max_pages_per_seq=8,
+                             max_batch_size=1, prefill_buckets=(16,),
+                             max_new_tokens=512)
+    eng = InferenceEngine(cfg, ecfg, seed=0)
+    seq = Sequence(request_id=0, prompt_tokens=list(range(1, 11)),
+                   max_new_tokens=500)     # 510 tokens = 64 pages naively
+    assert eng._pages_reserved(seq) <= 5   # window span + margins
+    assert eng.can_ever_admit(seq)
+    # And it actually serves to completion inside the 15-page pool.
+    eng.prefill(seq)
+    while eng.active_sequences():
+        eng.decode_steps()
+    assert seq.finish_reason in ("stop", "length"), seq.finish_reason
+    assert len(seq.generated) > 50         # decoded far past the pool's
+    eng.release(seq)                       # naive capacity

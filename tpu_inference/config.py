@@ -97,6 +97,19 @@ def mixtral_8x7b() -> ModelConfig:
     )
 
 
+def mistral_7b() -> ModelConfig:
+    """Mistral-7B-v0.1 — the model the reference's Ollama endpoint
+    actually served (reference: traffic_generator/main.py:308 config
+    'model': 'mistral'). Its signature sliding window flows through the
+    window-aware serving path (dense mask + windowed Pallas kernels +
+    behind-window page eviction)."""
+    return ModelConfig(
+        name="mistral-7b", family="llama", vocab_size=32000, d_model=4096,
+        n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        max_seq_len=8192, rope_theta=10000.0, sliding_window=4096,
+    )
+
+
 def gpt2_small() -> ModelConfig:
     return ModelConfig(
         name="gpt2", family="gpt2", vocab_size=50257, d_model=768,
@@ -137,6 +150,7 @@ PRESETS = {
     "llama-3-8b": llama3_8b,
     "llama-3-70b": llama3_70b,
     "mixtral-8x7b": mixtral_8x7b,
+    "mistral-7b": mistral_7b,
     "gpt2": gpt2_small,
     "tiny-llama": tiny_llama,
     "tiny-mixtral": tiny_mixtral,

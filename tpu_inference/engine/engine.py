@@ -320,6 +320,11 @@ class InferenceEngine:
             from tpu_inference.engine.prefix_cache import PrefixCache
             self.prefix_cache = PrefixCache(self.allocator,
                                             engine_cfg.page_size)
+        elif engine_cfg.enable_prefix_cache:
+            print(f"[engine] {model_cfg.name}: prefix cache disabled — "
+                  f"sliding_window={model_cfg.sliding_window} evicts "
+                  "behind-window pages, which doesn't compose with "
+                  "cached prefixes (multi-turn requests re-prefill)")
         self.max_pages = engine_cfg.max_pages_per_seq
         self._base_key = jax.random.PRNGKey(seed)
         self._step_count = 0
@@ -709,10 +714,28 @@ class InferenceEngine:
 
     def _pages_reserved(self, seq: Sequence) -> int:
         """Worst-case page need for admission control (capped at the
-        per-sequence maximum, since ctx is clamped to max_context)."""
-        need = kvc.pages_needed(
-            len(seq.prompt_tokens) + seq.max_new_tokens,
-            self.engine_cfg.page_size)
+        per-sequence maximum, since ctx is clamped to max_context).
+
+        With behind-window eviction the worst case is NOT prompt +
+        max_new: live pages peak at the full prompt during prefill (no
+        eviction until the first decode token), then drop to the
+        window's span (+1 for the head page being written, +1 for
+        window/page misalignment) — long-generation requests must not
+        be queued for capacity they will never hold."""
+        ecfg = self.engine_cfg
+        total = len(seq.prompt_tokens) + seq.max_new_tokens
+        need = kvc.pages_needed(total, ecfg.page_size)
+        if self.swa_evict:
+            # Dispatch-ahead can grant depth*K tokens of head pages
+            # before eviction (at the fold) catches up — include them.
+            win = self.model_cfg.sliding_window
+            ahead = (ecfg.decode_steps_per_call
+                     * max(1, ecfg.decode_pipeline_depth))
+            window_span = -(-(win + ahead) // ecfg.page_size) + 2
+            prefill_peak = kvc.pages_needed(
+                min(len(seq.prompt_tokens), ecfg.max_context),
+                ecfg.page_size)
+            need = min(need, max(window_span, prefill_peak))
         return min(need, self.max_pages)
 
     def _free_plus_evictable(self) -> int:
