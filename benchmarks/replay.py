@@ -94,6 +94,7 @@ def start_server(args) -> tuple:
 
     srv = build_server(
         model=args.model, tokenizer=args.tokenizer, tp=args.tp,
+        sp=args.sp, sp_attn=args.sp_attn,
         draft_model=args.draft_model, checkpoint=args.checkpoint,
         draft_checkpoint=args.draft_checkpoint,
         warmup=not args.no_warmup,
@@ -145,6 +146,9 @@ def main() -> dict:
     p.add_argument("--tokenizer", default="byte")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel prefill degree")
+    p.add_argument("--sp-attn", default="ring", choices=("ring", "ulysses"))
     p.add_argument("--draft-model", default=None)
     p.add_argument("--draft-checkpoint", default=None)
     p.add_argument("--num-speculative-tokens", type=int, default=4)
@@ -173,7 +177,7 @@ def main() -> dict:
     p.add_argument("--platform", default="auto",
                    choices=("auto", "cpu", "tpu"),
                    help="jax platform; 'cpu' forces the CPU backend "
-                        "(tp virtual devices) before any computation")
+                        "(tp*sp virtual devices) before any computation")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     args = p.parse_args()
@@ -186,7 +190,8 @@ def main() -> dict:
 
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu":
-            jax.config.update("jax_num_cpu_devices", max(1, args.tp))
+            jax.config.update("jax_num_cpu_devices",
+                              max(1, args.tp * args.sp))
 
     from tpu_inference.engine.autosize import resolve_sizing_args
 
@@ -212,7 +217,7 @@ def main() -> dict:
         t0 = time.perf_counter()
         metrics = gen.start_profile()
         replay_s = time.perf_counter() - t0
-        summary = summarize(metrics, n_chips=args.tp)
+        summary = summarize(metrics, n_chips=args.tp * args.sp)
         summary["replay_s"] = round(replay_s, 3)
         summary["server_stats"] = srv.group.stats_snapshot()
     finally:
