@@ -84,3 +84,40 @@ def test_target_ctx_shapes_batch():
     narrow = autosize.auto_size(llama_1b(), hbm_bytes=16e9, batch_cap=512,
                                 target_ctx=2048)
     assert wide.max_batch_size > narrow.max_batch_size
+
+
+def test_swa_model_batches_by_window_not_context():
+    """Behind-window eviction caps live KV at ~window tokens, so auto
+    sizing serves a bigger batch for an SWA model than for the same
+    architecture with full attention."""
+    import dataclasses
+
+    from tpu_inference.config import PRESETS
+
+    mistral = PRESETS["mistral-7b"]()
+    full = dataclasses.replace(mistral, sliding_window=0)
+    # Long-context serving geometry (target ctx 8192 > the 4096 window):
+    # full attention must budget the whole context per sequence, SWA
+    # only the window.
+    kw = dict(hbm_bytes=16e9, quant="int8", kv_quant="int8",
+              max_pages_per_seq=1024, batch_cap=256)
+    swa_sz = autosize.auto_size(mistral, **kw)
+    full_sz = autosize.auto_size(full, **kw)
+    assert swa_sz.max_batch_size > full_sz.max_batch_size
+    assert swa_sz.target_ctx <= mistral.sliding_window + 32
+
+
+def test_swa_clamp_off_under_speculative_decoding():
+    """Spec decode disables behind-window eviction (the window-less
+    draft reads full context), so the SWA batch clamp must not apply."""
+    import dataclasses
+
+    from tpu_inference.config import PRESETS
+
+    mistral = PRESETS["mistral-7b"]()
+    full = dataclasses.replace(mistral, sliding_window=0)
+    kw = dict(hbm_bytes=16e9, quant="int8", kv_quant="int8",
+              max_pages_per_seq=1024, batch_cap=256)
+    spec_sz = autosize.auto_size(mistral, speculative=True, **kw)
+    full_sz = autosize.auto_size(full, **kw)
+    assert spec_sz.max_batch_size == full_sz.max_batch_size

@@ -107,7 +107,8 @@ def auto_size(model_cfg, *, hbm_bytes: Optional[float] = None,
               page_size: int = 16, max_pages_per_seq: int = 64,
               target_ctx: Optional[int] = None, batch_cap: int = 32,
               reserve_frac: float = 0.15,
-              activation_headroom: int = 512 << 20) -> AutoSizing:
+              activation_headroom: int = 512 << 20,
+              speculative: bool = False) -> AutoSizing:
     """Size ``max_batch_size`` and ``num_pages`` for the chip.
 
     Raises ValueError when the weights alone exceed the per-chip budget
@@ -143,6 +144,17 @@ def auto_size(model_cfg, *, hbm_bytes: Optional[float] = None,
     ctx = int(target_ctx) if target_ctx else (page_size * max_pages_per_seq
                                               // 2)
     ctx = max(1, min(ctx, page_size * max_pages_per_seq))
+    win = getattr(model_cfg, "sliding_window", 0)
+    if win and not speculative:
+        # (Only when eviction will actually run: spec decode disables it
+        # — a window-less draft reads the full context, so each running
+        # sequence keeps O(context) pages; see engine.swa_evict.)
+        # Behind-window eviction (engine._evict_behind_window) caps a
+        # running SWA sequence's live KV at ~window tokens — batch
+        # sizes against that, not the full context. (The prefill peak
+        # briefly holds the whole prompt; the page-span margin covers
+        # typical prompts, and admission charges the true peak.)
+        ctx = min(ctx, win + 2 * page_size)
     batch = max(1, min(batch_cap, tokens // ctx))
     return AutoSizing(
         max_batch_size=batch, num_pages=num_pages, hbm_bytes=int(hbm),
@@ -218,7 +230,8 @@ def resolve_sizing_args(args) -> tuple:
         kv_quant=args.kv_quant, tp=args.tp, page_size=args.page_size,
         max_pages_per_seq=args.max_pages_per_seq,
         target_ctx=getattr(args, "target_ctx", 0) or None,
-        batch_cap=getattr(args, "batch_cap", 32))
+        batch_cap=getattr(args, "batch_cap", 32),
+        speculative=bool(getattr(args, "draft_model", None)))
     if mbs == "auto":
         mbs = sz.max_batch_size
     if pages == "auto":
