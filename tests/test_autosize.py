@@ -121,3 +121,21 @@ def test_swa_clamp_off_under_speculative_decoding():
     spec_sz = autosize.auto_size(mistral, speculative=True, **kw)
     full_sz = autosize.auto_size(full, **kw)
     assert spec_sz.max_batch_size == full_sz.max_batch_size
+
+
+def test_int_or_auto_argparse_type():
+    import argparse
+
+    assert autosize.int_or_auto("auto") == "auto"
+    assert autosize.int_or_auto("16") == 16
+    with pytest.raises(argparse.ArgumentTypeError, match="auto"):
+        autosize.int_or_auto("8x")
+
+
+def test_resolve_sizing_args_noop_on_ints():
+    """No 'auto' -> no model resolution, no device probe: the values
+    pass through untouched (the CLI fast path)."""
+    import types
+
+    args = types.SimpleNamespace(max_batch_size=8, num_pages=512)
+    assert autosize.resolve_sizing_args(args) == (8, 512)
