@@ -137,6 +137,14 @@ def tiny_mixtral(vocab_size: int = 512) -> ModelConfig:
     )
 
 
+def tiny_mistral(vocab_size: int = 512) -> ModelConfig:
+    """Small Mistral-style model (tiny_llama + sliding window): exercises
+    the full SWA serving path — windowed masks/kernels, behind-window
+    eviction, SWA x sp composition — without a checkpoint."""
+    return dataclasses.replace(tiny_llama(vocab_size), name="tiny-mistral",
+                               sliding_window=64)
+
+
 def tiny_gpt2(vocab_size: int = 512) -> ModelConfig:
     return ModelConfig(
         name="tiny-gpt2", family="gpt2", vocab_size=vocab_size, d_model=128,
@@ -154,6 +162,7 @@ PRESETS = {
     "gpt2": gpt2_small,
     "tiny-llama": tiny_llama,
     "tiny-mixtral": tiny_mixtral,
+    "tiny-mistral": tiny_mistral,
     "tiny-gpt2": tiny_gpt2,
 }
 

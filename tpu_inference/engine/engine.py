@@ -64,7 +64,9 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
     via two all-to-alls and attends full-sequence per head group
     (kernels/ulysses_attention.py, fewer collective hops, needs head
     counts divisible by sp). Valid only for a fresh full-prompt chunk
-    (no cached prefix); the engine routes eligible prefills here.
+    (no cached prefix); the engine routes eligible prefills here. Both
+    kernels apply ``cfg.sliding_window`` when set, so SWA models (Mistral)
+    compose with sequence parallelism.
     """
     from tpu_inference.models.common import dense_causal_attention
 
@@ -82,7 +84,8 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
 
         spec = P(None, "sp", "tp", None)       # [B, S, H, D]: seq × heads
         return jax.shard_map(
-            _partial(sp_local, axis_name="sp"),
+            _partial(sp_local, axis_name="sp",
+                     sliding_window=cfg.sliding_window),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False)(q, k, v)
 
@@ -245,14 +248,6 @@ class InferenceEngine:
         if backend not in ("dense", "pallas"):
             raise ValueError(f"unknown attn_backend {backend!r}; "
                              "expected 'auto', 'dense' or 'pallas'")
-        if (model_cfg.sliding_window and mesh is not None
-                and int(mesh.shape.get("sp", 1)) > 1):
-            # Before materializing params — a 70B-scale load must not
-            # run for minutes just to hit a config error.
-            raise ValueError(
-                f"{model_cfg.name}: sequence-parallel prefill doesn't "
-                "apply sliding_window masks yet; serve SWA models with "
-                "sp=1")
         # Validate mesh compatibility BEFORE materializing params —
         # at 70B scale a post-init failure wastes minutes (or OOMs).
         if mesh is not None:

@@ -34,11 +34,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_pos, k_pos, scale, n_rep):
+def _block_attend(q, k, v, q_pos, k_pos, scale, n_rep, sliding_window=0):
     """One (local Q) x (visiting KV chunk) block: masked scores + partial
     softmax stats. q: [B,Sq,Hq,D] f32; k/v: [B,Sk,Hkv,D] raw dtype (GQA
     expansion + f32 upcast happen here, per block, so the ring rotates the
-    small raw shards). Returns (m [B,H,Sq], l [B,H,Sq], o [B,Sq,H,D])."""
+    small raw shards). ``sliding_window`` > 0 additionally masks keys more
+    than window-1 positions behind the query (matches
+    models.common.dense_causal_attention). Returns (m [B,H,Sq],
+    l [B,H,Sq], o [B,Sq,H,D])."""
     if n_rep != 1:
         k = jnp.repeat(k, n_rep, axis=2)
         v = jnp.repeat(v, n_rep, axis=2)
@@ -46,6 +49,9 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, n_rep):
     v = v.astype(jnp.float32)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    if sliding_window:
+        mask &= (k_pos[None, None, None, :]
+                 > q_pos[None, None, :, None] - sliding_window)
     s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1)                              # [B, H, Sq]
     # exp(NEG_INF - NEG_INF) = 1 on fully-masked rows; zero them via mask.
@@ -56,10 +62,14 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, n_rep):
 
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                         axis_name: str = "sp") -> jax.Array:
+                         axis_name: str = "sp",
+                         sliding_window: int = 0) -> jax.Array:
     """Per-shard body; call under shard_map with the sequence dim sharded
     over ``axis_name``. q: [B, S_loc, Hq, D]; k/v: [B, S_loc, Hkv, D]
-    (GQA expanded internally). Returns [B, S_loc, Hq, D] in q.dtype."""
+    (GQA expanded internally). ``sliding_window`` > 0 applies the SWA
+    mask (each query sees itself + the window-1 tokens before it); fully
+    behind-window chunks skip their einsums just like fully-future ones.
+    Returns [B, S_loc, Hq, D] in q.dtype."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, hq, d = q.shape
@@ -83,7 +93,8 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
 
         def attend(ops):
             kc, vc = ops
-            return _block_attend(qf, kc, vc, q_pos, k_pos, scale, n_rep)
+            return _block_attend(qf, kc, vc, q_pos, k_pos, scale, n_rep,
+                                 sliding_window)
 
         def skip(ops):
             # Mark the constants as device-varying so both cond branches
@@ -104,8 +115,13 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         # is set by the busiest device, but ~half the fleet-wide FLOPs and
         # energy go away). A zigzag shard layout would balance the load
         # too; that changes the caller-visible sharding, so not done here.
-        fully_future = src * s_loc > q_pos[-1]
-        m_blk, l_blk, o_blk = jax.lax.cond(fully_future, skip, attend,
+        # Under SWA, chunks entirely behind every local query's window are
+        # equally dead: max k_pos <= min(q_pos) - window.
+        skippable = src * s_loc > q_pos[-1]
+        if sliding_window:
+            skippable |= (src * s_loc + s_loc - 1
+                          <= q_pos[0] - sliding_window)
+        m_blk, l_blk, o_blk = jax.lax.cond(skippable, skip, attend,
                                            (k_cur, v_cur))
         m_new = jnp.maximum(m, m_blk)
         a_prev = jnp.exp(m - m_new)
@@ -122,13 +138,15 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     return (acc / denom).astype(q.dtype)
 
 
-def seq_sharded_call(body, q, k, v, mesh: Mesh, axis_name: str):
+def seq_sharded_call(body, q, k, v, mesh: Mesh, axis_name: str,
+                     sliding_window: int = 0):
     """Shared wrapper for sequence-parallel attention kernels: reshard
     q/k/v so the sequence dim shards over ``axis_name`` (batch/head dims
     replicated), run the per-shard ``body`` under shard_map, return with
     the same sequence sharding. Used by ring and ulysses."""
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(functools.partial(body, axis_name=axis_name),
+    fn = jax.shard_map(functools.partial(body, axis_name=axis_name,
+                                         sliding_window=sliding_window),
                        mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     sh = NamedSharding(mesh, spec)
@@ -136,12 +154,15 @@ def seq_sharded_call(body, q, k, v, mesh: Mesh, axis_name: str):
               jax.device_put(v, sh))
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "axis_name", "sliding_window"))
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   mesh: Mesh, axis_name: str = "sp") -> jax.Array:
+                   mesh: Mesh, axis_name: str = "sp",
+                   sliding_window: int = 0) -> jax.Array:
     """Full-sequence causal attention, sequence-sharded over ``axis_name``.
 
     q: [B, S, Hq, D]; k/v: [B, S, Hkv, D] with S divisible by the axis
-    size.
+    size. ``sliding_window`` > 0 applies the SWA mask (Mistral-style).
     """
-    return seq_sharded_call(ring_attention_local, q, k, v, mesh, axis_name)
+    return seq_sharded_call(ring_attention_local, q, k, v, mesh, axis_name,
+                            sliding_window)

@@ -44,11 +44,15 @@ import jax
 from jax.sharding import Mesh
 
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                            axis_name: str = "sp") -> jax.Array:
+                            axis_name: str = "sp",
+                            sliding_window: int = 0) -> jax.Array:
     """Per-shard body; call under shard_map with the sequence dim sharded
     over ``axis_name``. q: [B, S_loc, Hq, D]; k/v: [B, S_loc, Hkv, D].
     Requires the local Hq and Hkv to be divisible by the axis size.
-    Returns [B, S_loc, Hq, D] in q.dtype.
+    ``sliding_window`` > 0 applies the SWA mask (the head-sharded
+    attention sees the full sequence, so the window term needs no
+    cross-device bookkeeping at all). Returns [B, S_loc, Hq, D] in
+    q.dtype.
 
     The head-sharded attention IS the repo's correctness-reference
     attention (models.common.dense_causal_attention — GQA expansion,
@@ -61,7 +65,7 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     n = jax.lax.axis_size(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     if n == 1:
-        return dense_causal_attention(q, k, v)
+        return dense_causal_attention(q, k, v, sliding_window=sliding_window)
     assert hq % n == 0 and hkv % n == 0, (
         f"ulysses needs head counts divisible by the sp axis: "
         f"Hq={hq}, Hkv={hkv}, sp={n}")
@@ -71,17 +75,20 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     qg = a2a(q, split_axis=2, concat_axis=1)
     kg = a2a(k, split_axis=2, concat_axis=1)
     vg = a2a(v, split_axis=2, concat_axis=1)
-    out = dense_causal_attention(qg, kg, vg)       # returns q.dtype
+    out = dense_causal_attention(qg, kg, vg,       # returns q.dtype
+                                 sliding_window=sliding_window)
     # head-sharded -> seq-sharded (raw dtype on the wire).
     return a2a(out, split_axis=1, concat_axis=2)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis_name"))
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "axis_name", "sliding_window"))
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                      mesh: Mesh, axis_name: str = "sp") -> jax.Array:
+                      mesh: Mesh, axis_name: str = "sp",
+                      sliding_window: int = 0) -> jax.Array:
     """Full-sequence causal attention, sequence-sharded over
     ``axis_name`` (same call surface as kernels.ring_attention)."""
     from tpu_inference.kernels.ring_attention import seq_sharded_call
 
     return seq_sharded_call(ulysses_attention_local, q, k, v, mesh,
-                            axis_name)
+                            axis_name, sliding_window)
