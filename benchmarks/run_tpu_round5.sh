@@ -1,0 +1,87 @@
+#!/bin/bash
+# Round-5 TPU measurement battery (VERDICT r4 items 1-4). Stages run in
+# VALUE order so a mid-battery re-wedge still captures the headline:
+#   bench    hardened bench.py, pallas bf16/int8/dense lanes (BENCH_r05
+#            content; target: re-verify >=510 tok/s on the chip)
+#   mosaic   Mosaic-validate the window-aware Pallas kernels + SP
+#            wrappers non-interpret (VERDICT item 4; cheap)
+#   replay   saturated BurstGPT replay: real 1B ckpt, int8+int8, auto
+#            batch (VERDICT item 2: >=370 tok/s, TTFT p50 < 5 s)
+#   bench8b  BENCH_MODEL=8b int8 lane (BASELINE.md config-1 row)
+#   bench32  BENCH_BATCH=32 chip-sized batch lane
+#   sweep    decode_steps x pipeline-depth mini-sweep (hbm_util push)
+#
+#   bash benchmarks/run_tpu_round5.sh [stage ...]   # default: all
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/results
+STAGES=${@:-"bench mosaic replay bench8b bench32 sweep"}
+CKPT=/tmp/real-llama-1b
+
+probe() {
+  # Shared wedge-safe probe (bench.py child runner: own process group,
+  # SIGKILL on timeout — never orphans a runtime helper on the chip).
+  timeout -k 10 300 python -c "
+import json, sys, bench
+rc, rec = bench._run_child(['--probe'], 120)
+print(json.dumps(rec)) if rec else sys.exit(1)"
+}
+
+echo "== probe: $(probe || echo UNREACHABLE)"
+
+for s in $STAGES; do case $s in
+bench)
+  echo "== bench.py (3 lanes, headline)"
+  timeout 1100 python bench.py 2>benchmarks/results/bench_r5_tpu.err \
+    | tee benchmarks/results/bench_r5_tpu.jsonl
+  ;;
+mosaic)
+  echo "== mosaic-validate windowed kernels (non-interpret)"
+  PYTHONPATH=.:${PYTHONPATH:-} timeout 600 python benchmarks/mosaic_validate.py \
+    --out benchmarks/results/mosaic_r5.json \
+    2>benchmarks/results/mosaic_r5.err | tail -8
+  ;;
+replay)
+  if [ -d "$CKPT" ]; then
+    echo "== saturated BurstGPT replay (real 1B, int8+int8, auto batch)"
+    timeout 1500 python benchmarks/replay.py \
+      --model "$CKPT" --tokenizer auto \
+      --quant int8 --kv-quant int8 \
+      --max-batch-size auto --num-pages auto --batch-cap 32 \
+      --trace data/BurstGPT_1.csv --max-trace 100 \
+      --decode-pipeline-depth 2 \
+      --out benchmarks/results/real1b_burstgpt_r5_int8_auto.json \
+      2>&1 | tail -5
+  else
+    echo "== replay SKIPPED: $CKPT missing"
+  fi
+  ;;
+bench8b)
+  echo "== bench.py BENCH_MODEL=8b (int8-only lane, config-1 row)"
+  BENCH_MODEL=8b timeout 1100 python bench.py \
+    2>benchmarks/results/bench_r5_8b.err \
+    | tee benchmarks/results/bench_r5_8b.jsonl
+  ;;
+bench32)
+  echo "== bench.py BENCH_BATCH=32 (chip-sized batch lane)"
+  BENCH_BATCH=32 timeout 1100 python bench.py \
+    2>benchmarks/results/bench_r5_bs32.err \
+    | tee benchmarks/results/bench_r5_bs32.jsonl
+  ;;
+sweep)
+  echo "== K x depth sweep on the int8 replay config (hbm_util push)"
+  for K in 8 16; do for D in 1 2 4; do
+    [ -d "$CKPT" ] || break 2
+    echo "-- K=$K depth=$D"
+    timeout 900 python benchmarks/replay.py \
+      --model "$CKPT" --tokenizer auto --quant int8 --kv-quant int8 \
+      --max-batch-size auto --num-pages auto --batch-cap 32 \
+      --trace data/BurstGPT_1.csv --max-trace 40 \
+      --decode-steps-per-call $K --decode-pipeline-depth $D \
+      --out benchmarks/results/sweep_r5_K${K}_D${D}.json \
+      2>&1 | tail -2
+  done; done
+  ;;
+*) echo "unknown stage $s";;
+esac; done
+echo "== done"

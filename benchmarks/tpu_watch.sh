@@ -1,5 +1,5 @@
 #!/bin/bash
-# Probe the axon TPU tunnel until it heals, then run the round-4
+# Probe the axon TPU tunnel until it heals, then run the round-5
 # measurement battery exactly once. Intended to run in the background:
 #   bash benchmarks/tpu_watch.sh >> benchmarks/results/tpu_watch.log 2>&1
 set -u
@@ -29,7 +29,7 @@ import sys, bench
 rc, rec = bench._run_child(['--probe'], 120)
 sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)"; then
     echo "[watch] $(date -u +%H:%M:%S) tunnel healthy after $n probes; running battery"
-    bash benchmarks/run_tpu_round4.sh
+    bash benchmarks/run_tpu_round5.sh
     exit 0
   fi
   echo "[watch] $(date -u +%H:%M:%S) probe $n: tunnel still wedged; sleeping ${INTERVAL}s"
