@@ -282,6 +282,18 @@ def test_swa_disables_prefix_cache():
     assert eng.swa_evict
 
 
+def test_swa_exclusions_gated_on_window_binding():
+    """When max_context <= window the mask can never bind (behavior is
+    identical to full attention), so the SWA exclusions don't apply: the
+    prefix cache stays on and eviction stays off (ADVICE r4)."""
+    # window 64 vs max_context 4 pages x 8 = 32: never binds.
+    eng = InferenceEngine(_swa_cfg(64), cfgs.EngineConfig(
+        page_size=8, num_pages=32, max_pages_per_seq=4, max_batch_size=2,
+        prefill_buckets=(16,), enable_prefix_cache=True), seed=0)
+    assert eng.prefix_cache is not None
+    assert not eng.swa_evict
+
+
 def test_swa_eviction_bounds_live_pages_and_preserves_tokens():
     """A sequence decoding far past its window holds O(window) live KV
     pages (behind-window pages return to the pool mid-flight), and the

@@ -134,7 +134,10 @@ def auto_size(model_cfg, *, hbm_bytes: Optional[float] = None,
     # every slot holding a full-length sequence, with 4x slack for the
     # prefix cache and freed-page fragmentation.
     num_pages = min(num_pages, 4 * batch_cap * max_pages_per_seq)
-    tokens = min(tokens, num_pages * page_size)
+    # Page 0 is the allocator's reserved trash page (kv_cache.py):
+    # admission only ever grants num_pages - 1, so the token/batch math
+    # must budget on the usable count (ADVICE r4).
+    tokens = min(tokens, (num_pages - 1) * page_size)
     if num_pages < max_pages_per_seq + 1:  # +1: trash page (kv_cache.py)
         raise ValueError(
             f"{model_cfg.name}: KV budget ({budget / 1e9:.2f} GB/chip) "

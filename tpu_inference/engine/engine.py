@@ -306,7 +306,14 @@ class InferenceEngine:
         # full pages below ctx_len, where every row in BOTH pools is
         # settled. Reusing a cached page therefore reuses a valid draft
         # twin for free.
-        if engine_cfg.enable_prefix_cache and not model_cfg.sliding_window:
+        # The window only binds when the serving context can exceed it
+        # (ADVICE r4): with max_context <= window no query ever looks
+        # back past the window, eviction would never free a page, and
+        # behavior is identical to full attention — so the prefix cache
+        # stays safe and the SWA exclusions don't apply.
+        swa_binds = bool(model_cfg.sliding_window) and (
+            engine_cfg.max_context > model_cfg.sliding_window)
+        if engine_cfg.enable_prefix_cache and not swa_binds:
             # SWA models run WITHOUT the prefix cache (vLLM makes the
             # same exclusion): behind-window pages are evicted while a
             # sequence runs (_evict_behind_window), and a cached prefix
@@ -381,9 +388,16 @@ class InferenceEngine:
         # Behind-window page eviction (SWA): a running sequence holds
         # O(window) KV pages instead of O(context). Off under spec
         # decode — a window-less DRAFT model still attends to the full
-        # context, so the target's behind-window pages stay live.
-        self.swa_evict = (bool(model_cfg.sliding_window)
-                          and self.prefix_cache is None and not spec_on)
+        # context, so the target's behind-window pages stay live. Off
+        # when the window can't bind (swa_binds above): there would
+        # never be a behind-window page to free.
+        self.swa_evict = (swa_binds and self.prefix_cache is None
+                          and not spec_on)
+        if swa_binds and spec_on:
+            print(f"[engine] {model_cfg.name}: SWA + speculative decoding"
+                  " — behind-window eviction OFF (the window-less draft"
+                  " attends full context), so sequences hold O(context)"
+                  " KV pages, not O(window)")
         if self.spec_enabled:
             assert draft_cfg.vocab_size == model_cfg.vocab_size, \
                 "draft and target must share a tokenizer/vocab"
@@ -727,10 +741,17 @@ class InferenceEngine:
             ahead = (ecfg.decode_steps_per_call
                      * max(1, ecfg.decode_pipeline_depth))
             window_span = -(-(win + ahead) // ecfg.page_size) + 2
-            prefill_peak = kvc.pages_needed(
-                min(len(seq.prompt_tokens), ecfg.max_context),
-                ecfg.page_size)
-            need = min(need, max(window_span, prefill_peak))
+            # The post-prefill transient: dispatch-ahead grants up to
+            # ``ahead`` decode tokens (head pages allocated) BEFORE the
+            # first fold-time eviction frees any behind-window page, so a
+            # long-prompt sequence briefly holds its whole prompt PLUS
+            # the dispatch-ahead burst (ADVICE r4: charging only the
+            # prefill peak degrades to a decode stall under a
+            # fully-committed pool).
+            peak_tokens = min(len(seq.prompt_tokens), ecfg.max_context)
+            transient = kvc.pages_needed(
+                min(peak_tokens + ahead, ecfg.max_context), ecfg.page_size)
+            need = min(need, max(window_span, transient))
         return min(need, self.max_pages)
 
     def _free_plus_evictable(self) -> int:
