@@ -69,3 +69,17 @@ def test_two_process_rendezvous_mesh_and_psum():
     # Exactly the coordinator process hosts mesh row 0 (the frontend).
     frontend = {rec["pid"]: rec["role"]["hosts_frontend"] for rec in outs}
     assert frontend == {0: True, 1: False}
+
+    # Serving under the hybrid mesh: each process served its own dp
+    # replica row (VERDICT r4 item 6) — distinct rows, identical tokens,
+    # and both match the unsharded single-process oracle.
+    assert sorted(rec["replica_row"] for rec in outs) == [0, 1]
+    assert outs[0]["tokens"] == outs[1]["tokens"]
+    from tests import _multihost_worker as mw
+    from tpu_inference.config import EngineConfig, tiny_llama
+    from tpu_inference.engine.engine import InferenceEngine
+
+    oracle = InferenceEngine(tiny_llama(), EngineConfig(**mw.ENGINE_KW),
+                             seed=0)
+    want = oracle.generate(mw.PROMPTS, max_new_tokens=mw.MAX_NEW)
+    assert outs[0]["tokens"] == want

@@ -167,6 +167,22 @@ def test_dp_tp_mesh_shapes():
         build_mesh(ParallelConfig(dp=4, tp=4))
 
 
+def test_replica_meshes_split():
+    """replica_meshes hands back one (tp, sp) submesh per dp row; in a
+    single process every row is local, each keeps dp=1 and the
+    production axis names so sharding specs apply unchanged."""
+    from tpu_inference import config as cfgs
+    from tpu_inference.parallel.multihost import (build_hybrid_mesh,
+                                                  replica_meshes)
+
+    mesh = build_hybrid_mesh(cfgs.ParallelConfig(dp=2, tp=2, sp=2))
+    rows = replica_meshes(mesh)
+    assert [i for i, _ in rows] == [0, 1]
+    for i, sub in rows:
+        assert dict(sub.shape) == {"dp": 1, "tp": 2, "sp": 2}
+        assert (sub.devices == mesh.devices[i:i + 1]).all()
+
+
 def test_hybrid_mesh_single_slice():
     """build_hybrid_mesh == flat mesh layout when all devices share ICI."""
     from tpu_inference import config as cfgs

@@ -96,6 +96,26 @@ def build_hybrid_mesh(pcfg: ParallelConfig,
     return Mesh(arr.transpose(0, 2, 1), AXES)
 
 
+def replica_meshes(mesh: Mesh) -> list:
+    """Per-dp-row (tp, sp) submeshes this process participates in.
+
+    Multi-host DP serving runs one engine per dp row: the row never
+    straddles DCN (``build_hybrid_mesh`` guarantees it), so its tp/sp
+    collectives stay on ICI. Each host builds engines only for the rows
+    it holds devices of; hosts inside a multi-host slice share their
+    row's mesh and run that engine as multi-controller SPMD. Returns
+    ``[(row_index, submesh), ...]`` where the submesh keeps the dp axis
+    at size 1 so the production sharding specs apply unchanged.
+    """
+    local = set(jax.local_devices())
+    out = []
+    for i in range(mesh.devices.shape[0]):
+        row = mesh.devices[i:i + 1]
+        if any(d in local for d in row.flat):
+            out.append((i, Mesh(row, mesh.axis_names)))
+    return out
+
+
 def process_local_engine_role(mesh: Mesh) -> dict:
     """What this host contributes to the mesh (serving-topology info for
     logs/metrics): local device count and whether it hosts mesh row 0
