@@ -28,8 +28,8 @@ pipe could block the parent on orphan EOF). Steps:
      sitecustomize bypassed (``PYTHONPATH= JAX_PLATFORMS=cpu``) and runs
      the lanes on CPU at test scale, marked ``degraded``.
   2. One ``--lane backend:quant`` child per measurement lane
-     (pallas/bf16 first — the headline — then pallas/int8, then
-     dense/bf16), each under a ~4.5-minute deadline. After EVERY lane a
+     (pallas/bf16 first — the headline — then pallas/int8, pallas/int4,
+     then dense/bf16), each under a ~4.5-minute deadline. After EVERY lane a
      full snapshot record is printed+flushed, so even a driver-level kill
      mid-run leaves a parseable line with the lanes measured so far.
   3. A lane failure on TPU triggers a 60 s re-probe: tunnel gone →
@@ -78,7 +78,7 @@ METRIC = ("decode_tok_s_"
 PROBE_TIMEOUT_S = 120
 LANE_TIMEOUT_S = 280
 REPROBE_TIMEOUT_S = 60
-TOTAL_BUDGET_S = 780  # no lane launches that can't finish inside this
+TOTAL_BUDGET_S = 1060  # no lane launches that can't finish inside this
 
 # Per-chip peaks for utilization reporting (bf16 FLOP/s, HBM bytes/s).
 # HBM capacities live in tpu_inference/engine/autosize.py (the canonical
@@ -111,8 +111,8 @@ def bench_cfg(platform: str):
         return tiny_llama()
     if os.environ.get("BENCH_MODEL") == "8b":
         # Llama-3-8B dims. bf16 weights (16 GB) don't fit one v5e chip,
-        # so this lane is int8-only (bf16 lanes report skipped when the
-        # bf16 model exceeds HBM); opt-in via BENCH_MODEL=8b.
+        # so only the int8/int4 lanes run (bf16 lanes report skipped when
+        # the bf16 model exceeds HBM); opt-in via BENCH_MODEL=8b.
         return ModelConfig(
             name="llama-8b-bench", family="llama", vocab_size=128256,
             d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
@@ -149,15 +149,18 @@ def lane_child(spec: str) -> None:
     on_tpu = platform == "tpu"
     cfg = bench_cfg(platform)
 
-    if quant != "int8" and on_tpu:
-        # bf16 lanes need weights + KV pool + activations headroom inside
-        # the chip's HBM, gated at 0.85 * capacity to leave room for the
-        # runtime's own reservations (tables/estimator: autosize.py).
+    if on_tpu:
+        # Every lane needs its weights + KV pool + activations headroom
+        # inside the chip's HBM, gated at 0.85 * capacity to leave room
+        # for the runtime's own reservations (autosize.py tables). bf16
+        # 8B exceeds one v5e; int8/int4 fit.
         from tpu_inference.engine.autosize import (detect_hbm_bytes,
                                                    weight_bytes)
 
-        if weight_bytes(cfg) >= 0.85 * detect_hbm_bytes():
-            print(json.dumps({"lane": spec, "skipped": "bf16-exceeds-hbm",
+        if weight_bytes(cfg, quant) >= 0.85 * detect_hbm_bytes():
+            tag = "bf16" if quant == "none" else quant
+            print(json.dumps({"lane": spec,
+                              "skipped": f"{tag}-exceeds-hbm",
                               "model": cfg.name}), flush=True)
             return
 
@@ -285,12 +288,15 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
 
     pallas, int8, dense = lane("pallas:none"), lane("pallas:int8"), \
         lane("dense:none")
-    any_lane = pallas or int8 or dense
+    int4 = lane("pallas:int4")
+    any_lane = pallas or int8 or int4 or dense
 
     pallas_tok_s = pallas and pallas["sync_tok_s"]
     pallas_chained = pallas and pallas["chained_tok_s"]
     int8_tok_s = int8 and int8["sync_tok_s"]
     int8_chained = int8 and int8["chained_tok_s"]
+    int4_tok_s = int4 and int4["sync_tok_s"]
+    int4_chained = int4 and int4["chained_tok_s"]
     dense_tok_s = dense and dense["sync_tok_s"]
     dense_chained = dense and dense["chained_tok_s"]
 
@@ -299,17 +305,24 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
     # Pallas lane produced a number at all.
     best_bf16 = max(pallas_tok_s or 0.0, pallas_chained or 0.0)
     best_int8 = max(int8_tok_s or 0.0, int8_chained or 0.0)
-    best = (max(best_bf16, best_int8)
+    best_int4 = max(int4_tok_s or 0.0, int4_chained or 0.0)
+    best = (max(best_bf16, best_int8, best_int4)
             or max(dense_tok_s or 0.0, dense_chained or 0.0) or None)
 
     # mfu / hbm_util from the winning lane's resident weight bytes.
     mfu = hbm_util = mfu_bf16 = hbm_util_bf16 = None
     quant_tag = None
     if any_lane and best:
-        win = int8 if best_int8 >= best_bf16 and int8 else (pallas or dense)
+        if best_int4 and best_int4 >= max(best_bf16, best_int8) and int4:
+            win = int4
+        elif best_int8 >= best_bf16 and int8:
+            win = int8
+        else:
+            win = pallas or dense
         # "dense" marks the no-Pallas-lane fallback so BENCH_r{N}.json
         # never attributes a dense-gather number to the Pallas kernel.
-        quant_tag = ("int8" if win is int8 else
+        quant_tag = ("int4" if win is int4 else
+                     "int8" if win is int8 else
                      "bf16" if win is pallas else "dense")
         n_params = win["n_params"]
         kv_bpt = win["kv_bytes_per_token"]
@@ -331,9 +344,11 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
 
     # Mode label follows the lanes that actually supplied ``best``:
     # pallas lanes normally, the dense lane only in fallback.
-    if best_bf16 or best_int8:
-        chained_cands = [c for c in (pallas_chained, int8_chained) if c]
-        sync_cands = [c for c in (pallas_tok_s, int8_tok_s) if c]
+    if best_bf16 or best_int8 or best_int4:
+        chained_cands = [c for c in (pallas_chained, int8_chained,
+                                     int4_chained) if c]
+        sync_cands = [c for c in (pallas_tok_s, int8_tok_s,
+                                  int4_tok_s) if c]
     else:
         chained_cands = [c for c in (dense_chained,) if c]
         sync_cands = [c for c in (dense_tok_s,) if c]
@@ -370,11 +385,14 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "dense_chained_tok_s": _r(dense_chained),
         "int8_tok_s": _r(int8_tok_s),
         "int8_chained_tok_s": _r(int8_chained),
+        "int4_tok_s": _r(int4_tok_s),
+        "int4_chained_tok_s": _r(int4_chained),
         # Mode-matched kernel comparisons (sync/sync and chained/chained).
         "pallas_speedup_vs_dense_sync": _ratio(pallas_tok_s, dense_tok_s),
         "pallas_speedup_vs_dense_chained": _ratio(pallas_chained,
                                                   dense_chained),
         "int8_speedup_vs_bf16": _ratio(best_int8 or None, best_bf16 or None),
+        "int4_speedup_vs_bf16": _ratio(best_int4 or None, best_bf16 or None),
         "mfu": mfu,
         "hbm_util": hbm_util,
         "bf16_tok_s": _r(best_bf16 or None),
@@ -382,6 +400,7 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "bf16_hbm_util": hbm_util_bf16,
         "weight_bytes_bf16": pallas["weight_bytes"] if pallas else None,
         "weight_bytes_int8": int8["weight_bytes"] if int8 else None,
+        "weight_bytes_int4": int4["weight_bytes"] if int4 else None,
         "mean_ctx": _r(any_lane.get("mean_ctx") if any_lane else None, 1),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
@@ -433,7 +452,8 @@ def orchestrate() -> None:
 
     # Headline lane first so even the first snapshot carries the number
     # the round is judged on.
-    for spec in ("pallas:none", "pallas:int8", "dense:none"):
+    for spec in ("pallas:none", "pallas:int8", "pallas:int4",
+                 "dense:none"):
         if give_up:
             lanes[spec] = {"lane": spec, "skipped": "tpu-wedged-midrun"}
             continue

@@ -31,8 +31,8 @@ echo "== probe: $(probe || echo UNREACHABLE)"
 
 for s in $STAGES; do case $s in
 bench)
-  echo "== bench.py (3 lanes, headline)"
-  timeout 1100 python bench.py 2>benchmarks/results/bench_r5_tpu.err \
+  echo "== bench.py (4 lanes, headline)"
+  timeout 1400 python bench.py 2>benchmarks/results/bench_r5_tpu.err \
     | tee benchmarks/results/bench_r5_tpu.jsonl
   ;;
 mosaic)
@@ -58,13 +58,13 @@ replay)
   ;;
 bench8b)
   echo "== bench.py BENCH_MODEL=8b (int8-only lane, config-1 row)"
-  BENCH_MODEL=8b timeout 1100 python bench.py \
+  BENCH_MODEL=8b timeout 1400 python bench.py \
     2>benchmarks/results/bench_r5_8b.err \
     | tee benchmarks/results/bench_r5_8b.jsonl
   ;;
 bench32)
   echo "== bench.py BENCH_BATCH=32 (chip-sized batch lane)"
-  BENCH_BATCH=32 timeout 1100 python bench.py \
+  BENCH_BATCH=32 timeout 1400 python bench.py \
     2>benchmarks/results/bench_r5_bs32.err \
     | tee benchmarks/results/bench_r5_bs32.jsonl
   ;;

@@ -24,9 +24,12 @@ while :; do
   # well above the child's own deadline so it never kills a live child.
   # stderr flows to the watch log — a broken probe must look broken,
   # not like "still wedged" for 8 hours.
-  if timeout -k 10 300 python -c "
+  # 60s child deadline: a healthy tunnel probes in ~15s; only a wedged
+  # init ever runs longer, and every wedged probe burns the box's single
+  # core (it contends with foreground suite/bench runs).
+  if timeout -k 10 180 python -c "
 import sys, bench
-rc, rec = bench._run_child(['--probe'], 120)
+rc, rec = bench._run_child(['--probe'], 60)
 sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)"; then
     echo "[watch] $(date -u +%H:%M:%S) tunnel healthy after $n probes; running battery"
     bash benchmarks/run_tpu_round5.sh

@@ -62,14 +62,21 @@ def estimate_param_count(model_cfg) -> int:
 
 def weight_bytes(model_cfg, quant: str = "none") -> int:
     """Resident weight bytes. int8 stores matmul weights as one byte +
-    per-output-channel f32 scales, with embeddings left in model dtype
+    per-output-channel f32 scales; int4 as half a byte + per-group
+    scales (models/quant.py GROUP_SIZE=128: 4 scale bytes per 128
+    codes ≈ 6% overhead); embeddings stay in model dtype
     (models/quant.py quantizes matmuls only)."""
     n = estimate_param_count(model_cfg)
     itemsize = 2  # bf16 serving dtype
-    if quant == "int8":
+    if quant in ("int8", "int4"):
         d, V = model_cfg.d_model, model_cfg.vocab_size
         embed = V * d * (1 if model_cfg.tie_embeddings else 2)
         matmul = n - embed
+        if quant == "int4":
+            from tpu_inference.models.quant import GROUP_SIZE
+
+            # 0.5 B codes + one f32 scale per GROUP_SIZE weights.
+            return embed * itemsize + int(matmul * (0.5 + 4 / GROUP_SIZE))
         # Scales: one f32 per output channel; ~d_model-ish rows per
         # matmul — well under 1% of codes. Budget 1% rather than walk
         # every shape.

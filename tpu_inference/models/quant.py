@@ -1,32 +1,37 @@
-"""Weight-only int8 quantization for the HBM-bound decode path.
+"""Weight-only int8/int4 quantization for the HBM-bound decode path.
 
 TPU decode at serving batch sizes is bandwidth-bound: every step re-reads
 the full weight set from HBM (BASELINE.md roofline), so storing matmul
-weights as int8 with a per-output-channel scale halves weight traffic and
-lifts the decode-throughput ceiling by up to 2x. XLA folds the int8->bf16
-convert into the matmul fusion, so HBM sees one int8 read and the MXU
+weights as int8 with a per-output-channel scale halves weight traffic —
+and int4 with group-wise scales halves it again. XLA folds the int->bf16
+convert into the matmul fusion, so HBM sees one narrow read and the MXU
 still runs a bf16 contraction against full-precision activations.
 
 Design:
-- ``QuantizedArray`` is a registered pytree dataclass ``{q: int8, scale:
-  f32}`` with the scale per *output* channel (the contraction dim — axis
-  -2 of every weight in this codebase's [in, out] convention — is reduced
-  to 1 in ``scale``). Registered as a pytree node it survives ``lax.scan``
-  over stacked layer weights (each leaf carries the leading layer axis)
-  and ``jax.tree.map``-based sharding unchanged.
+- ``QuantizedArray`` is a registered pytree dataclass ``{q, scale}``.
+  int8: per-*output*-channel scale — the contraction dim (axis -2 of
+  every weight in this codebase's [in, out] convention) is reduced to 1
+  in ``scale``. int4: the contraction dim is split into groups of
+  ``GROUP_SIZE`` and the scale is per (group, output channel) — 4-bit
+  cells are too coarse for one whole-column scale (the GPTQ/AWQ
+  group-quant recipe). Registered as a pytree node it survives
+  ``lax.scan`` over stacked layer weights and tree-mapped sharding.
 - ``qdot`` / ``qeinsum`` are drop-in contraction helpers the model
   forwards call for every weight matmul; they accept plain arrays too, so
   quantization stays a load-time decision (EngineConfig.quant) rather
-  than a model-code fork.
-- Scales multiply the *output* of the contraction (valid because the
-  scale axis is not contracted), so under tensor parallelism GSPMD is
-  free to place the all-reduce before or after the scale — both are
-  exact.
+  than a model-code fork. The two paths are discriminated by the scale's
+  group count alone: G == 1 scales the contraction *output* (exact
+  because the scale is constant along the contracted axis), G > 1 runs a
+  grouped contraction and folds the per-group partial sums.
+- Under tensor parallelism GSPMD shards the grouped partials like any
+  einsum; for G == 1 it may place the all-reduce before or after the
+  scale — both are exact.
 
 The reference has no quantization tier (it has no model code at all,
 SURVEY.md §0); this implements the serving-side capability its external
 Ollama endpoint provided (Ollama serves quantized GGUF models — the
-reference's `mistral` was a 4-bit variant by default).
+reference's `mistral` was a 4-bit variant by default, which is exactly
+the int4 tier here).
 """
 
 from __future__ import annotations
@@ -37,7 +42,12 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-QUANT_MODES = ("none", "int8")
+QUANT_MODES = ("none", "int8", "int4")
+
+# int4 group size along the contraction dim (GPTQ/AWQ-style). Contraction
+# dims not divisible by it fall back to one group per column (exact for
+# the tiny test models whose dims are below the group size anyway).
+GROUP_SIZE = 128
 
 # Params-tree leaf names eligible for quantization: the large matmul
 # weights. Norm scales, biases, embeddings (gather tables), positional
@@ -52,7 +62,11 @@ QUANT_KEYS = frozenset({
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class QuantizedArray:
-    """int8 weight + per-output-channel f32 scale (axis -2 reduced)."""
+    """Narrow-int weight + f32 scale.
+
+    int8: scale [..., 1, out] (axis -2 reduced). int4: scale
+    [..., G, out] with G groups along the contraction dim.
+    """
 
     q: jax.Array
     scale: jax.Array
@@ -74,9 +88,26 @@ class QuantizedArray:
         return self.q.ndim
 
 
-def quantize_array(w: jax.Array) -> QuantizedArray:
-    """Symmetric int8 quantization along the contraction dim (axis -2)."""
+def _groups_for(in_dim: int, mode: str) -> int:
+    """Scale groups along the contraction dim for a quant mode."""
+    if mode == "int8" or in_dim % GROUP_SIZE:
+        return 1
+    return in_dim // GROUP_SIZE
+
+
+def quantize_array(w: jax.Array, mode: str = "int8") -> QuantizedArray:
+    """Symmetric narrow-int quantization along the contraction dim
+    (axis -2): int8 per output channel, int4 per (group, channel)."""
     wf = w.astype(jnp.float32)
+    if mode == "int4":
+        in_dim, out = w.shape[-2], w.shape[-1]
+        ngrp = _groups_for(in_dim, mode)
+        wg = wf.reshape(w.shape[:-2] + (ngrp, in_dim // ngrp, out))
+        amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / 7.0
+        q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int4)
+        return QuantizedArray(q=q.reshape(w.shape),
+                              scale=scale[..., 0, :])   # [..., G, out]
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
@@ -84,7 +115,13 @@ def quantize_array(w: jax.Array) -> QuantizedArray:
 
 
 def dequantize(w: QuantizedArray, dtype=jnp.float32) -> jax.Array:
-    return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    ngrp = w.scale.shape[-2]
+    if ngrp == 1:
+        return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    in_dim, out = w.q.shape[-2], w.q.shape[-1]
+    wg = w.q.reshape(w.q.shape[:-2] + (ngrp, in_dim // ngrp, out))
+    full = wg.astype(jnp.float32) * w.scale[..., :, None, :]
+    return full.reshape(w.q.shape).astype(dtype)
 
 
 def qdot(x: jax.Array, w: Any) -> jax.Array:
@@ -93,9 +130,20 @@ def qdot(x: jax.Array, w: Any) -> jax.Array:
     x: [..., in]; w: [in, out] (or quantized). Returns f32 [..., out].
     """
     if isinstance(w, QuantizedArray):
-        y = jnp.dot(x, w.q.astype(x.dtype),
-                    preferred_element_type=jnp.float32)
-        return y * w.scale[..., 0, :]
+        ngrp = w.scale.shape[-2]
+        if ngrp == 1:
+            y = jnp.dot(x, w.q.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+            return y * w.scale[..., 0, :]
+        # Grouped (int4): contract each group separately, fold the
+        # per-group partials with their own scales. HBM still reads only
+        # the 4-bit codes + the small scale table.
+        gsz = w.q.shape[-2] // ngrp
+        xg = x.reshape(x.shape[:-1] + (ngrp, gsz))
+        qg = w.q.reshape(ngrp, gsz, w.q.shape[-1]).astype(x.dtype)
+        y = jnp.einsum("...gi,gio->...go", xg, qg,
+                       preferred_element_type=jnp.float32)
+        return jnp.sum(y * w.scale, axis=-2)
     return jnp.dot(x, w, preferred_element_type=jnp.float32)
 
 
@@ -105,12 +153,25 @@ def qeinsum(eq: str, a: jax.Array, w: Any) -> jax.Array:
     Valid for contractions whose output ends with w's output (last) axis
     and preserves w's leading batch axes (the MoE expert einsums
     'ecd,edf->ecf' and 'ecf,efd->ecd'): the [..., 1, out] scale then
-    broadcasts against the result directly.
+    broadcasts against the result directly; grouped (int4) scales fold
+    per-group partial contractions of the same two patterns.
     """
     if isinstance(w, QuantizedArray):
-        y = jnp.einsum(eq, a, w.q.astype(a.dtype),
+        ngrp = w.scale.shape[-2]
+        if ngrp == 1:
+            y = jnp.einsum(eq, a, w.q.astype(a.dtype),
+                           preferred_element_type=jnp.float32)
+            return y * w.scale
+        assert eq in ("ecd,edf->ecf", "ecf,efd->ecd"), (
+            f"grouped qeinsum supports the MoE expert contractions, "
+            f"got {eq!r}")
+        gsz = w.q.shape[-2] // ngrp
+        a4 = a.reshape(a.shape[:-1] + (ngrp, gsz))        # [E, C, G, g]
+        q4 = w.q.reshape(w.q.shape[0], ngrp, gsz,
+                         w.q.shape[-1]).astype(a.dtype)   # [E, G, g, out]
+        y = jnp.einsum("ecgi,egio->egco", a4, q4,
                        preferred_element_type=jnp.float32)
-        return y * w.scale
+        return jnp.sum(y * w.scale[:, :, None, :], axis=1)
     return jnp.einsum(eq, a, w, preferred_element_type=jnp.float32)
 
 
@@ -126,7 +187,8 @@ def quantize_params(params: dict, mode: str = "int8") -> dict:
         return params
     if mode not in QUANT_MODES:
         raise ValueError(f"unknown quant mode {mode!r}; one of {QUANT_MODES}")
-    quant_jit = jax.jit(quantize_array)
+    import functools
+    quant_jit = jax.jit(functools.partial(quantize_array, mode=mode))
 
     def maybe_quant(path, leaf):
         last = path[-1]
@@ -180,7 +242,7 @@ def init_quantized_params(model_cfg, seed: int = 0,
             out.append(jax.jit(
                 lambda k, s=sds: quantize_array(
                     (0.02 * jax.random.normal(k, s.shape, jnp.float32)
-                     ).astype(s.dtype)))(sub))
+                     ).astype(s.dtype), mode))(sub))
         elif "norm" in name:
             out.append(jnp.ones(sds.shape, sds.dtype))
         else:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
@@ -441,7 +442,7 @@ def load_checkpoint(cfg: ModelConfig, path: str,
         if quant != "none" and name in QUANT_KEYS:
             # The bf16 leaf becomes garbage as soon as this returns; its
             # device buffer frees before the next leaf materializes.
-            return jax.jit(quantize_array)(arr)
+            return jax.jit(partial(quantize_array, mode=quant))(arr)
         return arr
 
     is_plan_leaf = lambda x: isinstance(x, tuple)  # noqa: E731

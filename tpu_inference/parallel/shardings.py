@@ -125,11 +125,16 @@ def param_specs(cfg: ModelConfig) -> dict:
     return fam(cfg)
 
 
-def _scale_spec(spec: P, ndim: int) -> P:
-    """Spec for a QuantizedArray's scale: same as the weight's, with the
-    contraction dim (axis -2, size 1 in the scale) unsharded."""
+def _scale_spec(spec: P, leaf) -> P:
+    """Spec for a QuantizedArray's scale: same as the weight's. The
+    contraction dim is size 1 in an int8 scale (unshard it — replicated)
+    but holds G groups in an int4 scale, where it must follow the
+    weight's contraction-dim sharding so each chip keeps the scales for
+    its own weight shard."""
+    ndim = leaf.q.ndim
     entries = list(spec) + [None] * (ndim - len(spec))
-    entries[ndim - 2] = None
+    if leaf.scale.shape[-2] == 1:
+        entries[ndim - 2] = None
     return P(*entries)
 
 
@@ -151,9 +156,26 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh,
 
     def mk(spec: P, leaf: Any):
         if isinstance(leaf, QuantizedArray):
+            sspec = _scale_spec(spec, leaf)
+            ngrp = leaf.scale.shape[-2]
+            axis = sspec[leaf.q.ndim - 2] if len(sspec) >= leaf.q.ndim - 1 \
+                else None
+            if ngrp > 1 and axis is not None:
+                n = int(mesh.shape.get(axis, 1))
+                if ngrp % n:
+                    # Fail here with a named leaf, not deep inside GSPMD
+                    # placement (same job validate_tp does for head/ff
+                    # divisibility — the grouped constraint depends on
+                    # the quantized leaf, so it's checked at shard time).
+                    raise ValueError(
+                        f"int4 grouped scales: {ngrp} groups on a "
+                        f"contraction dim sharded over {axis}={n} don't "
+                        f"divide evenly; use a tp that divides the group "
+                        f"count (dim/{ngrp and leaf.q.shape[-2]//ngrp}) "
+                        "or --quant int8")
             return QuantizedArray(
                 q=NamedSharding(mesh, spec),
-                scale=NamedSharding(mesh, _scale_spec(spec, leaf.q.ndim)))
+                scale=NamedSharding(mesh, sspec))
         return NamedSharding(mesh, spec)
 
     return jax.tree.map(mk, specs, params,

@@ -65,9 +65,11 @@ def main() -> None:
                    choices=("auto", "dense", "pallas"),
                    help="decode attention: Pallas paged kernel (TPU) or "
                         "dense gather; auto = pallas on TPU")
-    p.add_argument("--quant", default="none", choices=("none", "int8"),
+    p.add_argument("--quant", default="none",
+                   choices=("none", "int8", "int4"),
                    help="weight quantization: int8 stores matmul weights "
-                        "as int8 + per-channel scales, halving the HBM "
+                        "as int8 + per-channel scales (int4: 4-bit + "
+                        "group-128 scales, quartering), halving the HBM "
                         "weight traffic that bounds decode throughput")
     p.add_argument("--kv-quant", default="none", choices=("none", "int8"),
                    help="KV-cache quantization: int8 codes + per-token-"
