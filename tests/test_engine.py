@@ -39,10 +39,12 @@ def _oracle_forward(mod, cfg, pad):
     if key not in _ORACLE_FWD:
         def fwd(params, toks, n):
             """Logits at position n-1 of a [1, pad] right-padded batch
-            (causal attention: padding after n-1 cannot leak in)."""
+            (causal attention: padding after n-1 cannot leak in). Honors
+            cfg.sliding_window (part of the cache key via cfg), so SWA
+            tests share this oracle too."""
             pos = jnp.broadcast_to(jnp.arange(pad), (1, pad))
-            logits, _ = mod.forward(params, cfg, toks, pos, None,
-                                    common.make_dense_attn())
+            attn = common.make_dense_attn(cfg.sliding_window or 0)
+            logits, _ = mod.forward(params, cfg, toks, pos, None, attn)
             return logits[0, n - 1]
 
         _ORACLE_FWD[key] = jax.jit(fwd)
@@ -137,52 +139,58 @@ def _sp(b, **kw):
 
 
 def test_sampling_modes():
+    # Eager sample() pays ~1s of op-by-op dispatch per call on this box;
+    # production always runs it inside jitted graphs, so jit here too
+    # (SamplingParams is a NamedTuple — a pytree — so values, not
+    # shapes, vary freely across calls under one compile).
+    jsample = jax.jit(sample)
     key = jax.random.PRNGKey(0)
     logits = jnp.asarray(np.array([[0.0, 5.0, 1.0, -2.0],
                                    [10.0, 0.0, 0.0, 0.0]], np.float32))
     # Greedy rows pick argmax regardless of key.
     sp = SamplingParams.greedy(2)
-    toks = sample(logits, key, sp)
+    toks = jsample(logits, key, sp)
     assert toks.tolist() == [1, 0]
     # Temperature sampling with top_k=1 degenerates to greedy.
     sp = _sp(2, temperature=jnp.ones((2,)), top_k=jnp.ones((2,), jnp.int32))
-    toks = sample(logits, key, sp)
+    toks = jsample(logits, key, sp)
     assert toks.tolist() == [1, 0]
     # Per-row top_k: row 0 restricted to its argmax, row 1 unrestricted
     # at huge temperature still yields a valid token.
     sp = _sp(2, temperature=jnp.full((2,), 100.0),
              top_k=jnp.asarray([1, 0], jnp.int32))
-    assert sample(logits, key, sp).tolist()[0] == 1
+    assert jsample(logits, key, sp).tolist()[0] == 1
     # top_p tiny keeps only the argmax.
     sp = _sp(2, temperature=jnp.ones((2,)), top_p=jnp.full((2,), 1e-6))
-    toks = sample(logits, key, sp)
+    toks = jsample(logits, key, sp)
     assert toks.tolist() == [1, 0]
     # High temperature covers the support (statistical sanity).
     sp = _sp(16, temperature=jnp.full((16,), 100.0))
     wide = jnp.zeros((16, 4))
     seen = set()
     for i in range(20):
-        seen.update(sample(wide, jax.random.PRNGKey(i), sp).tolist())
+        seen.update(jsample(wide, jax.random.PRNGKey(i), sp).tolist())
     assert seen == {0, 1, 2, 3}
 
 
 def test_sampling_seeded_reproducible():
     """seed >= 0 rows depend only on (seed, ctx) — not the engine key or
     batch position; seed < 0 rows follow the engine key."""
+    jsample = jax.jit(sample)          # see test_sampling_modes
     wide = jnp.zeros((2, 64))
     ctx = jnp.asarray([7, 7], jnp.int32)
     sp = _sp(2, temperature=jnp.ones((2,)),
              seed=jnp.asarray([42, -1], jnp.int32))
-    a = sample(wide, jax.random.PRNGKey(0), sp, ctx=ctx)
-    b = sample(wide, jax.random.PRNGKey(999), sp, ctx=ctx)
+    a = jsample(wide, jax.random.PRNGKey(0), sp, ctx=ctx)
+    b = jsample(wide, jax.random.PRNGKey(999), sp, ctx=ctx)
     assert a[0] == b[0]                     # seeded row: key-independent
     # Same seed in a different slot gives the same token at the same ctx.
     sp_swapped = _sp(2, temperature=jnp.ones((2,)),
                      seed=jnp.asarray([-1, 42], jnp.int32))
-    c = sample(wide, jax.random.PRNGKey(0), sp_swapped, ctx=ctx)
+    c = jsample(wide, jax.random.PRNGKey(0), sp_swapped, ctx=ctx)
     assert c[1] == a[0]
     # Unseeded rows vary with the engine key (statistically).
-    outs = {int(sample(wide, jax.random.PRNGKey(i), sp, ctx=ctx)[1])
+    outs = {int(jsample(wide, jax.random.PRNGKey(i), sp, ctx=ctx)[1])
             for i in range(10)}
     assert len(outs) > 1
 

@@ -12,6 +12,8 @@ must match exactly), TP-sharded equality, and spec-decode compatibility.
 
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -111,6 +113,7 @@ def test_mixtral_kv_int8():
     assert len(out[0]) == 8
 
 
+@pytest.mark.slow   # spec x kv-int8 combination; each covered separately
 def test_spec_decode_with_kv_int8():
     cfg = tiny_llama()
     draft = dataclasses.replace(cfg, n_layers=1, name="draft")
@@ -122,6 +125,7 @@ def test_spec_decode_with_kv_int8():
     assert len(out[0]) == 6
 
 
+@pytest.mark.slow   # int8 x kv-int8 x pallas combination sweep
 def test_both_quant_tiers_together():
     """Weights int8 + KV int8 — the full memory-bandwidth configuration."""
     cfg = tiny_llama()
@@ -154,6 +158,7 @@ def test_prefix_cache_reuses_quantized_pages():
     assert cold == warm
 
 
+@pytest.mark.slow   # sp x kv-int8 combination; each covered separately
 def test_sp_ring_prefill_with_kv_int8():
     """sp>1 ring-attention prefill writes the chunk's KV into the
     quantized pool; decode then reads int8 codes — token-equal to the

@@ -31,6 +31,22 @@ def _ecfg(**kw):
     return cfgs.EngineConfig(**base)
 
 
+@pytest.fixture(scope="module")
+def warm_engine(setup):
+    """Shared cache-on engine. Cache state is cumulative across tests:
+    each test uses its own distinct prompts and asserts >=/>0, so
+    earlier entries can't change any outcome."""
+    model_cfg, params, _ = setup
+    return InferenceEngine(model_cfg, _ecfg(), params=params)
+
+
+@pytest.fixture(scope="module")
+def cold_engine(setup):
+    model_cfg, params, _ = setup
+    return InferenceEngine(model_cfg, _ecfg(enable_prefix_cache=False),
+                           params=params)
+
+
 def test_chain_hash_full_pages_only():
     hs = _chain_hashes(list(range(20)), 8)
     assert len(hs) == 2                      # 20 tokens -> 2 full pages
@@ -68,15 +84,12 @@ def test_prefix_cache_unit():
     assert n == 0 and got == []
 
 
-def test_warm_request_matches_cold(setup):
-    model_cfg, params, _ = setup
+def test_warm_request_matches_cold(setup, warm_engine, cold_engine):
     prompt = np.random.default_rng(0).integers(0, 256, 37).tolist()
 
-    cold = InferenceEngine(model_cfg, _ecfg(enable_prefix_cache=False),
-                           params=params)
-    want = cold.generate([prompt], max_new_tokens=12)[0]
+    want = cold_engine.generate([prompt], max_new_tokens=12)[0]
 
-    warm = InferenceEngine(model_cfg, _ecfg(), params=params)
+    warm = warm_engine
     first = warm.generate([prompt], max_new_tokens=12)[0]
     assert first == want
     assert warm.prefix_cache.stats()["entries"] > 0
@@ -86,10 +99,9 @@ def test_warm_request_matches_cold(setup):
     assert warm.prefix_cache.hits >= 1
 
 
-def test_multi_turn_conversation_reuse(setup):
+def test_multi_turn_conversation_reuse(setup, warm_engine, cold_engine):
     """Turn 2 resends turn 1's history: its full pages must be reused."""
-    model_cfg, params, _ = setup
-    engine = InferenceEngine(model_cfg, _ecfg(), params=params)
+    engine = warm_engine
     rng = np.random.default_rng(1)
     turn1 = rng.integers(0, 256, 20).tolist()
     reply1 = engine.generate([turn1], max_new_tokens=8)[0]
@@ -104,9 +116,8 @@ def test_multi_turn_conversation_reuse(setup):
     warm_out = list(s.generated)
     engine.release(s)
 
-    cold = InferenceEngine(model_cfg, _ecfg(enable_prefix_cache=False),
-                           params=params)
-    assert warm_out == cold.generate([history], max_new_tokens=4)[0]
+    assert warm_out == cold_engine.generate([history],
+                                            max_new_tokens=4)[0]
 
 
 def test_cache_eviction_under_pressure(setup):
@@ -128,11 +139,10 @@ def test_cache_eviction_under_pressure(setup):
     engine.release(s)
 
 
-def test_shared_pages_never_written(setup):
+def test_shared_pages_never_written(setup, warm_engine):
     """Running a warm request must not corrupt the cached prefix for a
     concurrent cold request using the same pages."""
-    model_cfg, params, _ = setup
-    engine = InferenceEngine(model_cfg, _ecfg(), params=params)
+    engine = warm_engine
     rng = np.random.default_rng(2)
     prompt = rng.integers(0, 256, 16).tolist()   # exactly 2 full pages
     base = engine.generate([prompt], max_new_tokens=10)[0]

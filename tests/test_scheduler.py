@@ -126,9 +126,8 @@ def test_pipelined_decode_error_recovery():
                              latency_decode_threshold=0)
     params, _ = build_model(model_cfg, seed=0)
     engine = InferenceEngine(model_cfg, ecfg, params=params)
-
-    want = InferenceEngine(model_cfg, ecfg, params=params).generate(
-        [[5, 6, 7]], max_new_tokens=6)[0]
+    # Same engine supplies the reference (generate leaves no state).
+    want = engine.generate([[5, 6, 7]], max_new_tokens=6)[0]
 
     real = engine._decode_multi_jit
     state = {"calls": 0}
@@ -171,11 +170,13 @@ def test_chunked_prefill_interleaves_with_decode():
     short = rng.integers(0, 256, size=6).tolist()
     long = rng.integers(0, 256, size=90).tolist()   # 3 chunks of <=32
 
-    ref = InferenceEngine(model_cfg, ecfg, params=params)
-    want_short = ref.generate([short], max_new_tokens=20)[0]
-    want_long = ref.generate([long], max_new_tokens=8)[0]
-
+    # One engine serves both the reference generates and the scheduler:
+    # generate() leaves no state behind, so the second compile of an
+    # identical engine would be pure waste on this single-core box.
     engine = InferenceEngine(model_cfg, ecfg, params=params)
+    want_short = engine.generate([short], max_new_tokens=20)[0]
+    want_long = engine.generate([long], max_new_tokens=8)[0]
+
     sched = EngineScheduler(engine).start()
     try:
         s1 = Sequence(request_id=1, prompt_tokens=short, max_new_tokens=20)

@@ -316,7 +316,8 @@ def test_debug_disabled_by_default():
         model=tiny_llama(vocab_size=512),
         engine=EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
                             max_batch_size=2, prefill_buckets=(16,)),
-        server=ServerConfig(model_name="t", tokenizer="byte"))
+        server=ServerConfig(model_name="t", tokenizer="byte",
+                            warmup=False))   # routes-only test: no compile
     srv = InferenceServer(cfg)
 
     async def scenario(client):
@@ -376,7 +377,8 @@ def test_chaos_injection():
         engine=EngineConfig(page_size=8, num_pages=32, max_pages_per_seq=4,
                             max_batch_size=2, prefill_buckets=(16,)),
         server=ServerConfig(model_name="t", tokenizer="byte",
-                            chaos_failure_rate=1.0))
+                            chaos_failure_rate=1.0,
+                            warmup=False))   # 503s pre-engine: no compile
     srv = InferenceServer(cfg)
 
     async def scenario(client):
@@ -404,7 +406,7 @@ def test_dp_replica_serving(quant, kv_quant):
     cfg = FrameworkConfig(
         model=tiny_llama(vocab_size=512),
         engine=EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=4,
-                            max_batch_size=2, prefill_buckets=(16, 32),
+                            max_batch_size=2, prefill_buckets=(16,),
                             quant=quant, kv_quant=kv_quant),
         parallel=ParallelConfig(dp=2, tp=2),
         server=ServerConfig(model_name="t", tokenizer="byte"))

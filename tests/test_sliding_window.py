@@ -76,15 +76,11 @@ def test_engine_matches_windowed_oracle():
     prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 20)]
     got = engine.generate(prompts, max_new_tokens=12)
 
-    attn = common.make_dense_attn(sliding_window=window)
+    # reference_greedy honors cfg.sliding_window (shared-compile oracle).
+    from tests.test_engine import reference_greedy
     for prompt, gen in zip(prompts, got):
-        toks = list(prompt)
-        for _ in range(12):
-            t = jnp.asarray(np.array(toks)[None])
-            pos = jnp.broadcast_to(jnp.arange(len(toks)), (1, len(toks)))
-            logits, _ = mod.forward(params, cfg, t, pos, None, attn)
-            toks.append(int(jnp.argmax(logits[0, -1])))
-        assert gen == toks[len(prompt):], f"prompt len {len(prompt)}"
+        want = reference_greedy(params, mod, cfg, prompt, 12)
+        assert gen == want, f"prompt len {len(prompt)}"
 
 
 def test_windowed_differs_from_full_attention():
@@ -330,15 +326,9 @@ def test_swa_eviction_bounds_live_pages_and_preserves_tokens():
     engine.release(seq)
     assert engine.allocator.num_free == free_at_prefill
 
-    # Token equality with the windowed no-cache oracle.
-    attn = common.make_dense_attn(sliding_window=window)
-    toks = list(prompt)
-    for _ in range(40):
-        t = jnp.asarray(np.array(toks)[None])
-        pos = jnp.broadcast_to(jnp.arange(len(toks)), (1, len(toks)))
-        logits, _ = mod.forward(params, cfg, t, pos, None, attn)
-        toks.append(int(jnp.argmax(logits[0, -1])))
-    assert got == toks[len(prompt):]
+    # Token equality with the windowed no-cache oracle (shared-compile).
+    from tests.test_engine import reference_greedy
+    assert got == reference_greedy(params, mod, cfg, prompt, 40)
 
 
 def test_mistral_preset_registered():

@@ -36,23 +36,35 @@ def _ecfg(gamma, **kw):
     return cfgs.EngineConfig(**base)
 
 
-def test_spec_greedy_matches_target(models):
-    """Greedy spec output == greedy plain output, any draft model."""
+@pytest.fixture(scope="module")
+def plain_engine(models):
+    """Shared no-spec reference engine (generate leaves no state behind,
+    so read-only token-equality tests reuse one compile)."""
+    target_cfg, params, _, _ = models
+    return InferenceEngine(target_cfg, _ecfg(0), params=params)
+
+
+@pytest.fixture(scope="module")
+def spec_engine(models):
+    """Shared gamma=3 spec engine (counters are cumulative across tests;
+    assert deltas or > 0, never exact totals)."""
     target_cfg, params, draft_cfg, draft_params = models
+    return InferenceEngine(target_cfg, _ecfg(3), params=params,
+                           draft_cfg=draft_cfg, draft_params=draft_params)
+
+
+def test_spec_greedy_matches_target(models, plain_engine, spec_engine):
+    """Greedy spec output == greedy plain output, any draft model."""
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 13, 22)]
 
-    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
-    want = plain.generate(prompts, max_new_tokens=15)
-
-    spec = InferenceEngine(target_cfg, _ecfg(3), params=params,
-                           draft_cfg=draft_cfg, draft_params=draft_params)
-    got = spec.generate(prompts, max_new_tokens=15)
+    want = plain_engine.generate(prompts, max_new_tokens=15)
+    got = spec_engine.generate(prompts, max_new_tokens=15)
     assert got == want
-    assert spec.spec_drafted > 0
+    assert spec_engine.spec_drafted > 0
 
 
-def test_spec_perfect_draft_accepts_everything(models):
+def test_spec_perfect_draft_accepts_everything(models, plain_engine):
     """Draft == target: every draft token accepted, gamma+1 tokens/round."""
     target_cfg, params, _, _ = models
     gamma = 3
@@ -63,22 +75,18 @@ def test_spec_perfect_draft_accepts_everything(models):
     assert len(out) == 12
     assert spec.spec_accepted == spec.spec_drafted  # 100% acceptance
 
-    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
-    assert out == plain.generate([prompt], max_new_tokens=12)[0]
+    assert out == plain_engine.generate([prompt], max_new_tokens=12)[0]
 
 
-def test_spec_eos_and_budget(models):
-    target_cfg, params, draft_cfg, draft_params = models
-    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
+def test_spec_eos_and_budget(models, plain_engine, spec_engine):
     prompt = list(range(7))
-    ref = plain.generate([prompt], max_new_tokens=10)[0]
+    ref = plain_engine.generate([prompt], max_new_tokens=10)[0]
     # EOS = a token whose FIRST occurrence is mid-stream (tiny random
     # models repeat; picking ref[k] blindly could stop earlier).
     k = max(i for i in range(len(ref)) if ref[i] not in ref[:i])
     eos = ref[k]
 
-    spec = InferenceEngine(target_cfg, _ecfg(3), params=params,
-                           draft_cfg=draft_cfg, draft_params=draft_params)
+    spec = spec_engine
     s = Sequence(request_id=0, prompt_tokens=prompt, max_new_tokens=10,
                  eos_token_id=eos)
     spec.prefill(s)
@@ -88,6 +96,8 @@ def test_spec_eos_and_budget(models):
     assert s.generated == ref[:k + 1]
     assert s.finish_reason == "stop"
 
+    spec.release(s)          # shared engine: free the slot for later tests
+
     s2 = Sequence(request_id=1, prompt_tokens=prompt, max_new_tokens=7)
     spec.prefill(s2)
     while spec.active_sequences():
@@ -95,6 +105,7 @@ def test_spec_eos_and_budget(models):
     assert len(s2.generated) == 7               # budget exact, no overshoot
     assert s2.generated == ref[:7]
     assert s2.finish_reason == "length"
+    spec.release(s2)
 
 
 def test_spec_sampled_runs(models):
@@ -108,20 +119,17 @@ def test_spec_sampled_runs(models):
     assert all(0 <= t < 256 for t in out)
 
 
-def test_spec_continuous_batching_join(models):
+def test_spec_continuous_batching_join(models, plain_engine, spec_engine):
     """Sequences join mid-flight in spec mode without perturbing others."""
-    target_cfg, params, draft_cfg, draft_params = models
-    plain = InferenceEngine(target_cfg, _ecfg(0), params=params)
     rng = np.random.default_rng(2)
     p1 = rng.integers(0, 256, size=9).tolist()
     p2 = rng.integers(0, 256, size=17).tolist()
-    w1 = plain.generate([p1], max_new_tokens=12)[0]
-    w2 = plain.generate([p2], max_new_tokens=8)[0]
+    w1 = plain_engine.generate([p1], max_new_tokens=12)[0]
+    w2 = plain_engine.generate([p2], max_new_tokens=8)[0]
 
-    spec = InferenceEngine(target_cfg, _ecfg(3), params=params,
-                           draft_cfg=draft_cfg, draft_params=draft_params)
-    s1 = Sequence(request_id=1, prompt_tokens=p1, max_new_tokens=12)
-    s2 = Sequence(request_id=2, prompt_tokens=p2, max_new_tokens=8)
+    spec = spec_engine
+    s1 = Sequence(request_id=3, prompt_tokens=p1, max_new_tokens=12)
+    s2 = Sequence(request_id=4, prompt_tokens=p2, max_new_tokens=8)
     spec.prefill(s1)
     spec.decode_steps()
     spec.prefill(s2)            # joins while s1 mid-generation
@@ -129,6 +137,8 @@ def test_spec_continuous_batching_join(models):
         spec.decode_steps()
     assert s1.generated == w1
     assert s2.generated == w2
+    spec.release(s1)
+    spec.release(s2)            # shared engine: leave all slots free
 
 
 def test_spec_composes_with_prefix_cache():
