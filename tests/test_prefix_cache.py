@@ -84,7 +84,7 @@ def test_prefix_cache_unit():
     assert n == 0 and got == []
 
 
-def test_warm_request_matches_cold(setup, warm_engine, cold_engine):
+def test_warm_request_matches_cold(warm_engine, cold_engine):
     prompt = np.random.default_rng(0).integers(0, 256, 37).tolist()
 
     want = cold_engine.generate([prompt], max_new_tokens=12)[0]
@@ -99,7 +99,7 @@ def test_warm_request_matches_cold(setup, warm_engine, cold_engine):
     assert warm.prefix_cache.hits >= 1
 
 
-def test_multi_turn_conversation_reuse(setup, warm_engine, cold_engine):
+def test_multi_turn_conversation_reuse(warm_engine, cold_engine):
     """Turn 2 resends turn 1's history: its full pages must be reused."""
     engine = warm_engine
     rng = np.random.default_rng(1)
@@ -139,7 +139,7 @@ def test_cache_eviction_under_pressure(setup):
     engine.release(s)
 
 
-def test_shared_pages_never_written(setup, warm_engine):
+def test_shared_pages_never_written(warm_engine):
     """Running a warm request must not corrupt the cached prefix for a
     concurrent cold request using the same pages."""
     engine = warm_engine

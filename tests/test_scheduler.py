@@ -123,7 +123,10 @@ def test_pipelined_decode_error_recovery():
                              decode_pipeline_depth=2,
                              # Force the pipelined path even for a lone
                              # request (latency mode would bypass it).
-                             latency_decode_threshold=0)
+                             latency_decode_threshold=0,
+                             # The same engine serves the reference
+                             # generate below; no warm-prefill crosstalk.
+                             enable_prefix_cache=False)
     params, _ = build_model(model_cfg, seed=0)
     engine = InferenceEngine(model_cfg, ecfg, params=params)
     # Same engine supplies the reference (generate leaves no state).

@@ -60,16 +60,36 @@ def _swa_cfg(window):
                                sliding_window=window)
 
 
-def test_engine_matches_windowed_oracle():
+# Shared geometry for the window-8 serving tests; the module-scoped
+# dense engine below serves every test that only needs plain windowed
+# generate() (tokens are geometry-invariant given the same params).
+SWA_KW = dict(page_size=8, num_pages=96, max_pages_per_seq=8,
+              max_batch_size=2, prefill_buckets=(16, 32))
+
+
+@pytest.fixture(scope="module")
+def swa8():
+    cfg = _swa_cfg(8)
+    params, mod = build_model(cfg, seed=0)
+    return cfg, params, mod
+
+
+@pytest.fixture(scope="module")
+def swa8_dense_engine(swa8):
+    # attn_backend pinned: "auto" would resolve to pallas on a real TPU
+    # backend and make the dense-vs-pallas parity test vacuous.
+    cfg, params, _ = swa8
+    return InferenceEngine(cfg, cfgs.EngineConfig(**SWA_KW,
+                                                  attn_backend="dense"),
+                           params=params)
+
+
+def test_engine_matches_windowed_oracle(swa8, swa8_dense_engine):
     """Greedy serving (bucketed prefill + paged decode) == repeated
     windowed full forwards: the window must hold across the
     prefill/decode boundary and as decode slides past it."""
-    window = 8
-    cfg = _swa_cfg(window)
-    ecfg = cfgs.EngineConfig(page_size=8, num_pages=64, max_pages_per_seq=8,
-                             max_batch_size=2, prefill_buckets=(16, 32))
-    params, mod = build_model(cfg, seed=0)
-    engine = InferenceEngine(cfg, ecfg, params=params)
+    cfg, params, mod = swa8
+    engine = swa8_dense_engine
     rng = np.random.default_rng(3)
     # Prompts shorter and longer than the window; enough new tokens that
     # decode positions slide well past it.
@@ -166,21 +186,15 @@ def test_windowed_paged_decode_kernel_matches_dense(kv_quant):
                                    err_msg=f"seq {i} kv_len {n}")
 
 
-def test_swa_pallas_engine_matches_dense_engine():
+def test_swa_pallas_engine_matches_dense_engine(swa8, swa8_dense_engine):
     """Serving on the full windowed Pallas path (flash prefill + paged
     decode) produces exactly the dense backend's tokens."""
-    cfg = _swa_cfg(8)
-    ecfg = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
-                max_batch_size=2, prefill_buckets=(16, 32))
-    params, _ = build_model(cfg, seed=0)
+    cfg, params, _ = swa8
     rng = np.random.default_rng(5)
     prompts = [rng.integers(0, 256, size=n).tolist() for n in (6, 21)]
 
-    dense = InferenceEngine(cfg, cfgs.EngineConfig(**ecfg,
-                                                   attn_backend="dense"),
-                            params=params)
-    want = dense.generate(prompts, max_new_tokens=14)
-    pallas = InferenceEngine(cfg, cfgs.EngineConfig(**ecfg,
+    want = swa8_dense_engine.generate(prompts, max_new_tokens=14)
+    pallas = InferenceEngine(cfg, cfgs.EngineConfig(**SWA_KW,
                                                     attn_backend="pallas"),
                              params=params)
     got = pallas.generate(prompts, max_new_tokens=14)
@@ -188,7 +202,7 @@ def test_swa_pallas_engine_matches_dense_engine():
 
 
 @pytest.mark.parametrize("sp_attn", ["ring", "ulysses"])
-def test_swa_sp_engine_matches_unsharded(sp_attn):
+def test_swa_sp_engine_matches_unsharded(sp_attn, swa8, swa8_dense_engine):
     """SWA composes with sequence parallelism (VERDICT r4 item 5): a
     sliding-window model served on an sp=2 mesh — prompts long enough to
     span both sequence shards, window smaller than the prompt so the
@@ -197,21 +211,17 @@ def test_swa_sp_engine_matches_unsharded(sp_attn):
     from tpu_inference.config import ParallelConfig
     from tpu_inference.parallel.mesh import build_mesh
 
-    cfg = _swa_cfg(8)
-    ecfg = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
-                max_batch_size=2, prefill_buckets=(16, 32))
-    params, _ = build_model(cfg, seed=0)
+    cfg, params, _ = swa8
     rng = np.random.default_rng(17)
     prompts = [rng.integers(0, 256, size=n).tolist() for n in (21, 13)]
 
-    base = InferenceEngine(cfg, cfgs.EngineConfig(**ecfg), params=params)
-    want = base.generate(prompts, max_new_tokens=10)
+    want = swa8_dense_engine.generate(prompts, max_new_tokens=10)
 
     # Ulysses needs n_kv_heads (2) divisible by tp*sp, so it runs tp=1;
     # the ring composes with tp=2 head sharding.
     tp = 2 if sp_attn == "ring" else 1
     mesh = build_mesh(ParallelConfig(tp=tp, sp=2))
-    eng = InferenceEngine(cfg, cfgs.EngineConfig(**ecfg, sp_attn=sp_attn),
+    eng = InferenceEngine(cfg, cfgs.EngineConfig(**SWA_KW, sp_attn=sp_attn),
                           params=params, mesh=mesh)
     assert eng.sp == 2 and eng.swa_evict
     got = eng.generate(prompts, max_new_tokens=10)
@@ -344,27 +354,22 @@ def test_mistral_preset_registered():
     assert sz.max_batch_size >= 8
 
 
-def test_spec_decode_serves_swa_target():
+def test_spec_decode_serves_swa_target(swa8, swa8_dense_engine):
     """Speculative decoding with a window-less draft over an SWA target:
     emitted tokens must equal the plain SWA engine's (the verify pass
     windows the target's logits; rejection sampling is exact)."""
     import dataclasses
 
-    cfg = _swa_cfg(8)
-    params, _ = build_model(cfg, seed=0)
-    base_kw = dict(page_size=8, num_pages=96, max_pages_per_seq=8,
-                   max_batch_size=2, prefill_buckets=(16, 32))
-    plain = InferenceEngine(cfg, cfgs.EngineConfig(**base_kw),
-                            params=params)
+    cfg, params, _ = swa8
     rng = np.random.default_rng(7)
     prompts = [rng.integers(0, 256, size=n).tolist() for n in (6, 18)]
-    want = plain.generate(prompts, max_new_tokens=12)
+    want = swa8_dense_engine.generate(prompts, max_new_tokens=12)
 
     draft_cfg = dataclasses.replace(cfg, name="draft", n_layers=1,
                                     sliding_window=0)
     draft_params, _ = build_model(draft_cfg, seed=9)
     spec = InferenceEngine(
-        cfg, cfgs.EngineConfig(**base_kw, num_speculative_tokens=3),
+        cfg, cfgs.EngineConfig(**SWA_KW, num_speculative_tokens=3),
         params=params, draft_cfg=draft_cfg, draft_params=draft_params)
     assert not spec.swa_evict        # window-less draft reads full ctx
     got = spec.generate(prompts, max_new_tokens=12)
