@@ -278,6 +278,30 @@ def test_int4_qdot_and_qeinsum_match_dequantized():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_int4_grouped_bf16_activations():
+    """bf16 activations through the grouped paths (the real-checkpoint
+    serving dtype). XLA:CPU can't execute batched bf16 dots, so the
+    grouped contraction upcasts off-TPU (_contract_dtype) — this is the
+    regression test for the int4 CPU-smoke failure."""
+    rng = np.random.default_rng(6)
+    w = jnp.asarray(rng.normal(size=(256, 16)) * 0.05, jnp.bfloat16)
+    x = jnp.asarray(rng.normal(size=(4, 256)), jnp.bfloat16)
+    qa = quantize_array(w, "int4")
+    assert qa.scale.shape[-2] == 2
+    got = jax.jit(qdot)(x, qa)               # must compile AND execute
+    want = x.astype(jnp.float32) @ dequantize(qa)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-2, atol=5e-2)
+    we = jnp.asarray(rng.normal(size=(2, 256, 8)) * 0.02, jnp.bfloat16)
+    a = jnp.asarray(rng.normal(size=(2, 3, 256)), jnp.bfloat16)
+    qe = quantize_array(we, "int4")
+    got = jax.jit(lambda a_, w_: qeinsum("ecd,edf->ecf", a_, w_))(a, qe)
+    want = jnp.einsum("ecd,edf->ecf", a.astype(jnp.float32),
+                      dequantize(qe))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-2, atol=5e-2)
+
+
 @pytest.mark.parametrize("cfg_fn", [tiny_llama, tiny_mixtral])
 def test_engine_serves_int4(cfg_fn):
     """End-to-end serving with int4 weights (w_down's 256-dim contraction

@@ -249,6 +249,11 @@ def test_aux_routes(server):
         info = show["model_info"]
         assert info["llama.context_length"] > 0
         assert info["general.parameter_count"] > 0
+        # SWA composition rules surface here (full-attention model:
+        # window 0, no eviction, prefix cache on).
+        assert info["llama.attention.sliding_window"] == 0
+        assert info["serving.swa_eviction"] is False
+        assert info["serving.prefix_cache"] is True
 
     _run(server, go)
 

@@ -88,6 +88,16 @@ class QuantizedArray:
         return self.q.ndim
 
 
+def _contract_dtype(act_dtype):
+    """Contraction dtype for the grouped (int4) paths. bf16 on TPU (MXU
+    native); f32 elsewhere — XLA:CPU's batched-dot thunk cannot execute
+    bf16 x bf16 -> f32 (the backend is static at trace time, so this is
+    a compile-time constant, not a traced branch)."""
+    if act_dtype == jnp.bfloat16 and jax.default_backend() != "tpu":
+        return jnp.float32
+    return act_dtype
+
+
 def _groups_for(in_dim: int, mode: str) -> int:
     """Scale groups along the contraction dim for a quant mode."""
     if mode == "int8" or in_dim % GROUP_SIZE:
@@ -139,8 +149,9 @@ def qdot(x: jax.Array, w: Any) -> jax.Array:
         # per-group partials with their own scales. HBM still reads only
         # the 4-bit codes + the small scale table.
         gsz = w.q.shape[-2] // ngrp
-        xg = x.reshape(x.shape[:-1] + (ngrp, gsz))
-        qg = w.q.reshape(ngrp, gsz, w.q.shape[-1]).astype(x.dtype)
+        ct = _contract_dtype(x.dtype)
+        xg = x.reshape(x.shape[:-1] + (ngrp, gsz)).astype(ct)
+        qg = w.q.reshape(ngrp, gsz, w.q.shape[-1]).astype(ct)
         y = jnp.einsum("...gi,gio->...go", xg, qg,
                        preferred_element_type=jnp.float32)
         return jnp.sum(y * w.scale, axis=-2)
@@ -166,9 +177,10 @@ def qeinsum(eq: str, a: jax.Array, w: Any) -> jax.Array:
             f"grouped qeinsum supports the MoE expert contractions, "
             f"got {eq!r}")
         gsz = w.q.shape[-2] // ngrp
-        a4 = a.reshape(a.shape[:-1] + (ngrp, gsz))        # [E, C, G, g]
+        ct = _contract_dtype(a.dtype)
+        a4 = a.reshape(a.shape[:-1] + (ngrp, gsz)).astype(ct)  # [E,C,G,g]
         q4 = w.q.reshape(w.q.shape[0], ngrp, gsz,
-                         w.q.shape[-1]).astype(a.dtype)   # [E, G, g, out]
+                         w.q.shape[-1]).astype(ct)        # [E, G, g, out]
         y = jnp.einsum("ecgi,egio->egco", a4, q4,
                        preferred_element_type=jnp.float32)
         return jnp.sum(y * w.scale[:, :, None, :], axis=1)

@@ -207,6 +207,13 @@ class InferenceServer:
                 # what /metrics reports.
                 "serving.attn_backend": self.engine.attn_backend,
                 "serving.kv_quant": ec.kv_quant,
+                # SWA composition rules actually in effect (README
+                # "Sliding-window models"): operators can confirm them
+                # here instead of grepping the boot log.
+                f"{mc.family}.attention.sliding_window":
+                    mc.sliding_window or 0,
+                "serving.swa_eviction": self.engine.swa_evict,
+                "serving.prefix_cache": self.engine.prefix_cache is not None,
             },
         })
 
