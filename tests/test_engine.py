@@ -51,6 +51,16 @@ def _oracle_forward(mod, cfg, pad):
     return _ORACLE_FWD[key]
 
 
+def randomize_qkv_biases(params, seed: int = 7, scale: float = 0.1) -> None:
+    """init_params zero-inits Qwen2's q/k/v biases; tests randomize them
+    in place so the bias term actually participates in parity checks."""
+    key = jax.random.PRNGKey(seed)
+    for i, name in enumerate(("bq", "bk", "bv")):
+        b = params["blocks"][name]
+        params["blocks"][name] = scale * jax.random.normal(
+            jax.random.fold_in(key, i), b.shape, b.dtype)
+
+
 def reference_greedy(params, mod, cfg, prompt, n_new):
     """Greedy decode via repeated full forwards (no cache), padded to a
     shared 64-token bucket so all steps/prompts reuse one compile."""
@@ -80,6 +90,29 @@ def test_engine_matches_full_forward(setup):
     for prompt, gen in zip(prompts, got):
         want = reference_greedy(params, mod, model_cfg, prompt, 12)
         assert gen == want, f"prompt len {len(prompt)}: {gen} != {want}"
+
+
+@pytest.mark.parametrize("dialect", ["qwen2", "gemma"])
+def test_engine_dialects_match_full_forward(dialect):
+    """Qwen2 (qkv bias) and Gemma (norm offset, GeGLU, embed scale,
+    decoupled head_dim) serve correctly through the paged engine."""
+    if dialect == "qwen2":
+        model_cfg = cfgs.tiny_qwen2(vocab_size=256)
+    else:
+        model_cfg = cfgs.tiny_gemma(vocab_size=256)
+    engine_cfg = cfgs.EngineConfig(
+        page_size=8, num_pages=64, max_pages_per_seq=16, max_batch_size=4,
+        prefill_buckets=(16, 32, 64))
+    params, mod = build_model(model_cfg, seed=0)
+    if dialect == "qwen2":
+        randomize_qkv_biases(params)
+    engine = InferenceEngine(model_cfg, engine_cfg, params=params)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 256, size=n).tolist() for n in (5, 19)]
+    got = engine.generate(prompts, max_new_tokens=10)
+    for prompt, gen in zip(prompts, got):
+        want = reference_greedy(params, mod, model_cfg, prompt, 10)
+        assert gen == want, f"{dialect} prompt len {len(prompt)}"
 
 
 def test_engine_continuous_join(setup):

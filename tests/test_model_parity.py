@@ -82,6 +82,72 @@ def test_gpt2_matches_hf(rng):
     _compare_logits(np.asarray(ours), theirs)
 
 
+def test_qwen2_matches_hf(rng):
+    """Qwen2 dialect: q/k/v projection bias on top of the Llama block."""
+    cfg = cfgs.tiny_qwen2(vocab_size=128)
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads, num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq_len, rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta, attn_implementation="eager",
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    sd = hf.state_dict()
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+
+    # HF inits the biases to zero; randomize so the test actually pins
+    # the bias term, then convert the updated state dict.
+    gen = torch.Generator().manual_seed(1)
+    with torch.no_grad():
+        for i in range(cfg.n_layers):
+            for proj in ("q_proj", "k_proj", "v_proj"):
+                b = hf.model.layers[i].self_attn.__getattr__(proj).bias
+                b.copy_(torch.randn(b.shape, generator=gen) * 0.1)
+    params = weights.convert_state_dict(cfg, hf.state_dict())
+    toks = _tokens(rng, cfg.vocab_size)
+    positions = np.broadcast_to(np.arange(toks.shape[1]), toks.shape)
+
+    ours, _ = llama.forward(params, cfg, jnp.asarray(toks),
+                            jnp.asarray(positions), None,
+                            common.make_dense_attn())
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(toks)).logits.numpy()
+    _compare_logits(np.asarray(ours), theirs)
+
+
+def test_gemma_matches_hf(rng):
+    """Gemma dialect: +1 norm offset, GeGLU, sqrt(d)-scaled embeddings,
+    tied unembedding, head_dim decoupled from d_model/n_heads."""
+    cfg = cfgs.tiny_gemma(vocab_size=128)
+    assert cfg.head_dim * cfg.n_heads != cfg.d_model  # the decoupled case
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads, num_key_value_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.norm_eps, rope_theta=cfg.rope_theta,
+        hidden_act="gelu_pytorch_tanh",
+        hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+
+    params = weights.convert_state_dict(cfg, hf.state_dict())
+    toks = _tokens(rng, cfg.vocab_size)
+    positions = np.broadcast_to(np.arange(toks.shape[1]), toks.shape)
+
+    ours, _ = llama.forward(params, cfg, jnp.asarray(toks),
+                            jnp.asarray(positions), None,
+                            common.make_dense_attn())
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(toks)).logits.numpy()
+    _compare_logits(np.asarray(ours), theirs)
+
+
 def test_mixtral_matches_hf(rng):
     cfg = cfgs.tiny_mixtral(vocab_size=128)
     hf_cfg = transformers.MixtralConfig(

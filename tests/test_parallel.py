@@ -33,6 +33,14 @@ def tp_llama_cfg():
         rope_theta=10000.0, dtype=jnp.float32)
 
 
+def tp_qwen2_cfg():
+    """Qwen2 dialect under TP: the head-dim-sharded q/k/v biases must
+    follow their projections (parallel/shardings.py bq/bk/bv specs)."""
+    import dataclasses
+    return dataclasses.replace(tp_llama_cfg(), name="tp-qwen2",
+                               qkv_bias=True)
+
+
 def tp_mixtral_cfg():
     return ModelConfig(
         name="tp-mixtral", family="mixtral", vocab_size=512, d_model=128,
@@ -49,10 +57,14 @@ def _forward_logits(cfg, params, tokens):
     return logits
 
 
-@pytest.mark.parametrize("cfg_fn", [tp_llama_cfg, tp_mixtral_cfg])
+@pytest.mark.parametrize("cfg_fn", [tp_llama_cfg, tp_qwen2_cfg,
+                                    tp_mixtral_cfg])
 def test_tp_forward_matches_single_device(cfg_fn):
     cfg = cfg_fn()
     params, mod = build_model(cfg, seed=0)
+    if cfg.qkv_bias:
+        from tests.test_engine import randomize_qkv_biases
+        randomize_qkv_biases(params, seed=11)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
                                 cfg.vocab_size)
 

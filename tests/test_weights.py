@@ -41,6 +41,12 @@ def _random_llama_sd(cfg, rng):
             p + "mlp.up_proj.weight": rng.standard_normal((f, d)),
             p + "mlp.down_proj.weight": rng.standard_normal((d, f)),
         })
+        if cfg.qkv_bias:
+            sd.update({
+                p + "self_attn.q_proj.bias": rng.standard_normal((hq * hd,)),
+                p + "self_attn.k_proj.bias": rng.standard_normal((hkv * hd,)),
+                p + "self_attn.v_proj.bias": rng.standard_normal((hkv * hd,)),
+            })
     return {k: a.astype(np.float32) for k, a in sd.items()}
 
 
@@ -70,6 +76,70 @@ def test_load_checkpoint_matches_converter(tmp_path):
     want = weights.convert_state_dict(cfg, sd)
     got = weights.load_checkpoint(cfg, str(tmp_path))
     _assert_tree_equal(got, want)
+
+
+def test_load_checkpoint_qwen2_biases(tmp_path):
+    """Qwen2 plan: the q/k/v biases stream (and TP-shard) like weights."""
+    from tpu_inference.parallel import shardings as shd
+    from tpu_inference.parallel.mesh import build_mesh
+
+    cfg = cfgs.tiny_qwen2(vocab_size=128)
+    sd = _random_llama_sd(cfg, np.random.default_rng(4))
+    _write_sharded(sd, str(tmp_path))
+
+    want = weights.convert_state_dict(cfg, sd)
+    got = weights.load_checkpoint(cfg, str(tmp_path))
+    assert "bq" in got["blocks"]
+    _assert_tree_equal(got, want)
+
+    mesh = build_mesh(cfgs.ParallelConfig(tp=2))
+    shardings = shd.param_shardings(cfg, mesh)
+    got_tp = weights.load_checkpoint(cfg, str(tmp_path), shardings=shardings)
+    _assert_tree_equal(got_tp, want)
+
+
+def test_config_from_hf_qwen2_and_gemma(tmp_path):
+    """model_type qwen2 -> qkv_bias (window gated on use_sliding_window);
+    model_type gemma -> norm offset, gelu_tanh, embed scale, head_dim."""
+    from tpu_inference.models.weights import config_from_hf
+
+    qwen = {"model_type": "qwen2", "vocab_size": 1024, "hidden_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "intermediate_size": 256,
+            "rope_theta": 1000000.0, "rms_norm_eps": 1e-6,
+            "sliding_window": 4096, "use_sliding_window": False,
+            "tie_word_embeddings": True}
+    (tmp_path / "config.json").write_text(json.dumps(qwen))
+    cfg = config_from_hf(str(tmp_path))
+    assert cfg.family == "llama" and cfg.qkv_bias
+    assert cfg.sliding_window == 0 and cfg.tie_embeddings
+    assert cfg.rope_theta == 1000000.0
+
+    qwen["use_sliding_window"] = True
+    (tmp_path / "config.json").write_text(json.dumps(qwen))
+    assert config_from_hf(str(tmp_path)).sliding_window == 4096
+
+    # HF windows only layers >= max_window_layers; the global-window
+    # engine maps the all-or-nothing cases and rejects mixed stacks.
+    qwen["max_window_layers"] = 2        # == num_hidden_layers: full attn
+    (tmp_path / "config.json").write_text(json.dumps(qwen))
+    assert config_from_hf(str(tmp_path)).sliding_window == 0
+    qwen["max_window_layers"] = 1        # mixed: unsupported
+    (tmp_path / "config.json").write_text(json.dumps(qwen))
+    with pytest.raises(ValueError, match="max_window_layers"):
+        config_from_hf(str(tmp_path))
+    del qwen["max_window_layers"]
+
+    gemma = {"model_type": "gemma", "vocab_size": 2048, "hidden_size": 128,
+             "num_hidden_layers": 2, "num_attention_heads": 4,
+             "num_key_value_heads": 1, "intermediate_size": 512,
+             "head_dim": 48, "rms_norm_eps": 1e-6,
+             "hidden_act": "gelu_pytorch_tanh"}
+    (tmp_path / "config.json").write_text(json.dumps(gemma))
+    cfg = config_from_hf(str(tmp_path))
+    assert cfg.family == "llama" and cfg.norm_offset == 1.0
+    assert cfg.hidden_act == "gelu_tanh" and cfg.embed_scale
+    assert cfg.head_dim == 48 and cfg.tie_embeddings  # gemma default ties
 
 
 def test_load_checkpoint_streams_into_tp_layout(tmp_path):

@@ -50,11 +50,25 @@ class ModelConfig:
     # GPT-2 family uses learned positional embeddings + LayerNorm with bias.
     use_learned_pos: bool = False
     use_bias: bool = False
+    # Llama-family dialect knobs (all default to vanilla Llama):
+    # Qwen2 puts bias terms on the q/k/v projections only.
+    qkv_bias: bool = False
+    # Gemma stores RMSNorm weights as offsets from 1: y = normed * (o + w).
+    # Applied in float32 inside the norm so 1+w never rounds through bf16.
+    norm_offset: float = 0.0
+    # FFN gate activation: "silu" (Llama/Qwen) | "gelu_tanh" (Gemma).
+    hidden_act: str = "silu"
+    # Gemma scales token embeddings by sqrt(d_model) (cast to cfg.dtype,
+    # matching HF's rounded normalizer) before the first block.
+    embed_scale: bool = False
+    # Gemma-7B decouples head_dim from d_model/n_heads (3072/16 heads but
+    # head_dim 256). 0 = derive from d_model // n_heads.
+    head_dim_override: int = 0
     dtype: jnp.dtype = jnp.bfloat16
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def n_rep(self) -> int:
@@ -62,7 +76,8 @@ class ModelConfig:
         return self.n_heads // self.n_kv_heads
 
     def validate(self) -> None:
-        assert self.d_model % self.n_heads == 0
+        if not self.head_dim_override:
+            assert self.d_model % self.n_heads == 0
         assert self.n_heads % self.n_kv_heads == 0
         if self.n_experts:
             assert self.n_experts_per_tok <= self.n_experts
@@ -110,6 +125,30 @@ def mistral_7b() -> ModelConfig:
     )
 
 
+def qwen2_7b() -> ModelConfig:
+    """Qwen2-7B: Llama-shaped with bias on the q/k/v projections and a
+    1M rope base. Loads from HF ``model_type: qwen2`` checkpoints
+    (weights.config_from_hf)."""
+    return ModelConfig(
+        name="qwen2-7b", family="llama", vocab_size=152064, d_model=3584,
+        n_layers=28, n_heads=28, n_kv_heads=4, d_ff=18944,
+        max_seq_len=8192, rope_theta=1000000.0, norm_eps=1e-6,
+        qkv_bias=True,
+    )
+
+
+def gemma_7b() -> ModelConfig:
+    """Gemma-7B: RMSNorm offset (+1), GeGLU FFN, sqrt(d)-scaled embeddings,
+    tied unembedding, and head_dim 256 decoupled from d_model/n_heads."""
+    return ModelConfig(
+        name="gemma-7b", family="llama", vocab_size=256000, d_model=3072,
+        n_layers=28, n_heads=16, n_kv_heads=16, d_ff=24576,
+        max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
+        tie_embeddings=True, norm_offset=1.0, hidden_act="gelu_tanh",
+        embed_scale=True, head_dim_override=256,
+    )
+
+
 def gpt2_small() -> ModelConfig:
     return ModelConfig(
         name="gpt2", family="gpt2", vocab_size=50257, d_model=768,
@@ -145,6 +184,24 @@ def tiny_mistral(vocab_size: int = 512) -> ModelConfig:
                                sliding_window=64)
 
 
+def tiny_qwen2(vocab_size: int = 512) -> ModelConfig:
+    """tiny_llama + qkv bias (the Qwen2 dialect) for unit tests."""
+    return dataclasses.replace(tiny_llama(vocab_size), name="tiny-qwen2",
+                               qkv_bias=True)
+
+
+def tiny_gemma(vocab_size: int = 512) -> ModelConfig:
+    """Small Gemma exercising every dialect knob, including a head_dim
+    (48) decoupled from d_model/n_heads (128/4 = 32)."""
+    return ModelConfig(
+        name="tiny-gemma", family="llama", vocab_size=vocab_size, d_model=128,
+        n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, max_seq_len=1024,
+        rope_theta=10000.0, norm_eps=1e-6, tie_embeddings=True,
+        norm_offset=1.0, hidden_act="gelu_tanh", embed_scale=True,
+        head_dim_override=48, dtype=jnp.float32,
+    )
+
+
 def tiny_gpt2(vocab_size: int = 512) -> ModelConfig:
     return ModelConfig(
         name="tiny-gpt2", family="gpt2", vocab_size=vocab_size, d_model=128,
@@ -159,8 +216,12 @@ PRESETS = {
     "llama-3-70b": llama3_70b,
     "mixtral-8x7b": mixtral_8x7b,
     "mistral-7b": mistral_7b,
+    "qwen2-7b": qwen2_7b,
+    "gemma-7b": gemma_7b,
     "gpt2": gpt2_small,
     "tiny-llama": tiny_llama,
+    "tiny-qwen2": tiny_qwen2,
+    "tiny-gemma": tiny_gemma,
     "tiny-mixtral": tiny_mixtral,
     "tiny-mistral": tiny_mistral,
     "tiny-gpt2": tiny_gpt2,

@@ -13,6 +13,7 @@ Conventions:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable, Tuple
 
 import jax
@@ -24,13 +25,26 @@ from tpu_inference.models.quant import qdot
 AttentionFn = Callable[[int, jax.Array, jax.Array, jax.Array, Any],
                        Tuple[jax.Array, Any]]
 
+# Gated-FFN activations; a KeyError here fails loudly on an unknown or
+# unmapped hidden_act instead of silently running the wrong function.
+_GATE_ACTS = {
+    "silu": jax.nn.silu,
+    "gelu_tanh": partial(jax.nn.gelu, approximate=True),
+}
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """RMSNorm with float32 statistics, output in x.dtype."""
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             offset: float = 0.0) -> jax.Array:
+    """RMSNorm with float32 statistics, output in x.dtype.
+
+    ``offset`` supports Gemma's stored-as-delta weights (y = normed *
+    (1 + w)); adding in float32 avoids the precision loss of
+    pre-materializing 1 + w in bf16.
+    """
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+    return (normed * (weight.astype(jnp.float32) + offset)).astype(x.dtype)
 
 
 def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array,
@@ -128,13 +142,16 @@ def make_dense_attn(sliding_window: int = 0) -> AttentionFn:
 
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
-           w_down: jax.Array) -> jax.Array:
-    """SwiGLU FFN: down( silu(x @ gate) * (x @ up) ).
+           w_down: jax.Array, act: str = "silu") -> jax.Array:
+    """Gated FFN: down( act(x @ gate) * (x @ up) ).
 
-    Weights may be int8 ``QuantizedArray``s (models/quant.py) — ``qdot``
-    handles both representations.
+    ``act``: "silu" (SwiGLU — Llama/Qwen/Mistral) or "gelu_tanh" (GeGLU
+    with the tanh approximation — Gemma). Weights may be int8/int4
+    ``QuantizedArray``s (models/quant.py) — ``qdot`` handles both
+    representations.
     """
-    gate = jax.nn.silu(qdot(x, w_gate))
+    fn = _GATE_ACTS[act]
+    gate = fn(qdot(x, w_gate))
     up = qdot(x, w_up)
     return qdot((gate * up).astype(x.dtype), w_down).astype(x.dtype)
 

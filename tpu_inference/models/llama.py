@@ -1,5 +1,11 @@
 """Llama-family decoder (RMSNorm + RoPE + GQA + SwiGLU) as pure JAX.
 
+One module serves the whole dialect family via ModelConfig knobs:
+vanilla Llama, Mistral (sliding_window — masked in the attention
+backend), Qwen2 (qkv_bias), and Gemma (norm_offset, gelu_tanh gate,
+embed_scale, decoupled head_dim). Parity for each dialect is pinned
+against its HF implementation in tests/test_model_parity.py.
+
 TPU-first design notes:
 - Per-layer weights are **stacked along a leading layer axis** and the block
   stack runs under ``jax.lax.scan`` — one traced layer body regardless of
@@ -58,6 +64,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         },
         "final_norm": jnp.ones((d,), cfg.dtype),
     }
+    if cfg.qkv_bias:
+        params["blocks"]["bq"] = jnp.zeros((L, cfg.n_heads * hd), cfg.dtype)
+        params["blocks"]["bk"] = jnp.zeros((L, cfg.n_kv_heads * hd), cfg.dtype)
+        params["blocks"]["bv"] = jnp.zeros((L, cfg.n_kv_heads * hd), cfg.dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = norm(jax.random.split(keys[0])[0],
                                  (d, cfg.vocab_size))
@@ -70,10 +80,13 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     b, s, d = x.shape
     hd = cfg.head_dim
 
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = qdot(h, lp["wq"]).astype(x.dtype)
-    k = qdot(h, lp["wk"]).astype(x.dtype)
-    v = qdot(h, lp["wv"]).astype(x.dtype)
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps, cfg.norm_offset)
+    q, k, v = qdot(h, lp["wq"]), qdot(h, lp["wk"]), qdot(h, lp["wv"])
+    if cfg.qkv_bias:
+        q = q + lp["bq"].astype(jnp.float32)
+        k = k + lp["bk"].astype(jnp.float32)
+        v = v + lp["bv"].astype(jnp.float32)
+    q, k, v = (t.astype(x.dtype) for t in (q, k, v))
     q = q.reshape(b, s, cfg.n_heads, hd)
     k = k.reshape(b, s, cfg.n_kv_heads, hd)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)
@@ -84,8 +97,9 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)
     x = x + qdot(attn_out, lp["wo"]).astype(x.dtype)
 
-    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-    x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+    h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps, cfg.norm_offset)
+    x = x + swiglu(h, lp["w_gate"], lp["w_up"], lp["w_down"],
+                   act=cfg.hidden_act)
     return x, kv
 
 
@@ -94,6 +108,10 @@ def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
                    attn: AttentionFn) -> Tuple[jax.Array, Any]:
     """Token ids -> final hidden states. tokens, positions: [B, S]."""
     x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:
+        # Gemma: HF casts the sqrt(d) normalizer to the activation dtype
+        # before multiplying; match that rounding for parity.
+        x = x * jnp.asarray(cfg.d_model ** 0.5, dtype=cfg.dtype)
 
     def body(carry, scanned):
         x, kv = carry
@@ -103,7 +121,7 @@ def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
 
     layer_ids = jnp.arange(cfg.n_layers)
     (x, kv), _ = jax.lax.scan(body, (x, kv), (layer_ids, params["blocks"]))
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps, cfg.norm_offset)
     return x, kv
 
 

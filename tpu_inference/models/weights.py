@@ -61,6 +61,10 @@ def convert_llama(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
         },
         "final_norm": _np(sd["model.norm.weight"]),
     }
+    if cfg.qkv_bias:
+        params["blocks"]["bq"] = _stack(sd, p + "self_attn.q_proj.bias", L)
+        params["blocks"]["bk"] = _stack(sd, p + "self_attn.k_proj.bias", L)
+        params["blocks"]["bv"] = _stack(sd, p + "self_attn.v_proj.bias", L)
     if not cfg.tie_embeddings:
         head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
         params["lm_head"] = _np(head).T
@@ -201,10 +205,34 @@ def config_from_hf(path: str) -> ModelConfig:
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             use_learned_pos=True, use_bias=True, tie_embeddings=True,
             dtype=dtype)
-    if model_type not in ("llama", "mixtral", "mistral"):
+    if model_type not in ("llama", "mixtral", "mistral", "qwen2", "gemma"):
         raise ValueError(f"unsupported model_type {model_type!r} in "
                          f"{path}/config.json")
     heads = hf["num_attention_heads"]
+    gemma = model_type == "gemma"
+    # Gemma checkpoints ("gelu"/"gelu_pytorch_tanh", both the tanh
+    # approximation in practice) vs the SiLU dialects.
+    act = "gelu_tanh" if gemma else "silu"
+    # Qwen2 configs carry sliding_window but gate it behind
+    # use_sliding_window (default false); Mistral windows unconditionally.
+    if model_type == "mistral":
+        window = int(hf.get("sliding_window") or 0)
+    elif model_type == "qwen2" and hf.get("use_sliding_window"):
+        window = int(hf.get("sliding_window") or 0)
+        # HF Qwen2 windows only layers >= max_window_layers (the first
+        # max_window_layers layers keep full attention). The engine's
+        # window is global, so only the all-or-nothing cases map:
+        mwl = int(hf.get("max_window_layers") or 0)
+        if mwl >= int(hf["num_hidden_layers"]):
+            window = 0           # every layer is below the cutoff: full attn
+        elif mwl != 0:
+            raise ValueError(
+                f"qwen2 checkpoint {name!r} uses per-layer sliding window "
+                f"(max_window_layers={mwl} of {hf['num_hidden_layers']}); "
+                "mixed full/SWA layers are unsupported — set "
+                "use_sliding_window=false to serve with full attention")
+    else:
+        window = 0
     return ModelConfig(
         name=name, family="mixtral" if model_type == "mixtral" else "llama",
         vocab_size=hf["vocab_size"], d_model=hf["hidden_size"],
@@ -214,13 +242,18 @@ def config_from_hf(path: str) -> ModelConfig:
         max_seq_len=hf.get("max_position_embeddings", 8192),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         norm_eps=hf.get("rms_norm_eps", 1e-5),
-        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", gemma)),
         n_experts=hf.get("num_local_experts", 0),
         n_experts_per_tok=hf.get("num_experts_per_tok", 2),
-        # Mistral-style SWA; HF uses null for "no window" (v0.2+), and
-        # mixtral configs carry the field without the models using it.
-        sliding_window=(int(hf.get("sliding_window") or 0)
-                        if model_type == "mistral" else 0),
+        sliding_window=window,
+        qkv_bias=model_type == "qwen2",
+        norm_offset=1.0 if gemma else 0.0,
+        hidden_act=act,
+        embed_scale=gemma,
+        # Honored whenever the checkpoint carries it (a no-op when it
+        # equals d_model // n_heads): Gemma-7B and e.g. Mistral-Nemo
+        # decouple head_dim from the hidden size.
+        head_dim_override=int(hf.get("head_dim") or 0),
         dtype=dtype)
 
 
@@ -302,6 +335,10 @@ def _plan_llama(cfg: ModelConfig, have) -> dict:
         },
         "final_norm": ("model.norm.weight", False),
     }
+    if cfg.qkv_bias:
+        plan["blocks"]["bq"] = (lk("self_attn.q_proj.bias"), False)
+        plan["blocks"]["bk"] = (lk("self_attn.k_proj.bias"), False)
+        plan["blocks"]["bv"] = (lk("self_attn.v_proj.bias"), False)
     if not cfg.tie_embeddings:
         head = ("lm_head.weight" if "lm_head.weight" in have
                 else "model.embed_tokens.weight")

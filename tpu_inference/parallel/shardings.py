@@ -48,6 +48,11 @@ def _llama_specs(cfg: ModelConfig) -> dict:
         },
         "final_norm": P(),
     }
+    if cfg.qkv_bias:
+        # Qwen2 q/k/v biases follow their projection's head (output) dim.
+        specs["blocks"]["bq"] = P(None, "tp")
+        specs["blocks"]["bk"] = P(None, "tp")
+        specs["blocks"]["bv"] = P(None, "tp")
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
