@@ -23,3 +23,14 @@ jax.config.update("jax_num_cpu_devices", 8)
 # XLA:CPU's oneDNN matmuls run in reduced precision by default (~1e-1 abs
 # error on standard-normal f32 inputs), which swamps parity tolerances.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def randomize_qkv_biases(params, seed: int = 7, scale: float = 0.1) -> None:
+    """init_params zero-inits Qwen2's q/k/v biases; tests randomize them
+    in place so the bias term actually participates in parity checks.
+    Shared across test modules (engine + TP suites)."""
+    key = jax.random.PRNGKey(seed)
+    for i, name in enumerate(("bq", "bk", "bv")):
+        b = params["blocks"][name]
+        params["blocks"][name] = scale * jax.random.normal(
+            jax.random.fold_in(key, i), b.shape, b.dtype)

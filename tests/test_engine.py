@@ -51,16 +51,6 @@ def _oracle_forward(mod, cfg, pad):
     return _ORACLE_FWD[key]
 
 
-def randomize_qkv_biases(params, seed: int = 7, scale: float = 0.1) -> None:
-    """init_params zero-inits Qwen2's q/k/v biases; tests randomize them
-    in place so the bias term actually participates in parity checks."""
-    key = jax.random.PRNGKey(seed)
-    for i, name in enumerate(("bq", "bk", "bv")):
-        b = params["blocks"][name]
-        params["blocks"][name] = scale * jax.random.normal(
-            jax.random.fold_in(key, i), b.shape, b.dtype)
-
-
 def reference_greedy(params, mod, cfg, prompt, n_new):
     """Greedy decode via repeated full forwards (no cache), padded to a
     shared 64-token bucket so all steps/prompts reuse one compile."""
@@ -105,6 +95,7 @@ def test_engine_dialects_match_full_forward(dialect):
         prefill_buckets=(16, 32, 64))
     params, mod = build_model(model_cfg, seed=0)
     if dialect == "qwen2":
+        from tests.conftest import randomize_qkv_biases
         randomize_qkv_biases(params)
     engine = InferenceEngine(model_cfg, engine_cfg, params=params)
     rng = np.random.default_rng(3)

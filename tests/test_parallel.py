@@ -63,7 +63,7 @@ def test_tp_forward_matches_single_device(cfg_fn):
     cfg = cfg_fn()
     params, mod = build_model(cfg, seed=0)
     if cfg.qkv_bias:
-        from tests.test_engine import randomize_qkv_biases
+        from tests.conftest import randomize_qkv_biases
         randomize_qkv_biases(params, seed=11)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
                                 cfg.vocab_size)

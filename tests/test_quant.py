@@ -239,13 +239,29 @@ def test_engine_random_init_quant_decodes():
 # is the tier its numbers actually came from.
 # ---------------------------------------------------------------------
 
+def test_int4_pack_unpack_roundtrip():
+    """Nibble packing is lossless over the full code range, including
+    sign extension of negative nibbles from both byte halves."""
+    from tpu_inference.models.quant import pack_int4, unpack_int4
+
+    codes = jnp.tile(jnp.arange(-7, 8, dtype=jnp.int8), 30)[:448]
+    codes = codes.reshape(56, 8)              # even contraction dim
+    packed = pack_int4(codes)
+    assert packed.dtype == jnp.int8 and packed.shape == (28, 8)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(codes))
+
+
 def test_int4_roundtrip_grouped():
     from tpu_inference.models.quant import GROUP_SIZE
 
     w = jax.random.normal(jax.random.PRNGKey(2),
                           (2 * GROUP_SIZE, 32)) * 0.05
     qa = quantize_array(w, "int4")
-    assert qa.q.dtype == jnp.int4
+    # Codes are nibble-packed two-per-byte (no sub-byte dtype persists
+    # across jit boundaries — the axon device_put re-layout recursion).
+    assert qa.q.dtype == jnp.int8
+    assert qa.q.shape == (GROUP_SIZE, 32)     # half the contraction dim
     assert qa.scale.shape == (2, 32)          # one scale per (group, col)
     # Per-group symmetric rounding error bound.
     err = jnp.abs(dequantize(qa) - w).reshape(2, GROUP_SIZE, 32)

@@ -115,12 +115,16 @@ def test_config_from_hf_qwen2_and_gemma(tmp_path):
     assert cfg.sliding_window == 0 and cfg.tie_embeddings
     assert cfg.rope_theta == 1000000.0
 
+    # HF windows only layers >= max_window_layers (absent key = HF's
+    # default 28, NOT 0); the global-window engine maps the
+    # all-or-nothing cases and rejects mixed stacks.
     qwen["use_sliding_window"] = True
     (tmp_path / "config.json").write_text(json.dumps(qwen))
+    # absent max_window_layers -> 28 >= 2 layers: full attention.
+    assert config_from_hf(str(tmp_path)).sliding_window == 0
+    qwen["max_window_layers"] = 0        # every layer windowed
+    (tmp_path / "config.json").write_text(json.dumps(qwen))
     assert config_from_hf(str(tmp_path)).sliding_window == 4096
-
-    # HF windows only layers >= max_window_layers; the global-window
-    # engine maps the all-or-nothing cases and rejects mixed stacks.
     qwen["max_window_layers"] = 2        # == num_hidden_layers: full attn
     (tmp_path / "config.json").write_text(json.dumps(qwen))
     assert config_from_hf(str(tmp_path)).sliding_window == 0
@@ -128,6 +132,9 @@ def test_config_from_hf_qwen2_and_gemma(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps(qwen))
     with pytest.raises(ValueError, match="max_window_layers"):
         config_from_hf(str(tmp_path))
+    qwen["sliding_window"] = None        # no window at all: mixed is moot
+    (tmp_path / "config.json").write_text(json.dumps(qwen))
+    assert config_from_hf(str(tmp_path)).sliding_window == 0
     del qwen["max_window_layers"]
 
     gemma = {"model_type": "gemma", "vocab_size": 2048, "hidden_size": 128,

@@ -16,6 +16,14 @@ Design:
   cells are too coarse for one whole-column scale (the GPTQ/AWQ
   group-quant recipe). Registered as a pytree node it survives
   ``lax.scan`` over stacked layer weights and tree-mapped sharding.
+- int4 codes are stored PACKED, two per int8 byte along the contraction
+  dim (rows 2i, 2i+1 -> low, high nibble). Sub-byte (S4) arrays never
+  persist across a jit boundary: the axon TPU runtime's device_put
+  re-layout of persistent S4 arrays recurses into jit (round-5 bench
+  failure), and a packed byte array is the portable representation
+  anyway. The arithmetic-shift unpack is elementwise and fuses into the
+  matmul read; HBM still sees half of int8's weight bytes. Invariant:
+  a grouped scale (G > 1) always pairs with packed codes.
 - ``qdot`` / ``qeinsum`` are drop-in contraction helpers the model
   forwards call for every weight matmul; they accept plain arrays too, so
   quantization stays a load-time decision (EngineConfig.quant) rather
@@ -105,9 +113,33 @@ def _groups_for(in_dim: int, mode: str) -> int:
     return in_dim // GROUP_SIZE
 
 
+def pack_int4(codes: jax.Array) -> jax.Array:
+    """int8 codes [..., in, out] (values in [-7, 7]) -> packed int8
+    [..., in // 2, out]: row 2i in the low nibble, row 2i+1 in the high."""
+    *lead, in_dim, out = codes.shape
+    pairs = codes.reshape(*lead, in_dim // 2, 2, out)
+    lo, hi = pairs[..., 0, :], pairs[..., 1, :]
+    return (lo & jnp.int8(0x0F)) | (hi << 4)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Packed int8 [..., in // 2, out] -> sign-extended int8 codes
+    [..., in, out]. Two arithmetic shifts per nibble — elementwise, so
+    XLA fuses the unpack into the consuming matmul's operand read."""
+    *lead, half, out = packed.shape
+    lo = (packed << 4) >> 4                      # sign-extend low nibble
+    hi = packed >> 4                             # arithmetic: sign-extends
+    return jnp.stack([lo, hi], axis=-2).reshape(*lead, 2 * half, out)
+
+
 def quantize_array(w: jax.Array, mode: str = "int8") -> QuantizedArray:
     """Symmetric narrow-int quantization along the contraction dim
-    (axis -2): int8 per output channel, int4 per (group, channel)."""
+    (axis -2): int8 per output channel, int4 per (group, channel).
+
+    int4 with grouped scales returns PACKED codes (see module docstring);
+    the no-group fallback (contraction dim not divisible by GROUP_SIZE —
+    tiny test models) keeps one code per byte with a per-column scale,
+    which the G == 1 contraction path handles exactly."""
     wf = w.astype(jnp.float32)
     if mode == "int4":
         in_dim, out = w.shape[-2], w.shape[-1]
@@ -115,9 +147,11 @@ def quantize_array(w: jax.Array, mode: str = "int8") -> QuantizedArray:
         wg = wf.reshape(w.shape[:-2] + (ngrp, in_dim // ngrp, out))
         amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
         scale = jnp.maximum(amax, 1e-8) / 7.0
-        q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int4)
-        return QuantizedArray(q=q.reshape(w.shape),
-                              scale=scale[..., 0, :])   # [..., G, out]
+        q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int8)
+        q = q.reshape(w.shape)
+        if ngrp > 1:
+            q = pack_int4(q)
+        return QuantizedArray(q=q, scale=scale[..., 0, :])  # [..., G, out]
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
@@ -128,10 +162,11 @@ def dequantize(w: QuantizedArray, dtype=jnp.float32) -> jax.Array:
     ngrp = w.scale.shape[-2]
     if ngrp == 1:
         return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
-    in_dim, out = w.q.shape[-2], w.q.shape[-1]
-    wg = w.q.reshape(w.q.shape[:-2] + (ngrp, in_dim // ngrp, out))
+    codes = unpack_int4(w.q)
+    in_dim, out = codes.shape[-2], codes.shape[-1]
+    wg = codes.reshape(codes.shape[:-2] + (ngrp, in_dim // ngrp, out))
     full = wg.astype(jnp.float32) * w.scale[..., :, None, :]
-    return full.reshape(w.q.shape).astype(dtype)
+    return full.reshape(codes.shape).astype(dtype)
 
 
 def qdot(x: jax.Array, w: Any) -> jax.Array:
@@ -145,13 +180,15 @@ def qdot(x: jax.Array, w: Any) -> jax.Array:
             y = jnp.dot(x, w.q.astype(x.dtype),
                         preferred_element_type=jnp.float32)
             return y * w.scale[..., 0, :]
-        # Grouped (int4): contract each group separately, fold the
+        # Grouped (int4): unpack the nibble-packed codes (fuses into the
+        # operand read), contract each group separately, fold the
         # per-group partials with their own scales. HBM still reads only
-        # the 4-bit codes + the small scale table.
-        gsz = w.q.shape[-2] // ngrp
+        # the packed 4-bit codes + the small scale table.
+        codes = unpack_int4(w.q)
+        gsz = codes.shape[-2] // ngrp
         ct = _contract_dtype(x.dtype)
         xg = x.reshape(x.shape[:-1] + (ngrp, gsz)).astype(ct)
-        qg = w.q.reshape(ngrp, gsz, w.q.shape[-1]).astype(ct)
+        qg = codes.reshape(ngrp, gsz, codes.shape[-1]).astype(ct)
         y = jnp.einsum("...gi,gio->...go", xg, qg,
                        preferred_element_type=jnp.float32)
         return jnp.sum(y * w.scale, axis=-2)
@@ -176,11 +213,12 @@ def qeinsum(eq: str, a: jax.Array, w: Any) -> jax.Array:
         assert eq in ("ecd,edf->ecf", "ecf,efd->ecd"), (
             f"grouped qeinsum supports the MoE expert contractions, "
             f"got {eq!r}")
-        gsz = w.q.shape[-2] // ngrp
+        codes = unpack_int4(w.q)
+        gsz = codes.shape[-2] // ngrp
         ct = _contract_dtype(a.dtype)
         a4 = a.reshape(a.shape[:-1] + (ngrp, gsz)).astype(ct)  # [E,C,G,g]
-        q4 = w.q.reshape(w.q.shape[0], ngrp, gsz,
-                         w.q.shape[-1]).astype(ct)        # [E, G, g, out]
+        q4 = codes.reshape(codes.shape[0], ngrp, gsz,
+                           codes.shape[-1]).astype(ct)    # [E, G, g, out]
         y = jnp.einsum("ecgi,egio->egco", a4, q4,
                        preferred_element_type=jnp.float32)
         return jnp.sum(y * w.scale[:, :, None, :], axis=1)

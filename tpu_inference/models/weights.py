@@ -220,12 +220,14 @@ def config_from_hf(path: str) -> ModelConfig:
     elif model_type == "qwen2" and hf.get("use_sliding_window"):
         window = int(hf.get("sliding_window") or 0)
         # HF Qwen2 windows only layers >= max_window_layers (the first
-        # max_window_layers layers keep full attention). The engine's
-        # window is global, so only the all-or-nothing cases map:
-        mwl = int(hf.get("max_window_layers") or 0)
+        # max_window_layers layers keep full attention); the HF default
+        # for an absent key is 28, NOT 0. The engine's window is global,
+        # so only the all-or-nothing cases map:
+        mwl = hf.get("max_window_layers")
+        mwl = 28 if mwl is None else int(mwl)
         if mwl >= int(hf["num_hidden_layers"]):
             window = 0           # every layer is below the cutoff: full attn
-        elif mwl != 0:
+        elif mwl != 0 and window:
             raise ValueError(
                 f"qwen2 checkpoint {name!r} uses per-layer sliding window "
                 f"(max_window_layers={mwl} of {hf['num_hidden_layers']}); "

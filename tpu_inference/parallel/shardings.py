@@ -176,8 +176,8 @@ def param_shardings(cfg: ModelConfig, mesh: Mesh,
                         f"int4 grouped scales: {ngrp} groups on a "
                         f"contraction dim sharded over {axis}={n} don't "
                         f"divide evenly; use a tp that divides the group "
-                        f"count (dim/{ngrp and leaf.q.shape[-2]//ngrp}) "
-                        "or --quant int8")
+                        f"count (dim/{2 * leaf.q.shape[-2] // ngrp}, "
+                        "codes nibble-packed) or --quant int8")
             return QuantizedArray(
                 q=NamedSharding(mesh, spec),
                 scale=NamedSharding(mesh, sspec))
