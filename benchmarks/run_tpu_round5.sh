@@ -1,27 +1,59 @@
 #!/bin/bash
 # Round-5 TPU measurement battery (VERDICT r4 items 1-4). Stages run in
 # VALUE order so a mid-battery re-wedge still captures the headline:
-#   bench    hardened bench.py, pallas bf16/int8/dense lanes (BENCH_r05
-#            content; target: re-verify >=510 tok/s on the chip)
+#   bench    hardened bench.py, pallas bf16/int8/int4/dense lanes
+#            (BENCH_r05 content; 556/612 tok/s bf16/int8 landed 01:15)
 #   mosaic   Mosaic-validate the window-aware Pallas kernels + SP
-#            wrappers non-interpret (VERDICT item 4; cheap)
+#            wrappers non-interpret (landed: mosaic_r5.json 6/6 ok)
 #   replay   saturated BurstGPT replay: real 1B ckpt, int8+int8, auto
 #            batch (VERDICT item 2: >=370 tok/s, TTFT p50 < 5 s)
 #   bench8b  BENCH_MODEL=8b int8 lane (BASELINE.md config-1 row)
-#   bench32  BENCH_BATCH=32 chip-sized batch lane
 #   sweep    decode_steps x pipeline-depth mini-sweep (hbm_util push)
+#   bench32  BENCH_BATCH=32 chip-sized batch lane
 #
 #   bash benchmarks/run_tpu_round5.sh [stage ...]   # default: all
+#
+# EVERY python invocation that can touch the TPU goes through guard():
+# its own session/process group, SIGKILLed wholesale on deadline. A
+# TERM-then-orphan kill (plain `timeout`) leaves axon runtime helpers
+# holding the chip — that is exactly how the first round-5 battery run
+# wedged the tunnel mid-battery (replay overran its 1500 s timeout).
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
-STAGES=${@:-"bench mosaic replay bench8b bench32 sweep"}
+STAGES=${@:-"bench mosaic replay bench8b sweep bench32"}
 CKPT=/tmp/real-llama-1b
+
+guard() {
+  # guard <deadline_s> <cmd...>: run in a fresh process group; on
+  # deadline SIGKILL the whole group (never TERM — no orphan window).
+  local deadline=$1; shift
+  setsid "$@" &
+  local pid=$!
+  # Watchdog stdout MUST be detached: call sites pipe the function's
+  # stdout (tee/tail/$()), and an inherited write-end held by the
+  # watchdog's sleep would stall the pipe at EOF for the full deadline
+  # even after the guarded command exits. The deadline diagnostic goes
+  # to stderr, which call sites tie to files (never blocks).
+  (
+    sleep "$deadline"
+    if kill -0 "$pid" 2>/dev/null; then
+      echo "[guard] deadline ${deadline}s hit; SIGKILL group $pid" >&2
+      kill -KILL -- "-$pid" 2>/dev/null
+    fi
+  ) >/dev/null &
+  local watchdog=$!
+  wait "$pid"
+  local rc=$?
+  kill "$watchdog" 2>/dev/null
+  wait "$watchdog" 2>/dev/null
+  return $rc
+}
 
 probe() {
   # Shared wedge-safe probe (bench.py child runner: own process group,
   # SIGKILL on timeout — never orphans a runtime helper on the chip).
-  timeout -k 10 300 python -c "
+  guard 300 python -c "
 import json, sys, bench
 rc, rec = bench._run_child(['--probe'], 120)
 print(json.dumps(rec)) if rec else sys.exit(1)"
@@ -31,56 +63,61 @@ echo "== probe: $(probe || echo UNREACHABLE)"
 
 for s in $STAGES; do case $s in
 bench)
-  echo "== bench.py (4 lanes, headline)"
-  timeout 1400 python bench.py 2>benchmarks/results/bench_r5_tpu.err \
+  echo "== bench.py (5 lanes, headline)"
+  guard 1400 python bench.py 2>benchmarks/results/bench_r5_tpu.err \
     | tee benchmarks/results/bench_r5_tpu.jsonl
   ;;
 mosaic)
   echo "== mosaic-validate windowed kernels (non-interpret)"
-  PYTHONPATH=.:${PYTHONPATH:-} timeout 600 python benchmarks/mosaic_validate.py \
+  guard 600 env "PYTHONPATH=.:${PYTHONPATH:-}" python benchmarks/mosaic_validate.py \
     --out benchmarks/results/mosaic_r5.json \
     2>benchmarks/results/mosaic_r5.err | tail -8
   ;;
 replay)
   if [ -d "$CKPT" ]; then
+    # 60 queries + a 2400 s guard: the first battery's 100-query run
+    # overran 1500 s (early queries TTFT-stall while the autosized
+    # batch-32 decode graphs compile); the guard is sized to never
+    # fire on a healthy run.
     echo "== saturated BurstGPT replay (real 1B, int8+int8, auto batch)"
-    timeout 1500 python benchmarks/replay.py \
+    guard 2400 python benchmarks/replay.py \
       --model "$CKPT" --tokenizer auto \
       --quant int8 --kv-quant int8 \
       --max-batch-size auto --num-pages auto --batch-cap 32 \
-      --trace data/BurstGPT_1.csv --max-trace 100 \
+      --trace data/BurstGPT_1.csv --max-trace 60 \
       --decode-pipeline-depth 2 \
       --out benchmarks/results/real1b_burstgpt_r5_int8_auto.json \
-      2>&1 | tail -5
+      2>benchmarks/results/replay_r5.err | tail -5
   else
     echo "== replay SKIPPED: $CKPT missing"
   fi
   ;;
 bench8b)
   echo "== bench.py BENCH_MODEL=8b (int8-only lane, config-1 row)"
-  BENCH_MODEL=8b timeout 1400 python bench.py \
+  guard 1400 env BENCH_MODEL=8b python bench.py \
     2>benchmarks/results/bench_r5_8b.err \
     | tee benchmarks/results/bench_r5_8b.jsonl
   ;;
 bench32)
   echo "== bench.py BENCH_BATCH=32 (chip-sized batch lane)"
-  BENCH_BATCH=32 timeout 1400 python bench.py \
+  guard 1400 env BENCH_BATCH=32 python bench.py \
     2>benchmarks/results/bench_r5_bs32.err \
     | tee benchmarks/results/bench_r5_bs32.jsonl
   ;;
 sweep)
   echo "== K x depth sweep on the int8 replay config (hbm_util push)"
-  for K in 8 16; do for D in 1 2 4; do
-    [ -d "$CKPT" ] || break 2
-    echo "-- K=$K depth=$D"
-    timeout 900 python benchmarks/replay.py \
+  for KD in "8 2" "16 2" "16 4"; do
+    [ -d "$CKPT" ] || break
+    set -- $KD
+    echo "-- K=$1 depth=$2"
+    guard 1200 python benchmarks/replay.py \
       --model "$CKPT" --tokenizer auto --quant int8 --kv-quant int8 \
       --max-batch-size auto --num-pages auto --batch-cap 32 \
-      --trace data/BurstGPT_1.csv --max-trace 40 \
-      --decode-steps-per-call $K --decode-pipeline-depth $D \
-      --out benchmarks/results/sweep_r5_K${K}_D${D}.json \
-      2>&1 | tail -2
-  done; done
+      --trace data/BurstGPT_1.csv --max-trace 30 \
+      --decode-steps-per-call "$1" --decode-pipeline-depth "$2" \
+      --out "benchmarks/results/sweep_r5_K$1_D$2.json" \
+      2>/dev/null | tail -2
+  done
   ;;
 *) echo "unknown stage $s";;
 esac; done
