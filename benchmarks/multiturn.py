@@ -152,6 +152,11 @@ def main() -> dict:
     p.add_argument("--turns", type=int, default=5)
     p.add_argument("--max-tokens", type=int, default=48,
                    help="assistant tokens per turn")
+    # Consumed by the shared replay.start_server (its parser grew
+    # --sp/--sp-attn in r4; this parser must carry them too).
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel prefill degree")
+    p.add_argument("--sp-attn", default="ring", choices=("ring", "ulysses"))
     p.add_argument("--max-batch-size", type=int, default=8)
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
@@ -160,12 +165,24 @@ def main() -> dict:
     p.add_argument("--decode-pipeline-depth", type=int, default=1)
     p.add_argument("--quant", default="none", choices=("none", "int8"))
     p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
+    p.add_argument("--platform", default="auto",
+                   choices=("auto", "cpu", "tpu"),
+                   help="jax platform; 'cpu' forces the CPU backend "
+                        "before any computation (same pattern as "
+                        "replay.py / tests/conftest.py)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--compare", action="store_true",
                    help="also run with the prefix cache disabled and "
                         "report the TTFT delta")
     p.add_argument("--out", default=None)
     args = p.parse_args()
+
+    if args.platform != "auto":
+        # Before any jax computation (env vars are read too early in
+        # some images; jax.config is the reliable override).
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     # Snapshot before run_once mutates args (enable_prefix_cache toggles).
     out = {"config": dict(vars(args))}

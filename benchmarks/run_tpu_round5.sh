@@ -10,6 +10,8 @@
 #   bench8b  BENCH_MODEL=8b int8 lane (BASELINE.md config-1 row)
 #   sweep    decode_steps x pipeline-depth mini-sweep (hbm_util push)
 #   bench32  BENCH_BATCH=32 chip-sized batch lane
+#   turns    multi-turn chat replay with prefix cache (config-3 row
+#            on the chip; CPU demo landed round 3)
 #
 #   bash benchmarks/run_tpu_round5.sh [stage ...]   # default: all
 #
@@ -21,7 +23,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
-STAGES=${@:-"bench mosaic replay bench8b sweep bench32"}
+STAGES=${@:-"bench mosaic replay bench8b sweep bench32 turns"}
 CKPT=/tmp/real-llama-1b
 
 guard() {
@@ -116,8 +118,20 @@ sweep)
       --trace data/BurstGPT_1.csv --max-trace 30 \
       --decode-steps-per-call "$1" --decode-pipeline-depth "$2" \
       --out "benchmarks/results/sweep_r5_K$1_D$2.json" \
-      2>/dev/null | tail -2
+      2>"benchmarks/results/sweep_r5_K$1_D$2.err" | tail -2
   done
+  ;;
+turns)
+  if [ -d "$CKPT" ]; then
+    echo "== multi-turn chat replay (prefix cache, real 1B, int8)"
+    guard 1800 python benchmarks/multiturn.py \
+      --model "$CKPT" --tokenizer auto --quant int8 \
+      --conversations 6 --turns 5 \
+      --out benchmarks/results/config3_multiturn_r5_tpu.json \
+      2>benchmarks/results/multiturn_r5.err | tail -6
+  else
+    echo "== turns SKIPPED: $CKPT missing"
+  fi
   ;;
 *) echo "unknown stage $s";;
 esac; done
