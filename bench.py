@@ -68,12 +68,15 @@ BASELINE_TOK_S = 93.0  # BASELINE.md: reference-side Ollama single-stream rate
 # Decode slots. The default stays 8 so BENCH_r{N}.json compares across
 # rounds; BENCH_BATCH=32 is the chip-sized lane (engine/autosize.py).
 BATCH = int(os.environ.get("BENCH_BATCH", "8"))
-# Metric key encodes model + batch so a BENCH_BATCH/BENCH_MODEL lane can
-# never be diffed against default-lane history by accident; the default
-# spelling stays exactly "decode_tok_s_llama1b_bs8_pallas".
+# Metric key encodes model + batch (+ non-default fused-K) so a
+# BENCH_BATCH/BENCH_MODEL/BENCH_KSTEPS lane can never be diffed against
+# default-lane history by accident; the default spelling stays exactly
+# "decode_tok_s_llama1b_bs8_pallas".
+_KSTEPS = os.environ.get("BENCH_KSTEPS", "8")
 METRIC = ("decode_tok_s_"
           f"{'llama8b' if os.environ.get('BENCH_MODEL') == '8b' else 'llama1b'}"
-          f"_bs{BATCH}_pallas")
+          f"_bs{BATCH}"
+          f"{'' if _KSTEPS == '8' else f'_k{_KSTEPS}'}_pallas")
 
 PROBE_TIMEOUT_S = 120
 LANE_TIMEOUT_S = 280
@@ -166,15 +169,24 @@ def lane_child(spec: str) -> None:
 
     batch = BATCH
     prompt_len = 120
-    k = 8                                    # fused decode steps per dispatch
+    # Fused decode steps per dispatch. BENCH_KSTEPS lets the battery A/B
+    # larger fusions on the chip (fewer host round trips per token)
+    # without forking the lane code; the default stays 8 so the headline
+    # metric remains comparable across rounds.
+    k = int(os.environ.get("BENCH_KSTEPS", "8"))
     timed_calls = 32 if on_tpu else 2
     ramp_calls = 2
     budget = (timed_calls + ramp_calls + 1) * k
+    # Per-sequence page budget must cover prompt + the K-derived decode
+    # budget (BENCH_KSTEPS=16 pushes prompt+budget past the old 512-token
+    # cap and sequences would finish mid-measurement, silently deflating
+    # the lane's tok/s).
+    pages_per_seq = max(32, -(-(prompt_len + budget) // 16))
     ecfg = EngineConfig(page_size=16,
                         # Pool scales with the lane's batch so BENCH_BATCH
                         # lanes never hit page-pressure mid-measurement.
-                        num_pages=max(512, 32 * batch),
-                        max_pages_per_seq=32,
+                        num_pages=max(512, pages_per_seq * batch),
+                        max_pages_per_seq=pages_per_seq,
                         max_batch_size=batch, prefill_buckets=(128,),
                         decode_steps_per_call=k, max_new_tokens=budget,
                         attn_backend=backend, quant=quant)

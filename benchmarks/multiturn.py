@@ -183,6 +183,9 @@ def main() -> dict:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+        if args.platform == "cpu":
+            jax.config.update("jax_num_cpu_devices",
+                              max(1, args.tp * args.sp))
 
     # Snapshot before run_once mutates args (enable_prefix_cache toggles).
     out = {"config": dict(vars(args))}

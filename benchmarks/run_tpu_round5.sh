@@ -10,6 +10,7 @@
 #   bench8b  BENCH_MODEL=8b int8 lane (BASELINE.md config-1 row)
 #   sweep    decode_steps x pipeline-depth mini-sweep (hbm_util push)
 #   bench32  BENCH_BATCH=32 chip-sized batch lane
+#   bench16k BENCH_KSTEPS=16 fused-K A/B vs the K=8 headline
 #   turns    multi-turn chat replay with prefix cache (config-3 row
 #            on the chip; CPU demo landed round 3)
 #
@@ -23,7 +24,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
-STAGES=${@:-"bench mosaic replay bench8b sweep bench32 turns"}
+STAGES=${@:-"bench mosaic replay bench8b sweep bench32 bench16k turns"}
 CKPT=/tmp/real-llama-1b
 
 guard() {
@@ -105,6 +106,12 @@ bench32)
   guard 1400 env BENCH_BATCH=32 python bench.py \
     2>benchmarks/results/bench_r5_bs32.err \
     | tee benchmarks/results/bench_r5_bs32.jsonl
+  ;;
+bench16k)
+  echo "== bench.py BENCH_KSTEPS=16 (fused-K A/B vs the K=8 headline)"
+  guard 1400 env BENCH_KSTEPS=16 python bench.py \
+    2>benchmarks/results/bench_r5_k16.err \
+    | tee benchmarks/results/bench_r5_k16.jsonl
   ;;
 sweep)
   echo "== K x depth sweep on the int8 replay config (hbm_util push)"
