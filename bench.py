@@ -174,15 +174,20 @@ def lane_child(spec: str) -> None:
     # without forking the lane code; the default stays 8 so the headline
     # metric remains comparable across rounds.
     k = int(os.environ.get("BENCH_KSTEPS", "8"))
-    timed_calls = 32 if on_tpu else 2
+    # Hold total decoded tokens constant across K lanes (timed_calls
+    # scales inversely with k): a K=16 lane that kept timed_calls=32
+    # would decode twice the tokens and time its window at ~2x deeper
+    # KV context, confounding the fused-K A/B with KV-bandwidth cost.
+    timed_calls = max(1, (256 if on_tpu else 16) // k)
     ramp_calls = 2
     budget = (timed_calls + ramp_calls + 1) * k
+    page_size = 16
     # Per-sequence page budget must cover prompt + the K-derived decode
     # budget (BENCH_KSTEPS=16 pushes prompt+budget past the old 512-token
     # cap and sequences would finish mid-measurement, silently deflating
     # the lane's tok/s).
-    pages_per_seq = max(32, -(-(prompt_len + budget) // 16))
-    ecfg = EngineConfig(page_size=16,
+    pages_per_seq = max(32, -(-(prompt_len + budget) // page_size))
+    ecfg = EngineConfig(page_size=page_size,
                         # Pool scales with the lane's batch so BENCH_BATCH
                         # lanes never hit page-pressure mid-measurement.
                         num_pages=max(512, pages_per_seq * batch),

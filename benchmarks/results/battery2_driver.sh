@@ -1,0 +1,6 @@
+#!/bin/bash
+cd /root/repo
+echo "== battery2 start $(date -u +%H:%M:%S)"
+python benchmarks/make_real_model.py --out /tmp/real-llama-1b --size 1b 2>&1 | tail -2
+bash benchmarks/run_tpu_round5.sh replay bench bench8b bench32 sweep bench16k turns
+echo "== battery2 end $(date -u +%H:%M:%S)"
