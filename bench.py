@@ -297,6 +297,44 @@ def _cpu_env():
     return env
 
 
+def _last_tpu_record():
+    """Newest committed on-chip record for this metric, if any.
+
+    Degraded (CPU-fallback) runs embed it so a tunnel wedge at
+    measurement time cannot erase chip evidence already collected and
+    committed earlier in the round (benchmarks/results/bench_r*_tpu.jsonl
+    are written by benchmarks/run_tpu_round*.sh batteries). The embedded
+    record is clearly separated from the live run: the live record keeps
+    ``chip: cpu`` + ``degraded``; this is reported under its own key with
+    the artifact path so a reader can verify provenance.
+    """
+    import glob
+    results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "results")
+    cands = sorted(glob.glob(os.path.join(results, "bench_r*_tpu.jsonl")),
+                   key=os.path.getmtime, reverse=True)
+    for path in cands:
+        best = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if (rec.get("platform") == "tpu"
+                            and rec.get("metric") == METRIC
+                            and rec.get("value")):
+                        best = rec  # later snapshots supersede earlier
+        except OSError:
+            continue
+        if best:
+            rel = os.path.relpath(path, os.path.dirname(
+                os.path.abspath(__file__)))
+            return rel, best
+    return None, None
+
+
 def _snapshot(probe, lanes, degraded, partial, t_start):
     """Assemble the full record from whatever lanes have completed."""
     def lane(spec):
@@ -427,6 +465,17 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
     }
     if degraded:
         rec["degraded"] = degraded
+        art, tpu_rec = _last_tpu_record()
+        if tpu_rec:
+            rec["last_tpu_artifact"] = art
+            rec["last_tpu_result"] = {
+                k: tpu_rec.get(k) for k in (
+                    "value", "unit", "best_lane", "vs_baseline",
+                    "vs_baseline_aggregate", "per_stream_tok_s",
+                    "bf16_tok_s", "int8_tok_s", "int8_chained_tok_s",
+                    "int4_chained_tok_s", "pallas_speedup_vs_dense_chained",
+                    "int8_speedup_vs_bf16", "int4_speedup_vs_bf16",
+                    "mfu", "hbm_util", "backends_token_equal", "chip")}
     if skipped:
         rec["lanes_skipped"] = skipped
     print(json.dumps(rec), flush=True)

@@ -8,6 +8,8 @@ placement, GELU flavor, MoE routing normalization) to the de-facto standard
 implementation.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -143,6 +145,83 @@ def test_gemma_matches_hf(rng):
     ours, _ = llama.forward(params, cfg, jnp.asarray(toks),
                             jnp.asarray(positions), None,
                             common.make_dense_attn())
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(toks)).logits.numpy()
+    _compare_logits(np.asarray(ours), theirs)
+
+
+def test_llama31_rope_scaling_matches_hf(rng):
+    """Llama-3.1 "llama3" rope rescale: original_max_position_embeddings
+    (32) is chosen so that, at head_dim 32 / theta 10000, the frequency
+    table spans all three regimes — untouched high-frequency channels,
+    factor-8-slowed low-frequency channels, and the interpolated band."""
+    cfg = dataclasses.replace(
+        cfgs.tiny_llama(vocab_size=128),
+        rope_scaling=cfgs.RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                      high_freq_factor=4.0,
+                                      original_max_len=32))
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads, num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq_len, rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta, attn_implementation="eager",
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+
+    params = weights.convert_state_dict(cfg, hf.state_dict())
+    toks = _tokens(rng, cfg.vocab_size)
+    positions = np.broadcast_to(np.arange(toks.shape[1]), toks.shape)
+
+    ours, _ = llama.forward(params, cfg, jnp.asarray(toks),
+                            jnp.asarray(positions), None,
+                            common.make_dense_attn())
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(toks)).logits.numpy()
+    _compare_logits(np.asarray(ours), theirs)
+
+    # The rescale must actually bind at these dims — identical logits
+    # with scaling dropped would mean the test pinned nothing.
+    unscaled, _ = llama.forward(
+        params, dataclasses.replace(cfg, rope_scaling=None),
+        jnp.asarray(toks), jnp.asarray(positions), None,
+        common.make_dense_attn())
+    assert not np.allclose(np.asarray(unscaled), theirs, atol=2e-3)
+
+
+def test_phi3_matches_hf(rng):
+    """Phi-3 dialect: fused qkv_proj / gate_up_proj checkpoints split at
+    conversion, plus a BINDING sliding window (window 8 < seq 17) — this
+    pins our window convention (self + window-1 prior tokens) against
+    HF's eager-path Phi3 mask, not just the projection split."""
+    cfg = cfgs.tiny_phi3(vocab_size=128)
+    assert cfg.sliding_window == 8
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+        intermediate_size=cfg.d_ff, num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads, num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_seq_len, rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta, sliding_window=cfg.sliding_window,
+        attn_implementation="eager", tie_word_embeddings=False,
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    hf = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    sd = hf.state_dict()
+    assert "model.layers.0.self_attn.qkv_proj.weight" in sd
+
+    params = weights.convert_state_dict(cfg, sd)
+    toks = _tokens(rng, cfg.vocab_size)  # s=17 > window: the mask binds
+    positions = np.broadcast_to(np.arange(toks.shape[1]), toks.shape)
+
+    ours, _ = llama.forward(params, cfg, jnp.asarray(toks),
+                            jnp.asarray(positions), None,
+                            common.make_dense_attn(cfg.sliding_window))
     with torch.no_grad():
         theirs = hf(torch.from_numpy(toks)).logits.numpy()
     _compare_logits(np.asarray(ours), theirs)

@@ -149,6 +149,105 @@ def test_config_from_hf_qwen2_and_gemma(tmp_path):
     assert cfg.head_dim == 48 and cfg.tie_embeddings  # gemma default ties
 
 
+def _fuse_phi3(cfg, sd):
+    """Rewrite a split llama state dict into Phi-3's fused layout."""
+    fused = {k: v for k, v in sd.items()
+             if "q_proj" not in k and "k_proj" not in k
+             and "v_proj" not in k and "gate_proj" not in k
+             and "up_proj" not in k}
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        fused[p + "self_attn.qkv_proj.weight"] = np.concatenate(
+            [sd[p + f"self_attn.{w}_proj.weight"] for w in "qkv"], axis=0)
+        fused[p + "mlp.gate_up_proj.weight"] = np.concatenate(
+            [sd[p + "mlp.gate_proj.weight"],
+             sd[p + "mlp.up_proj.weight"]], axis=0)
+    return fused
+
+
+def test_load_checkpoint_phi3_fused_split(tmp_path):
+    """Phi-3 fused qkv_proj / gate_up_proj checkpoints produce the exact
+    pytree a split checkpoint would — eager converter, streaming loader,
+    and streaming straight into a TP layout (row-range reads compose with
+    device-slab reads)."""
+    from tpu_inference.parallel import shardings as shd
+    from tpu_inference.parallel.mesh import build_mesh
+
+    cfg = cfgs.tiny_phi3(vocab_size=128)
+    assert cfg.n_heads != cfg.n_kv_heads  # GQA: unequal q/k/v row spans
+    sd_split = _random_llama_sd(cfg, np.random.default_rng(7))
+    sd = _fuse_phi3(cfg, sd_split)
+    assert "model.layers.0.self_attn.qkv_proj.weight" in sd
+    _write_sharded(sd, str(tmp_path))
+
+    want = weights.convert_state_dict(cfg, sd_split)  # split-layout oracle
+    _assert_tree_equal(weights.convert_state_dict(cfg, sd), want)
+    _assert_tree_equal(weights.load_checkpoint(cfg, str(tmp_path)), want)
+
+    mesh = build_mesh(cfgs.ParallelConfig(tp=2))
+    shardings = shd.param_shardings(cfg, mesh)
+    got_tp = weights.load_checkpoint(cfg, str(tmp_path), shardings=shardings)
+    _assert_tree_equal(got_tp, want)
+
+
+def test_config_from_hf_phi3(tmp_path):
+    """model_type phi3 -> llama family + sliding window; LongRoPE
+    (rope_scaling) checkpoints are rejected with a clear error."""
+    from tpu_inference.models.weights import config_from_hf
+
+    phi = {"model_type": "phi3", "vocab_size": 32064, "hidden_size": 3072,
+           "num_hidden_layers": 32, "num_attention_heads": 32,
+           "num_key_value_heads": 32, "intermediate_size": 8192,
+           "rope_theta": 10000.0, "rms_norm_eps": 1e-5,
+           "sliding_window": 2047, "max_position_embeddings": 4096,
+           "rope_scaling": None, "tie_word_embeddings": False}
+    (tmp_path / "config.json").write_text(json.dumps(phi))
+    cfg = config_from_hf(str(tmp_path))
+    assert cfg.family == "llama" and not cfg.qkv_bias
+    assert cfg.sliding_window == 2047 and not cfg.tie_embeddings
+    assert cfg.d_ff == 8192 and cfg.max_seq_len == 4096
+
+    phi["rope_scaling"] = {"type": "longrope",
+                           "short_factor": [1.0], "long_factor": [1.0]}
+    (tmp_path / "config.json").write_text(json.dumps(phi))
+    with pytest.raises(ValueError, match="LongRoPE"):
+        config_from_hf(str(tmp_path))
+
+
+def test_config_from_hf_rope_scaling(tmp_path):
+    """rope_scaling "llama3" (Llama-3.1) parses into RopeScaling; yarn &
+    co. fail loudly (silently ignoring a rescale serves a different
+    model); null and "default" mean vanilla rope."""
+    from tpu_inference.models.weights import config_from_hf
+
+    base = {"model_type": "llama", "vocab_size": 1024, "hidden_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "intermediate_size": 256,
+            "rope_theta": 500000.0, "rope_scaling": None}
+    (tmp_path / "config.json").write_text(json.dumps(base))
+    assert config_from_hf(str(tmp_path)).rope_scaling is None
+
+    base["rope_scaling"] = {"rope_type": "llama3", "factor": 8.0,
+                            "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                            "original_max_position_embeddings": 8192}
+    (tmp_path / "config.json").write_text(json.dumps(base))
+    rs = config_from_hf(str(tmp_path)).rope_scaling
+    assert rs == cfgs.RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                  high_freq_factor=4.0, original_max_len=8192)
+
+    # Legacy key spelling ("type" instead of "rope_type") still parses.
+    base["rope_scaling"] = {"type": "llama3", "factor": 4.0,
+                            "low_freq_factor": 1.0, "high_freq_factor": 2.0,
+                            "original_max_position_embeddings": 4096}
+    (tmp_path / "config.json").write_text(json.dumps(base))
+    assert config_from_hf(str(tmp_path)).rope_scaling.factor == 4.0
+
+    base["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    (tmp_path / "config.json").write_text(json.dumps(base))
+    with pytest.raises(ValueError, match="yarn"):
+        config_from_hf(str(tmp_path))
+
+
 def test_load_checkpoint_streams_into_tp_layout(tmp_path):
     """Sharded load: every leaf lands with its TP NamedSharding and the
     assembled global values equal the unsharded oracle."""

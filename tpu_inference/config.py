@@ -17,6 +17,23 @@ import jax.numpy as jnp
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1 "llama3" rope frequency rescale (static, per-channel).
+
+    Long-wavelength channels (wavelen > original_max_len /
+    low_freq_factor) divide their frequency by ``factor``; short ones
+    keep it; the band between interpolates smoothly. Position-independent,
+    so it folds into the inverse-frequency table
+    (models/common.py rope_frequencies).
+    """
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_len: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """Architecture hyperparameters for a decoder-only transformer.
 
@@ -34,6 +51,9 @@ class ModelConfig:
     d_ff: int = 14336
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # Llama-3.1+ checkpoints rescale rope frequencies (rope_type
+    # "llama3" in HF config.json); None = vanilla rope.
+    rope_scaling: Optional[RopeScaling] = None
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     # MoE (Mixtral family); n_experts == 0 means dense FFN.
@@ -103,6 +123,17 @@ def llama3_70b() -> ModelConfig:
     )
 
 
+def llama31_8b() -> ModelConfig:
+    """Llama-3.1-8B: 3.0 dims + the "llama3" rope rescale that extends
+    context to 128k (rope_scaling in HF config.json, parsed by
+    weights.config_from_hf)."""
+    return ModelConfig(
+        name="llama-3.1-8b", family="llama", vocab_size=128256, d_model=4096,
+        n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        max_seq_len=131072, rope_theta=500000.0, rope_scaling=RopeScaling(),
+    )
+
+
 def mixtral_8x7b() -> ModelConfig:
     return ModelConfig(
         name="mixtral-8x7b", family="mixtral", vocab_size=32000, d_model=4096,
@@ -134,6 +165,19 @@ def qwen2_7b() -> ModelConfig:
         n_layers=28, n_heads=28, n_kv_heads=4, d_ff=18944,
         max_seq_len=8192, rope_theta=1000000.0, norm_eps=1e-6,
         qkv_bias=True,
+    )
+
+
+def phi3_mini() -> ModelConfig:
+    """Phi-3-mini-4k: Llama-shaped MHA (32 heads, no GQA) with a
+    2047-token sliding window. HF checkpoints store fused qkv_proj /
+    gate_up_proj tensors; the loader splits them at read time
+    (models/weights.py fused-plan branch) so TP sharding and quantization
+    see the standard llama layout."""
+    return ModelConfig(
+        name="phi-3-mini", family="llama", vocab_size=32064, d_model=3072,
+        n_layers=32, n_heads=32, n_kv_heads=32, d_ff=8192,
+        max_seq_len=4096, rope_theta=10000.0, sliding_window=2047,
     )
 
 
@@ -202,6 +246,13 @@ def tiny_gemma(vocab_size: int = 512) -> ModelConfig:
     )
 
 
+def tiny_phi3(vocab_size: int = 512) -> ModelConfig:
+    """tiny_llama + a binding sliding window; loads from fused-projection
+    (phi3-style) checkpoints via the fused-plan branch in weights.py."""
+    return dataclasses.replace(tiny_llama(vocab_size), name="tiny-phi3",
+                               sliding_window=8)
+
+
 def tiny_gpt2(vocab_size: int = 512) -> ModelConfig:
     return ModelConfig(
         name="tiny-gpt2", family="gpt2", vocab_size=vocab_size, d_model=128,
@@ -213,17 +264,20 @@ def tiny_gpt2(vocab_size: int = 512) -> ModelConfig:
 
 PRESETS = {
     "llama-3-8b": llama3_8b,
+    "llama-3.1-8b": llama31_8b,
     "llama-3-70b": llama3_70b,
     "mixtral-8x7b": mixtral_8x7b,
     "mistral-7b": mistral_7b,
     "qwen2-7b": qwen2_7b,
     "gemma-7b": gemma_7b,
+    "phi-3-mini": phi3_mini,
     "gpt2": gpt2_small,
     "tiny-llama": tiny_llama,
     "tiny-qwen2": tiny_qwen2,
     "tiny-gemma": tiny_gemma,
     "tiny-mixtral": tiny_mixtral,
     "tiny-mistral": tiny_mistral,
+    "tiny-phi3": tiny_phi3,
     "tiny-gpt2": tiny_gpt2,
 }
 

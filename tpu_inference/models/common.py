@@ -58,20 +58,45 @@ def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array,
     return out.astype(x.dtype)
 
 
-def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
-    """Inverse frequencies for rotary embeddings, [head_dim // 2] f32."""
+def rope_frequencies(head_dim: int, theta: float,
+                     scaling=None) -> jax.Array:
+    """Inverse frequencies for rotary embeddings, [head_dim // 2] f32.
+
+    ``scaling`` (config.RopeScaling) applies the Llama-3.1 "llama3"
+    per-channel rescale, matching HF's _compute_llama3_parameters:
+    channels with wavelength above original_max_len/low_freq_factor run
+    ``factor``× slower, those below original_max_len/high_freq_factor are
+    untouched, and the band between interpolates by how far the original
+    context fits into the wavelength.
+    """
     exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta ** exponent)
+    inv_freq = 1.0 / (theta ** exponent)
+    if scaling is not None:
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = ((scaling.original_max_len / wavelen
+                   - scaling.low_freq_factor)
+                  / (scaling.high_freq_factor - scaling.low_freq_factor))
+        interp = ((1.0 - smooth) * inv_freq / scaling.factor
+                  + smooth * inv_freq)
+        inv_freq = jnp.where(
+            wavelen > scaling.original_max_len / scaling.low_freq_factor,
+            inv_freq / scaling.factor,
+            jnp.where(
+                wavelen < scaling.original_max_len / scaling.high_freq_factor,
+                inv_freq, interp))
+    return inv_freq
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float,
+               scaling=None) -> jax.Array:
     """Rotary position embedding.
 
     x: [B, S, H, D]; positions: [B, S] int32. Uses the half-split pairing
     (first half with second half), matching HF Llama's rotate_half.
+    ``scaling`` forwards to rope_frequencies (Llama-3.1 rescale).
     """
     half = x.shape[-1] // 2
-    inv_freq = rope_frequencies(x.shape[-1], theta)           # [half]
+    inv_freq = rope_frequencies(x.shape[-1], theta, scaling)  # [half]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,S,half]
     cos = jnp.cos(angles)[:, :, None, :]                      # [B,S,1,half]
     sin = jnp.sin(angles)[:, :, None, :]

@@ -90,8 +90,8 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     q = q.reshape(b, s, cfg.n_heads, hd)
     k = k.reshape(b, s, cfg.n_kv_heads, hd)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
     attn_out, kv = attn(layer_idx, q, k, v, kv)
     attn_out = attn_out.reshape(b, s, cfg.n_heads * hd)

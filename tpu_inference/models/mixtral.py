@@ -113,9 +113,10 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     q = qdot(h, lp["wq"]).astype(x.dtype)
     k = qdot(h, lp["wk"]).astype(x.dtype)
     v = qdot(h, lp["wv"]).astype(x.dtype)
-    q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions, cfg.rope_theta)
+    q = apply_rope(q.reshape(b, s, cfg.n_heads, hd), positions,
+                   cfg.rope_theta, cfg.rope_scaling)
     k = apply_rope(k.reshape(b, s, cfg.n_kv_heads, hd), positions,
-                   cfg.rope_theta)
+                   cfg.rope_theta, cfg.rope_scaling)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)
 
     attn_out, kv = attn(layer_idx, q, k, v, kv)

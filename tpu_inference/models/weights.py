@@ -43,21 +43,52 @@ def _stack(state: Dict[str, Any], fmt: str, n_layers: int,
     return np.stack(mats)
 
 
+# Phi-3 checkpoints store q/k/v (and gate/up) fused along the out dim;
+# the serving layout keeps them split so the TP sharding and quantization
+# paths are identical across the llama family. One span definition feeds
+# both the eager converter and the streaming planner — they must split at
+# identical row offsets.
+_FUSED_QKV_KEY = "self_attn.qkv_proj.weight"
+_FUSED_GATE_UP_KEY = "mlp.gate_up_proj.weight"
+
+
+def _fused_qkv_spans(cfg: ModelConfig) -> tuple:
+    """(q_end, k_end, v_end) row offsets inside the fused qkv tensor."""
+    q_end = cfg.n_heads * cfg.head_dim
+    k_end = q_end + cfg.n_kv_heads * cfg.head_dim
+    return q_end, k_end, k_end + cfg.n_kv_heads * cfg.head_dim
+
+
 def convert_llama(cfg: ModelConfig, sd: Dict[str, Any]) -> dict:
     L = cfg.n_layers
     p = "model.layers.{}."
+    fused = p.format(0) + _FUSED_QKV_KEY in sd
+    if fused:
+        f = cfg.d_ff
+        q_end, k_end, _ = _fused_qkv_spans(cfg)
+        qkv = _stack(sd, p + _FUSED_QKV_KEY, L, transpose=True)
+        gu = _stack(sd, p + _FUSED_GATE_UP_KEY, L, transpose=True)
+        attn_ffn = {
+            "wq": qkv[..., :q_end], "wk": qkv[..., q_end:k_end],
+            "wv": qkv[..., k_end:],
+            "w_gate": gu[..., :f], "w_up": gu[..., f:],
+        }
+    else:
+        attn_ffn = {
+            "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+            "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+            "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+            "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
+            "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
+        }
     params = {
         "embed": _np(sd["model.embed_tokens.weight"]),
         "blocks": {
             "attn_norm": _stack(sd, p + "input_layernorm.weight", L),
-            "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
-            "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
-            "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
             "wo": _stack(sd, p + "self_attn.o_proj.weight", L, transpose=True),
             "ffn_norm": _stack(sd, p + "post_attention_layernorm.weight", L),
-            "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
-            "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
             "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
+            **attn_ffn,
         },
         "final_norm": _np(sd["model.norm.weight"]),
     }
@@ -178,7 +209,8 @@ def config_from_hf(path: str) -> ModelConfig:
     The reference's workflow is "point the server at a model and serve it"
     (Ollama pulls by name); the equivalent here is pointing at a local HF
     directory — architecture hyperparameters come from the checkpoint, not
-    from a hand-maintained preset. Supports llama, mixtral and gpt2.
+    from a hand-maintained preset. Supports llama, mistral, qwen2, gemma,
+    phi3 (all served by the llama module), mixtral and gpt2.
     """
     import jax.numpy as jnp
 
@@ -205,17 +237,46 @@ def config_from_hf(path: str) -> ModelConfig:
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             use_learned_pos=True, use_bias=True, tie_embeddings=True,
             dtype=dtype)
-    if model_type not in ("llama", "mixtral", "mistral", "qwen2", "gemma"):
+    if model_type not in ("llama", "mixtral", "mistral", "qwen2", "gemma",
+                          "phi3"):
         raise ValueError(f"unsupported model_type {model_type!r} in "
                          f"{path}/config.json")
+    if model_type == "phi3" and hf.get("rope_scaling"):
+        # Phi-3 long-context variants (128k) use LongRoPE: two rescaled
+        # rope frequency tables switched on context length — unsupported.
+        # The 4k checkpoints carry rope_scaling: null and serve natively.
+        raise ValueError(
+            f"phi3 checkpoint {name!r} uses rope_scaling="
+            f"{hf['rope_scaling'].get('type', hf['rope_scaling'])!r} "
+            "(LongRoPE); only rope_scaling: null Phi-3 checkpoints (4k "
+            "context) are supported")
     heads = hf["num_attention_heads"]
     gemma = model_type == "gemma"
+    # Llama-3.1+ rescale rope frequencies per channel (rope_type
+    # "llama3"); serving such a checkpoint without the rescale is a
+    # different model, so it is parsed (not ignored) and unsupported
+    # schemes (yarn, linear, dynamic) fail loudly.
+    rope_scaling = None
+    rs = hf.get("rope_scaling")
+    if rs:
+        from tpu_inference.config import RopeScaling
+        kind = rs.get("rope_type", rs.get("type", "default"))
+        if kind == "llama3":
+            rope_scaling = RopeScaling(
+                factor=float(rs["factor"]),
+                low_freq_factor=float(rs["low_freq_factor"]),
+                high_freq_factor=float(rs["high_freq_factor"]),
+                original_max_len=int(rs["original_max_position_embeddings"]))
+        elif kind != "default":
+            raise ValueError(
+                f"checkpoint {name!r} uses rope_scaling type {kind!r}; "
+                "only 'llama3' (and null/'default') are supported")
     # Gemma checkpoints ("gelu"/"gelu_pytorch_tanh", both the tanh
     # approximation in practice) vs the SiLU dialects.
     act = "gelu_tanh" if gemma else "silu"
     # Qwen2 configs carry sliding_window but gate it behind
     # use_sliding_window (default false); Mistral windows unconditionally.
-    if model_type == "mistral":
+    if model_type in ("mistral", "phi3"):
         window = int(hf.get("sliding_window") or 0)
     elif model_type == "qwen2" and hf.get("use_sliding_window"):
         window = int(hf.get("sliding_window") or 0)
@@ -243,6 +304,7 @@ def config_from_hf(path: str) -> ModelConfig:
         d_ff=hf["intermediate_size"],
         max_seq_len=hf.get("max_position_embeddings", 8192),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
         norm_eps=hf.get("rms_norm_eps", 1e-5),
         tie_embeddings=bool(hf.get("tie_word_embeddings", gemma)),
         n_experts=hf.get("num_local_experts", 0),
@@ -309,9 +371,13 @@ class _CheckpointFiles:
         return h.get_slice(key)
 
 
-# A leaf plan is (keys, transpose): ``keys`` is one HF tensor name, or a
-# (nested) list of names stacked along leading axes (layers, then experts);
-# ``transpose`` swaps the trailing 2 dims (HF Linear [out,in] -> [in,out]).
+# A leaf plan is (keys, transpose[, rows]): ``keys`` is one HF tensor name,
+# or a (nested) list of names stacked along leading axes (layers, then
+# experts); ``transpose`` swaps the trailing 2 dims (HF Linear [out,in] ->
+# [in,out]); optional ``rows = (start, stop)`` restricts the leaf to a row
+# range of the HF tensor's out dim (dim 0 pre-transpose) — how Phi-3's
+# fused qkv_proj / gate_up_proj split into separate param leaves without
+# ever materializing the fused tensor.
 _Plan = tuple
 
 
@@ -322,18 +388,36 @@ def _plan_llama(cfg: ModelConfig, have) -> dict:
     def lk(s):
         return [p.format(i) + s for i in range(L)]
 
+    if p.format(0) + _FUSED_QKV_KEY in have:
+        # Phi-3 fused layout: each split leaf reads a row range of the
+        # fused HF tensor (rows = out dim pre-transpose), so streaming
+        # still touches only the bytes each device shard needs.
+        f = cfg.d_ff
+        q_end, k_end, v_end = _fused_qkv_spans(cfg)
+        qkv, gu = lk(_FUSED_QKV_KEY), lk(_FUSED_GATE_UP_KEY)
+        attn_ffn = {
+            "wq": (qkv, True, (0, q_end)),
+            "wk": (qkv, True, (q_end, k_end)),
+            "wv": (qkv, True, (k_end, v_end)),
+            "w_gate": (gu, True, (0, f)),
+            "w_up": (gu, True, (f, 2 * f)),
+        }
+    else:
+        attn_ffn = {
+            "wq": (lk("self_attn.q_proj.weight"), True),
+            "wk": (lk("self_attn.k_proj.weight"), True),
+            "wv": (lk("self_attn.v_proj.weight"), True),
+            "w_gate": (lk("mlp.gate_proj.weight"), True),
+            "w_up": (lk("mlp.up_proj.weight"), True),
+        }
     plan = {
         "embed": ("model.embed_tokens.weight", False),
         "blocks": {
             "attn_norm": (lk("input_layernorm.weight"), False),
-            "wq": (lk("self_attn.q_proj.weight"), True),
-            "wk": (lk("self_attn.k_proj.weight"), True),
-            "wv": (lk("self_attn.v_proj.weight"), True),
             "wo": (lk("self_attn.o_proj.weight"), True),
             "ffn_norm": (lk("post_attention_layernorm.weight"), False),
-            "w_gate": (lk("mlp.gate_proj.weight"), True),
-            "w_up": (lk("mlp.up_proj.weight"), True),
             "w_down": (lk("mlp.down_proj.weight"), True),
+            **attn_ffn,
         },
         "final_norm": ("model.norm.weight", False),
     }
@@ -414,31 +498,39 @@ _PLANNERS = {"llama": _plan_llama, "gpt2": _plan_gpt2,
              "mixtral": _plan_mixtral}
 
 
-def _base_shape(files: _CheckpointFiles, keys, transpose: bool) -> tuple:
+def _base_shape(files: _CheckpointFiles, keys, transpose: bool,
+                rows=None) -> tuple:
     """Global shape of a leaf: stacked leading axes + (transposed) base."""
     stack = []
     while isinstance(keys, list):
         stack.append(len(keys))
         keys = keys[0]
     base = tuple(files.get_slice(keys).get_shape())
+    if rows is not None:
+        base = (rows[1] - rows[0],) + base[1:]
     if transpose:
         base = base[:-2] + (base[-1], base[-2])
     return tuple(stack) + base
 
 
 def _read_slab(files: _CheckpointFiles, keys, transpose: bool,
-               index: tuple) -> np.ndarray:
+               index: tuple, rows=None) -> np.ndarray:
     """Read the sub-array ``leaf[index]`` touching only the needed bytes."""
     if isinstance(keys, list):
         rng = range(len(keys))[index[0]]
-        parts = [_read_slab(files, keys[i], transpose, index[1:])
+        parts = [_read_slab(files, keys[i], transpose, index[1:], rows)
                  for i in rng]
         return np.stack(parts)
     sl = files.get_slice(keys)
     if transpose:
         index = index[:-2] + (index[-1], index[-2])
-        return np.asarray(sl[index]).swapaxes(-1, -2)
-    return np.asarray(sl[index])
+    if rows is not None:
+        # index is in HF-tensor coordinates here (post transpose-swap);
+        # shift its dim-0 slice into the fused tensor's row range.
+        d0 = index[0]
+        index = (slice(d0.start + rows[0], d0.stop + rows[0]),) + index[1:]
+    out = np.asarray(sl[index])
+    return out.swapaxes(-1, -2) if transpose else out
 
 
 def load_checkpoint(cfg: ModelConfig, path: str,
@@ -465,13 +557,15 @@ def load_checkpoint(cfg: ModelConfig, path: str,
     dtype = cfg.dtype
 
     def build(tree_path, leaf_plan: _Plan, sharding=None):
-        keys, transpose = leaf_plan
-        shape = _base_shape(files, keys, transpose)
+        keys, transpose, *rest = leaf_plan
+        rows = rest[0] if rest else None
+        shape = _base_shape(files, keys, transpose, rows)
         full = tuple(slice(0, s) for s in shape)
 
         def read(index=full):
             index = tuple(slice(*i.indices(s)) for i, s in zip(index, shape))
-            return _read_slab(files, keys, transpose, index).astype(dtype)
+            return _read_slab(files, keys, transpose, index,
+                              rows).astype(dtype)
 
         if sharding is None:
             arr = jnp.asarray(read())
