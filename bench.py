@@ -309,10 +309,18 @@ def _last_tpu_record():
     the artifact path so a reader can verify provenance.
     """
     import glob
+    import re
     results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "benchmarks", "results")
+
+    def round_no(path):
+        m = re.search(r"bench_r(\d+)_tpu", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    # Highest round first — mtime is checkout order on a fresh clone,
+    # not measurement order.
     cands = sorted(glob.glob(os.path.join(results, "bench_r*_tpu.jsonl")),
-                   key=os.path.getmtime, reverse=True)
+                   key=round_no, reverse=True)
     for path in cands:
         best = None
         try:

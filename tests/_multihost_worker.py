@@ -11,6 +11,15 @@ import json
 import sys
 
 import jax
+
+# Persistent XLA compilation cache, same knobs as the suite (this file
+# is launched as a bare subprocess, so conftest never runs here; script
+# dir is sys.path[0]). The cross-process psum + engine graphs dominate
+# this worker's runtime.
+import _xla_cache
+
+_xla_cache.enable(jax)
+
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,31 @@
+"""Persistent XLA compilation cache for the test suite.
+
+The suite is ~70% XLA:CPU compile time on a single-core box, and the
+graphs are identical run to run, so the compiled executables are cached
+on disk (keyed by HLO + compile options + jaxlib version). Shared by
+tests/conftest.py and the bare-subprocess tests/_multihost_worker.py so
+the knobs cannot drift.
+
+``jax_persistent_cache_enable_xla_caches="all"`` is required for XLA:CPU
+executable reuse (the default scope caches nothing useful on CPU).
+Reusing an executable on the same machine triggers a cosmetic
+cpu_aot_loader machine-feature warning per load (XLA's pseudo-features
+like +prefer-no-scatter are absent from the host-feature string), so
+TF_CPP_MIN_LOG_LEVEL silences C++ logging below FATAL; tests assert via
+Python exceptions, not glog. Numeric parity tests would catch a
+genuinely bad cached executable; delete the dir to force recompiles, or
+set TPU_INF_NO_XLA_CACHE=1 to opt out.
+"""
+
+import os
+
+
+def enable(jax) -> None:
+    if os.environ.get("TPU_INF_NO_XLA_CACHE"):
+        return
+    os.environ["TF_CPP_MIN_LOG_LEVEL"] = "3"
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("TPU_INF_XLA_CACHE",
+                                     "/tmp/tpu_inference_xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")

@@ -24,6 +24,15 @@ jax.config.update("jax_num_cpu_devices", 8)
 # error on standard-normal f32 inputs), which swamps parity tolerances.
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# Persistent XLA compilation cache: a warm test_speculative.py run drops
+# 41s -> 11s (rationale + knobs in tests/_xla_cache.py).
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _xla_cache  # noqa: E402
+
+_xla_cache.enable(jax)
+
 
 def randomize_qkv_biases(params, seed: int = 7, scale: float = 0.1) -> None:
     """init_params zero-inits Qwen2's q/k/v biases; tests randomize them

@@ -24,14 +24,49 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(scope="module")
-def real_dir(tmp_path_factory):
-    out = str(tmp_path_factory.mktemp("real-model"))
+def real_dir():
+    """Real-format checkpoint dir, cached across suite runs.
+
+    Building it (subprocess: jax+torch import, BPE training, sharded
+    safetensors write) costs ~30 s — the single most expensive fixture in
+    the suite — and its output is a pure function of the builder script +
+    args + corpus, so it is cached in /tmp keyed by a hash of exactly
+    those inputs. A builder or corpus edit changes the key and rebuilds.
+
+    Concurrency/crash safety: the build lands in a unique sibling temp
+    dir (same filesystem — os.rename never crosses a mount), the
+    .complete marker is written BEFORE the atomic rename, and a lost
+    rename race (ENOTEMPTY/EEXIST: another run published first) falls
+    back to the winner's dir. A complete cache dir is never deleted.
+    """
+    import hashlib
+    import shutil
+
+    builder = os.path.join(REPO, "benchmarks/make_real_model.py")
+    data = os.path.join(REPO, "data/conversations.json")
+    args = ["--size", "tiny", "--vocab-size", "1024", "--data", data]
+    h = hashlib.sha256()
+    for path in (builder, data):
+        with open(path, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(args).encode())
+    cached = f"/tmp/tpu_inference_test_real_model_{h.hexdigest()[:16]}"
+    marker = os.path.join(cached, ".complete")
+    if os.path.exists(marker):
+        return cached
+    tmp = f"{cached}.tmp{os.getpid()}"
     subprocess.run(
-        [sys.executable, os.path.join(REPO, "benchmarks/make_real_model.py"),
-         "--out", out, "--size", "tiny", "--vocab-size", "1024",
-         "--data", os.path.join(REPO, "data/conversations.json")],
+        [sys.executable, builder, "--out", tmp, *args],
         check=True, cwd=REPO, capture_output=True)
-    return out
+    with open(os.path.join(tmp, ".complete"), "w") as f:
+        f.write("ok")
+    try:
+        os.rename(tmp, cached)
+    except OSError:
+        shutil.rmtree(tmp)
+        if not os.path.exists(marker):
+            raise
+    return cached
 
 
 def test_config_from_hf(real_dir):
