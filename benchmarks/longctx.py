@@ -1,0 +1,146 @@
+"""Long-context serving benchmark: chunked prefill + deep-context decode.
+
+Long context is a first-class capability (SURVEY.md §5): this measures
+the two numbers that define it on a single chip — **prefill throughput**
+(tok/s through the incremental chunked-prefill path, interleavable with
+decode in production) and **decode TPOT at deep context** (per-token
+latency once the KV holds ``--ctx`` tokens, where paged attention's
+O(pages) reads and the int8 KV tier earn their keep).
+
+Drives the PRODUCTION serving loop (EngineScheduler: admission, chunked
+prefill, fused decode, streaming callbacks) — not a hand-rolled forward
+loop — with one synthetic ``--ctx``-token prompt. TTFT here is
+engine-side (no HTTP/tokenizer), labeled as such in the output.
+
+Usage:
+    python benchmarks/longctx.py --model /tmp/real-llama-1b --ctx 8192 \
+        --quant int8 --kv-quant int8 --out benchmarks/results/longctx.json
+
+Emits one JSON line: {"metric": "longctx", "ctx": N,
+"prefill_tok_s": ..., "ttft_s": ..., "tpot_ms": ..., ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> dict:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="tiny-llama",
+                   help="preset name or HF checkpoint dir")
+    p.add_argument("--ctx", type=int, default=8192,
+                   help="prompt length (tokens) to prefill")
+    p.add_argument("--decode-tokens", type=int, default=64,
+                   help="decode steps measured at full context")
+    p.add_argument("--chunk", type=int, default=512,
+                   help="prefill chunk size (the compiled bucket)")
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--quant", default="none",
+                   choices=("none", "int8", "int4"))
+    p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
+    p.add_argument("--attn-backend", default="auto",
+                   choices=("auto", "dense", "pallas"))
+    p.add_argument("--platform", default="auto",
+                   choices=("auto", "cpu", "tpu"))
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from tpu_inference.config import PRESETS, EngineConfig
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    if os.path.isdir(args.model):
+        from tpu_inference.models.weights import config_from_hf
+
+        model_cfg = config_from_hf(args.model)
+        checkpoint = args.model
+    else:
+        model_cfg = PRESETS[args.model]()
+        checkpoint = None
+
+    total = args.ctx + args.decode_tokens + 1
+    pages_per_seq = -(-total // args.page_size) + 1
+    ecfg = EngineConfig(
+        page_size=args.page_size, num_pages=pages_per_seq + 2,
+        max_pages_per_seq=pages_per_seq, max_batch_size=1,
+        prefill_buckets=(args.chunk,), max_new_tokens=args.decode_tokens,
+        quant=args.quant, kv_quant=args.kv_quant,
+        attn_backend=args.attn_backend)
+
+    t_build = time.perf_counter()
+    if checkpoint:
+        from tpu_inference.models.weights import load_checkpoint
+
+        params = load_checkpoint(model_cfg, checkpoint, quant=args.quant)
+        engine = InferenceEngine(model_cfg, ecfg, params=params)
+    else:
+        engine = InferenceEngine(model_cfg, ecfg)
+    build_s = time.perf_counter() - t_build
+
+    # Synthetic prompt: deterministic ids away from special tokens.
+    prompt = [17 + (i * 7919) % (model_cfg.vocab_size - 32)
+              for i in range(args.ctx)]
+
+    token_times: list = []
+    done = threading.Event()
+    sched = EngineScheduler(engine).start()
+    try:
+        seq = Sequence(request_id=0, prompt_tokens=prompt,
+                       max_new_tokens=args.decode_tokens)
+        t0 = time.perf_counter()
+        sched.submit(seq, on_token=lambda s, t: token_times.append(
+            time.perf_counter()), on_finish=lambda s: done.set())
+        if not done.wait(timeout=3600):
+            raise TimeoutError("long-context generation hung")
+    finally:
+        sched.stop(drain=False)
+
+    ttft = token_times[0] - t0
+    decode_s = token_times[-1] - token_times[0]
+    n = len(token_times)
+    import jax
+
+    rec = {
+        "metric": "longctx",
+        "model": model_cfg.name,
+        "ctx": args.ctx,
+        "chunk": args.chunk,
+        "quant": args.quant,
+        "kv_quant": args.kv_quant,
+        "backend": engine.attn_backend,
+        "platform": jax.default_backend(),
+        # TTFT covers the full chunked prefill of ctx tokens plus the
+        # first decode dispatch (engine-side: no HTTP/tokenizer in the
+        # path, unlike replay.py's client-side TTFT).
+        "ttft_s": round(ttft, 3),
+        "prefill_tok_s": round(args.ctx / ttft, 1),
+        "decode_tokens": n,
+        # One token = no decode interval to measure; null, not a
+        # 1e-9-floor artifact.
+        "tpot_ms": round(decode_s / (n - 1) * 1e3, 2) if n > 1 else None,
+        "decode_tok_s": round((n - 1) / decode_s, 2) if n > 1 else None,
+        "build_s": round(build_s, 1),
+    }
+    print(json.dumps(rec), flush=True)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+    return rec
+
+
+if __name__ == "__main__":
+    main()

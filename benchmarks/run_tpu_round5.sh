@@ -8,6 +8,8 @@
 #   replay   saturated BurstGPT replay: real 1B ckpt, int8+int8, auto
 #            batch (VERDICT item 2: >=370 tok/s, TTFT p50 < 5 s)
 #   bench8b  BENCH_MODEL=8b int8 lane (BASELINE.md config-1 row)
+#   longctx  8k chunked prefill + deep-context decode TPOT, KV bf16 vs
+#            int8 A/B (benchmarks/longctx.py — SURVEY §5 long context)
 #   sweep    decode_steps x pipeline-depth mini-sweep (hbm_util push)
 #   bench32  BENCH_BATCH=32 chip-sized batch lane
 #   bench16k BENCH_KSTEPS=16 fused-K A/B vs the K=8 headline
@@ -24,7 +26,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
-STAGES=${@:-"bench mosaic replay bench8b sweep bench32 bench16k turns"}
+STAGES=${@:-"bench mosaic replay bench8b longctx sweep bench32 bench16k turns"}
 CKPT=/tmp/real-llama-1b
 
 guard() {
@@ -127,6 +129,23 @@ sweep)
       --out "benchmarks/results/sweep_r5_K$1_D$2.json" \
       2>"benchmarks/results/sweep_r5_K$1_D$2.err" | tail -2
   done
+  ;;
+longctx)
+  if [ -d "$CKPT" ]; then
+    # Long context on ONE chip (SURVEY §5 first-class capability):
+    # 8k-token chunked prefill + decode TPOT at full context, int8
+    # weights, KV bf16 vs int8 A/B (the KV tier's deep-context payoff).
+    echo "== long-context: 8k prefill + deep-ctx decode (real 1B, int8)"
+    for KVQ in none int8; do
+      guard 1200 python benchmarks/longctx.py \
+        --model "$CKPT" --ctx 8192 --decode-tokens 64 --chunk 512 \
+        --quant int8 --kv-quant "$KVQ" \
+        --out "benchmarks/results/longctx_r5_kv$KVQ.json" \
+        2>"benchmarks/results/longctx_r5_kv$KVQ.err" | tail -1
+    done
+  else
+    echo "== longctx SKIPPED: $CKPT missing"
+  fi
   ;;
 turns)
   if [ -d "$CKPT" ]; then

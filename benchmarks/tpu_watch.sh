@@ -35,7 +35,7 @@ sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)"; then
     # Replay first: the saturated BurstGPT replay is the round's most
     # valuable missing artifact (bench/mosaic headline already landed
     # 01:15; a mid-battery re-wedge must not cost it again).
-    bash benchmarks/run_tpu_round5.sh replay bench bench8b bench32 sweep bench16k turns
+    bash benchmarks/run_tpu_round5.sh replay bench bench8b longctx bench32 sweep bench16k turns
     exit 0
   fi
   echo "[watch] $(date -u +%H:%M:%S) probe $n: tunnel still wedged; sleeping ${INTERVAL}s"

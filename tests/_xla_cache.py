@@ -23,7 +23,7 @@ import os
 def enable(jax) -> None:
     if os.environ.get("TPU_INF_NO_XLA_CACHE"):
         return
-    os.environ["TF_CPP_MIN_LOG_LEVEL"] = "3"
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("TPU_INF_XLA_CACHE",
                                      "/tmp/tpu_inference_xla_cache"))

@@ -55,17 +55,21 @@ def real_dir():
     if os.path.exists(marker):
         return cached
     tmp = f"{cached}.tmp{os.getpid()}"
-    subprocess.run(
-        [sys.executable, builder, "--out", tmp, *args],
-        check=True, cwd=REPO, capture_output=True)
-    with open(os.path.join(tmp, ".complete"), "w") as f:
-        f.write("ok")
     try:
+        subprocess.run(
+            [sys.executable, builder, "--out", tmp, *args],
+            check=True, cwd=REPO, capture_output=True)
+        with open(os.path.join(tmp, ".complete"), "w") as f:
+            f.write("ok")
         os.rename(tmp, cached)
     except OSError:
-        shutil.rmtree(tmp)
+        # Lost the publish race (ENOTEMPTY: another run renamed first).
+        shutil.rmtree(tmp, ignore_errors=True)
         if not os.path.exists(marker):
             raise
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)  # failed build: no orphans
+        raise
     return cached
 
 
