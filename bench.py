@@ -228,8 +228,7 @@ def lane_child(spec: str) -> None:
     mean_ctx = float(np.mean([s.ctx_len for s in engine.slots
                               if s is not None]))
     head = [int(t) for t in engine.slots[0].generated[:8]]
-    weight_bytes = int(sum(x.size * x.dtype.itemsize
-                           for x in jax.tree.leaves(engine.params)))
+    weight_bytes = int(engine.weight_bytes)  # same math as /api/ps
     print(json.dumps({
         "lane": spec, "model": cfg.name, "platform": platform,
         "sync_tok_s": sync_tok_s, "chained_tok_s": chained_tok_s,

@@ -254,6 +254,12 @@ def test_aux_routes(server):
         assert info["llama.attention.sliding_window"] == 0
         assert info["serving.swa_eviction"] is False
         assert info["serving.prefix_cache"] is True
+        # Ollama GET /api/ps: the one loaded model, never unloading.
+        ps = await (await client.get("/api/ps")).json()
+        (entry,) = ps["models"]
+        assert entry["name"] == "tiny-llama"
+        assert entry["size"] > 0 and entry["size_vram"] == entry["size"]
+        assert entry["expires_at"].startswith("0001-01-01")
 
     _run(server, go)
 

@@ -290,6 +290,11 @@ class InferenceEngine:
             kv_scale_sh = shd.kv_scale_sharding(mesh)
         self.params = params
         self.n_params = int(sum(x.size for x in jax.tree.leaves(params)))
+        # Resident bytes of the (possibly quantized) weights — global
+        # logical size, independent of sharding. Reported by /api/ps and
+        # used by bench.py's hbm_util roofline math.
+        self.weight_bytes = int(sum(x.nbytes
+                                    for x in jax.tree.leaves(params)))
         self.attn_backend = backend
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh,
                                      scale_sharding=kv_scale_sh)

@@ -146,6 +146,7 @@ class InferenceServer:
         app.router.add_post("/api/show", self.handle_show)
         app.router.add_post("/api/embeddings", self.handle_embeddings)
         app.router.add_post("/api/embed", self.handle_embeddings)
+        app.router.add_get("/api/ps", self.handle_ps)
         app.router.add_get("/api/version", self.handle_version)
         app.router.add_get("/healthz", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
@@ -181,6 +182,25 @@ class InferenceServer:
             "model": self.cfg.server.model_name,
             "details": {"family": self.cfg.model.family,
                         "parameter_size": self.cfg.model.name},
+        }]})
+
+    async def handle_ps(self, request: web.Request) -> web.Response:
+        """Ollama GET /api/ps: the loaded ("running") models. One entry —
+        this server loads its model at boot and never unloads it, so
+        ``expires_at`` is the zero time (Ollama's "never")."""
+        mc = self.cfg.model
+        # dp replicas each hold a full weights copy: resident HBM is
+        # per-replica bytes x replica count.
+        size = int(self.engine.weight_bytes) * len(self.group.engines)
+        return web.json_response({"models": [{
+            "name": self.cfg.server.model_name,
+            "model": self.cfg.server.model_name,
+            "size": size,
+            "size_vram": size,     # weights live in HBM, nothing on host
+            "details": {"family": mc.family,
+                        "parameter_size": mc.name,
+                        "quantization_level": self.cfg.engine.quant},
+            "expires_at": "0001-01-01T00:00:00Z",
         }]})
 
     async def handle_show(self, request: web.Request) -> web.Response:
