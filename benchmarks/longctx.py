@@ -45,7 +45,8 @@ def main() -> dict:
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--quant", default="none",
                    choices=("none", "int8", "int4"))
-    p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
+    p.add_argument("--kv-quant", default="none",
+                   choices=("none", "int8", "int4"))
     p.add_argument("--attn-backend", default="auto",
                    choices=("auto", "dense", "pallas"))
     p.add_argument("--platform", default="auto",

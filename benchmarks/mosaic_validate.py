@@ -119,6 +119,24 @@ def main() -> int:
             return f"max abs err {np.abs(np.asarray(got) - want).max():.2e}"
         return None
 
+    def swa_decode4():
+        # int4 nibble-packed pool: proves the in-kernel integer
+        # unpack (shift/mask/select + lane-dim concat) lowers through
+        # Mosaic, not just interpret mode.
+        kq, ks = kvc.quantize_kv_int4(jnp.asarray(k_pool))
+        vq, vs = kvc.quantize_kv_int4(jnp.asarray(v_pool))
+        got = paged_attention(jnp.asarray(q1), kq, vq, jnp.asarray(bt),
+                              jnp.asarray(kv_lens), ks, vs,
+                              sliding_window=window, interpret=False)
+        kd = np.asarray(kvc.unpack_int4_kv(kq), np.float32) \
+            * np.asarray(ks)[..., None]
+        vd = np.asarray(kvc.unpack_int4_kv(vq), np.float32) \
+            * np.asarray(vs)[..., None]
+        want = decode_ref(kd, vd, q1)
+        if not np.allclose(np.asarray(got), want, rtol=5e-2, atol=5e-2):
+            return f"max abs err {np.abs(np.asarray(got) - want).max():.2e}"
+        return None
+
     s = 24
     q_off = np.array([0, 16, 8], np.int32)
     pf_lens = (q_off + s).astype(np.int32)
@@ -205,6 +223,7 @@ def main() -> int:
 
     check("swa_decode", swa_decode)
     check("swa_decode8", swa_decode8)
+    check("swa_decode4", swa_decode4)
     check("swa_prefill", swa_prefill)
     check("swa_prefill8", swa_prefill8)
     check("ring_swa", ring_swa)

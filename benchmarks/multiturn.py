@@ -164,7 +164,8 @@ def main() -> dict:
     p.add_argument("--decode-steps-per-call", type=int, default=8)
     p.add_argument("--decode-pipeline-depth", type=int, default=1)
     p.add_argument("--quant", default="none", choices=("none", "int8"))
-    p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
+    p.add_argument("--kv-quant", default="none",
+                   choices=("none", "int8", "int4"))
     p.add_argument("--platform", default="auto",
                    choices=("auto", "cpu", "tpu"),
                    help="jax platform; 'cpu' forces the CPU backend "

@@ -174,7 +174,8 @@ def main() -> dict:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--quant", default="none",
                    choices=("none", "int8", "int4"))
-    p.add_argument("--kv-quant", default="none", choices=("none", "int8"))
+    p.add_argument("--kv-quant", default="none",
+                   choices=("none", "int8", "int4"))
     p.add_argument("--platform", default="auto",
                    choices=("auto", "cpu", "tpu"),
                    help="jax platform; 'cpu' forces the CPU backend "

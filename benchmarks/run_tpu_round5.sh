@@ -134,9 +134,10 @@ longctx)
   if [ -d "$CKPT" ]; then
     # Long context on ONE chip (SURVEY §5 first-class capability):
     # 8k-token chunked prefill + decode TPOT at full context, int8
-    # weights, KV bf16 vs int8 A/B (the KV tier's deep-context payoff).
+    # weights, KV bf16 vs int8 vs packed-int4 A/B (the KV tiers'
+    # deep-context payoff).
     echo "== long-context: 8k prefill + deep-ctx decode (real 1B, int8)"
-    for KVQ in none int8; do
+    for KVQ in none int8 int4; do
       guard 1200 python benchmarks/longctx.py \
         --model "$CKPT" --ctx 8192 --decode-tokens 64 --chunk 512 \
         --quant int8 --kv-quant "$KVQ" \

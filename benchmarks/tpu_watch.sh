@@ -5,7 +5,7 @@
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL=${TPU_WATCH_INTERVAL_S:-600}
-DEADLINE=${TPU_WATCH_DEADLINE_S:-28800}   # give up after 8h
+DEADLINE=${TPU_WATCH_DEADLINE_S:-43200}   # give up after 12h (a full round)
 start=$(date +%s)
 n=0
 while :; do

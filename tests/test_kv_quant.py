@@ -137,6 +137,84 @@ def test_both_quant_tiers_together():
     assert all(0 <= tok < cfg.vocab_size for t in out for tok in t)
 
 
+def test_quantize_kv_int4_roundtrip_and_bounds():
+    """Nibble pack/unpack is lossless on the codes; dequant error stays
+    inside the per-row quantization envelope (scale/2 per element)."""
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 5, 3, 16)) * 2.0
+    packed, scale = kvc.quantize_kv_int4(x)
+    assert packed.dtype == jnp.uint8 and packed.shape == (2, 5, 3, 8)
+    codes = kvc.unpack_int4_kv(packed)
+    assert codes.shape == x.shape
+    assert int(jnp.max(jnp.abs(codes))) <= 7
+    err = jnp.abs(codes.astype(jnp.float32) * scale[..., None] - x)
+    assert bool((err <= scale[..., None] / 2 + 1e-6).all())
+
+
+def test_kv_int4_pool_alloc():
+    cfg = tiny_llama()
+    kv = kvc.alloc_kv_pages(cfg, EngineConfig(**BASE, kv_quant="int4"))
+    assert kv.quantized and kv.packed_int4
+    assert kv.k.dtype == jnp.uint8
+    assert kv.k.shape[-1] == cfg.head_dim // 2
+    assert kv.k_scale.shape[-1] == cfg.n_kv_heads
+    odd = dataclasses.replace(cfg, d_model=120, n_heads=4, n_kv_heads=2,
+                              head_dim_override=15)
+    with pytest.raises(ValueError, match="even head_dim"):
+        kvc.alloc_kv_pages(odd, EngineConfig(**BASE, kv_quant="int4"))
+
+
+def test_dense_and_pallas_token_equal_kv_int4():
+    """Both backends read the SAME packed nibbles; greedy tokens must
+    agree exactly (in-kernel unpack+dequant == gather unpack+dequant)."""
+    cfg = tiny_llama()
+    dense = InferenceEngine(cfg, EngineConfig(**BASE, kv_quant="int4"),
+                            seed=0).generate(PROMPTS, max_new_tokens=10)
+    pallas = InferenceEngine(
+        cfg, EngineConfig(**BASE, kv_quant="int4", attn_backend="pallas"),
+        seed=0).generate(PROMPTS, max_new_tokens=10)
+    assert dense == pallas
+
+
+def test_kv_int4_dequant_error_bounded_at_pool_scale():
+    """Full write->gather through the paged pool at realistic shapes:
+    int4 dequant error stays in its expected band (~10% relative for
+    7-level symmetric on standard-normal data) and strictly below a
+    hard ceiling. Token-level closeness vs full precision is NOT
+    asserted: on a random-init tiny model greedy argmax margins are
+    smaller than honest int4 noise (int8 is the accuracy-safe tier;
+    the cross-backend exact-equality test pins implementation
+    correctness instead)."""
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, kv_quant="int4")
+    kv = kvc.alloc_kv_pages(cfg, ecfg)
+    k_new = jax.random.normal(jax.random.PRNGKey(1),
+                              (1, 16, cfg.n_kv_heads, cfg.head_dim))
+    v_new = jax.random.normal(jax.random.PRNGKey(2), k_new.shape)
+    bt = jnp.zeros((1, ecfg.max_pages_per_seq), jnp.int32).at[0, 0].set(3)
+    slots = kvc.slot_mapping(bt, jnp.arange(16)[None],
+                             jnp.ones((1, 16), bool), ecfg.page_size)
+    kv = kvc.write_kv(kv, 0, k_new, v_new, slots)
+    k_got, v_got = kvc.gather_kv(kv, 0, bt)
+    for got, ref in ((k_got, k_new), (v_got, v_new)):
+        rel = float(jnp.linalg.norm(got[0, :16] - ref[0])
+                    / jnp.linalg.norm(ref[0]))
+        assert rel < 0.15, rel
+
+
+def test_tp_sharded_kv_int4_matches_unsharded():
+    """The packed pool (trailing dim D/2) shards on the kv-head dim like
+    every other pool; TP generation is token-equal to unsharded."""
+    from tpu_inference.parallel.mesh import build_mesh
+    cfg = tiny_llama()
+    ecfg = EngineConfig(**BASE, kv_quant="int4", attn_backend="pallas")
+    base = InferenceEngine(cfg, ecfg, seed=0).generate(PROMPTS,
+                                                       max_new_tokens=10)
+    mesh = build_mesh(ParallelConfig(tp=2))
+    tp_eng = InferenceEngine(cfg, ecfg, seed=0, mesh=mesh)
+    assert tp_eng.kv.k.dtype == jnp.uint8
+    assert base == tp_eng.generate(PROMPTS, max_new_tokens=10)
+
+
 def test_unknown_kv_quant_mode_rejected():
     import pytest
     cfg = tiny_llama()

@@ -335,8 +335,10 @@ class EngineConfig:
     # KV-cache quantization: "int8" stores pool pages as int8 codes with
     # per-(token, kv-head) f32 scales (engine/kv_cache.py quantize_kv) —
     # halves KV HBM traffic AND doubles the context that fits in a pool
-    # of the same byte size. Dequant is in-kernel (Pallas) or at gather
-    # (dense path).
+    # of the same byte size. "int4" nibble-packs codes (uint8 pool,
+    # trailing dim D/2) for quarter traffic / 4x context at lower
+    # fidelity (7 levels per half-range; int8 is the accuracy-safe
+    # tier). Dequant is in-kernel (Pallas) or at gather (dense path).
     kv_quant: str = "none"
     # Sequence-parallel prefill algorithm on an sp>1 mesh: "ring"
     # (ppermute K/V rotation, O((S/n)^2) memory — the long-context

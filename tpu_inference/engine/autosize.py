@@ -88,12 +88,15 @@ def kv_bytes_per_token(model_cfg, kv_quant: str = "none") -> int:
     """Pool bytes one token occupies across all layers (K and V).
 
     bf16: 2 * L * Hkv * D * 2; int8: codes (1 byte) + a per-(token,
-    kv-head) f32 scale — engine/kv_cache.py layouts."""
+    kv-head) f32 scale; int4: nibble-packed codes (D/2 bytes) + the
+    same f32 scale — engine/kv_cache.py layouts."""
     L = model_cfg.n_layers
     hkv = model_cfg.n_kv_heads
     d = model_cfg.head_dim
     if kv_quant == "int8":
         return 2 * L * hkv * (d + 4)
+    if kv_quant == "int4":
+        return 2 * L * hkv * (d // 2 + 4)
     return 2 * L * hkv * d * 2
 
 

@@ -40,6 +40,15 @@ class KVPages(NamedTuple):
     traffic for the KV working set halves vs bf16; dequantization
     happens on the consumer side (in-kernel for Pallas, at gather for
     the dense path). ``None`` scales = unquantized pool.
+
+    With int4 (kv_quant="int4") k/v hold **uint8 nibble-packed** codes
+    ``[..., head_dim // 2]`` — byte i carries code i (low nibble) and
+    code i + head_dim/2 (high nibble), so unpacking is a concat, never
+    an interleave — with the same per-(token, head) scale pools. KV HBM
+    traffic quarters vs bf16. The mode is carried by the pool DTYPE
+    (uint8 = packed int4, int8 = int8), which stays static under jit —
+    a bool field here would become a traced pytree leaf inside the
+    decode-step carry.
     """
 
     k: jax.Array
@@ -59,6 +68,10 @@ class KVPages(NamedTuple):
     def quantized(self) -> bool:
         return self.k_scale is not None
 
+    @property
+    def packed_int4(self) -> bool:
+        return self.k.dtype == jnp.uint8
+
 
 def alloc_kv_pages(model_cfg: ModelConfig, engine_cfg: EngineConfig,
                    dtype=None, sharding=None,
@@ -68,11 +81,18 @@ def alloc_kv_pages(model_cfg: ModelConfig, engine_cfg: EngineConfig,
     shape = (model_cfg.n_layers, engine_cfg.num_pages, engine_cfg.page_size,
              model_cfg.n_kv_heads, model_cfg.head_dim)
     dtype = dtype or model_cfg.dtype
-    if engine_cfg.kv_quant not in ("none", "int8"):
+    if engine_cfg.kv_quant not in ("none", "int8", "int4"):
         raise ValueError(f"unknown kv_quant mode {engine_cfg.kv_quant!r}; "
-                         "one of ('none', 'int8')")
-    if engine_cfg.kv_quant == "int8":
-        zeros = jax.jit(lambda: jnp.zeros(shape, jnp.int8),
+                         "one of ('none', 'int8', 'int4')")
+    if engine_cfg.kv_quant == "int4" and model_cfg.head_dim % 2:
+        raise ValueError("kv_quant='int4' needs an even head_dim to "
+                         f"nibble-pack, got {model_cfg.head_dim}")
+    if engine_cfg.kv_quant != "none":
+        code_dtype = (jnp.uint8 if engine_cfg.kv_quant == "int4"
+                      else jnp.int8)
+        code_shape = (shape[:-1] + (shape[-1] // 2,)
+                      if engine_cfg.kv_quant == "int4" else shape)
+        zeros = jax.jit(lambda: jnp.zeros(code_shape, code_dtype),
                         out_shardings=sharding)
         szeros = jax.jit(lambda: jnp.zeros(shape[:-1], jnp.float32),
                          out_shardings=scale_sharding)
@@ -92,6 +112,38 @@ def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
     return q, scale
+
+
+def quantize_kv_int4(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(token, head) symmetric int4 over head_dim, nibble-packed.
+
+    x: [B, S, Hkv, D] -> (packed uint8 [B,S,Hkv,D//2], scale f32
+    [B,S,Hkv]). Codes live in [-7, 7]; byte i = code i (low nibble) |
+    code i+D/2 (high nibble) so unpack is a concat along D.
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -7, 7).astype(jnp.int32)
+    half = x.shape[-1] // 2
+    lo, hi = q[..., :half], q[..., half:]
+    packed = ((hi << 4) | (lo & 0xF)) & 0xFF
+    return packed.astype(jnp.uint8), scale
+
+
+def unpack_int4_kv(packed: jax.Array) -> jax.Array:
+    """uint8 nibble-packed codes [..., D//2] -> int32 codes [..., D].
+
+    Pure integer ops (compare/select sign extension, no bitcasts), so it
+    lowers both through XLA (dense gather path) and Mosaic (in-kernel
+    dequant in the paged decode/prefill kernels).
+    """
+    p = packed.astype(jnp.int32)
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    lo = lo - jnp.where(lo > 7, 16, 0)
+    hi = hi - jnp.where(hi > 7, 16, 0)
+    return jnp.concatenate([lo, hi], axis=-1)
 
 
 def slot_mapping(block_tables: jax.Array, positions: jax.Array,
@@ -116,8 +168,9 @@ def write_kv(kv: KVPages, layer_idx: jax.Array, k_new: jax.Array,
     L, P, pg, H, D = kv.k.shape
     flat = slots.reshape(-1)
     if kv.quantized:
-        k_new, ks = quantize_kv(k_new)
-        v_new, vs = quantize_kv(v_new)
+        qfn = quantize_kv_int4 if kv.packed_int4 else quantize_kv
+        k_new, ks = qfn(k_new)
+        v_new, vs = qfn(v_new)
         ksf = kv.k_scale.reshape(L, P * pg, H)
         vsf = kv.v_scale.reshape(L, P * pg, H)
         ksf = ksf.at[layer_idx, flat].set(ks.reshape(-1, H))
@@ -144,6 +197,8 @@ def gather_kv(kv: KVPages, layer_idx: jax.Array,
     _, _, pg, H, D = kv.k.shape
     k = kv.k[layer_idx][block_tables].reshape(b, mp * pg, H, D)
     v = kv.v[layer_idx][block_tables].reshape(b, mp * pg, H, D)
+    if kv.packed_int4:
+        k, v = unpack_int4_kv(k), unpack_int4_kv(v)
     if kv.quantized:
         ks = kv.k_scale[layer_idx][block_tables].reshape(b, mp * pg, H)
         vs = kv.v_scale[layer_idx][block_tables].reshape(b, mp * pg, H)

@@ -33,9 +33,19 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _unpack_int4(packed):
+    """uint8 nibble-packed [..., D//2] -> f32 [..., D]. ONE copy of the
+    packing contract (engine/kv_cache.py unpack_int4_kv: integer
+    compare/select sign extension, Mosaic-friendly); the f32 cast is
+    this kernel's consumption dtype."""
+    from tpu_inference.engine.kv_cache import unpack_int4_kv
+
+    return unpack_int4_kv(packed).astype(jnp.float32)
+
+
 def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
                    *rest, page_size: int, scale: float, quantized: bool,
-                   sliding_window: int = 0):
+                   packed: bool = False, sliding_window: int = 0):
     if quantized:
         ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -66,8 +76,13 @@ def _decode_kernel(block_tables_ref, kv_len_ref, q_ref, k_ref, v_ref,
         q = q_ref[0].astype(jnp.float32)                  # [Hkv, R, D]
         # Mosaic requires dot_general batch dims at matching positions, so
         # bring the kv-head dim to the front before the batched contractions.
-        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
-        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
+        if packed:
+            # int4: one uint8 read of half a page's bytes, unpacked in VMEM.
+            k = _unpack_int4(k_ref[0]).transpose(1, 0, 2)    # [Hkv, pg, D]
+            v = _unpack_int4(v_ref[0]).transpose(1, 0, 2)
+        else:
+            k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv,pg,D]
+            v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
         if quantized:
             # int8 codes * per-(token, head) scale — dequant in VMEM, so
             # HBM sees one int8 read of the page.
@@ -120,8 +135,10 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     block_tables: [B, MP] int32 physical page ids (0 = trash page)
     kv_len:       [B] int32 valid tokens per sequence (incl. current)
     k/v_scale:    [P, page_size, Hkv] f32 — present when the pool holds
-                  int8 codes (engine/kv_cache.py quantize_kv); dequant
-                  happens in VMEM after each page's DMA.
+                  int8 codes (engine/kv_cache.py quantize_kv) or uint8
+                  nibble-packed int4 codes (quantize_kv_int4; pool
+                  trailing dim D/2); dequant happens in VMEM after each
+                  page's DMA.
     sliding_window > 0 (SWA, Mistral): only the pages overlapping the
     last ``sliding_window`` positions are streamed — the grid's page
     axis shrinks to the window's page span and the index maps offset
@@ -132,8 +149,11 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     quantized = k_scale is not None
+    # uint8 pool = nibble-packed int4 codes (engine/kv_cache.py); the
+    # pool's trailing dim is D/2 bytes and the kernel unpacks in VMEM.
+    packed = k_pages.dtype == jnp.uint8
     b, hq, d = q.shape
-    _, page_size, hkv, _ = k_pages.shape
+    _, page_size, hkv, d_pool = k_pages.shape
     n_rep = hq // hkv
     mp = block_tables.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -156,7 +176,7 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         def page_idx(i, p, bt, kl):
             return bt[i, p]
 
-    page_spec = pl.BlockSpec((1, page_size, hkv, d),
+    page_spec = pl.BlockSpec((1, page_size, hkv, d_pool),
                              lambda i, p, bt, kl: (page_idx(i, p, bt, kl),
                                                    0, 0, 0))
     in_specs = [
@@ -186,7 +206,7 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, page_size=page_size, scale=scale,
-                          quantized=quantized,
+                          quantized=quantized, packed=packed,
                           sliding_window=sliding_window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),

@@ -38,9 +38,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _unpack_int4(packed):
+    """uint8 nibble-packed [..., D//2] -> f32 [..., D]. ONE copy of the
+    packing contract (engine/kv_cache.py unpack_int4_kv: integer
+    compare/select sign extension, Mosaic-friendly); the f32 cast is
+    this kernel's consumption dtype."""
+    from tpu_inference.engine.kv_cache import unpack_int4_kv
+
+    return unpack_int4_kv(packed).astype(jnp.float32)
+
+
 def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
                     v_ref, *rest, page_size: int, block_q: int, n_rep: int,
-                    scale: float, quantized: bool, sliding_window: int = 0):
+                    scale: float, quantized: bool, packed: bool = False,
+                    sliding_window: int = 0):
     if quantized:
         ks_ref, vs_ref, out_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -75,8 +86,12 @@ def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
         q = q_ref[0, 0].astype(jnp.float32)               # [Hkv, bq*R, D]
         # Mosaic wants batched dot dims in matching positions: kv-head
         # leading on both sides.
-        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, pg, D]
-        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+        if packed:
+            k = _unpack_int4(k_ref[0]).transpose(1, 0, 2)    # [Hkv, pg, D]
+            v = _unpack_int4(v_ref[0]).transpose(1, 0, 2)
+        else:
+            k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [Hkv,pg,D]
+            v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
         if quantized:
             k = k * ks_ref[0].astype(jnp.float32).transpose(1, 0)[:, :, None]
             v = v * vs_ref[0].astype(jnp.float32).transpose(1, 0)[:, :, None]
@@ -131,15 +146,19 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
     block_tables: [B, MP] int32 physical page ids (0 = trash page)
     kv_len:       [B] total valid tokens (cached prefix + this chunk)
     q_offset:     [B] absolute position of q[:, 0] (= prefix length)
-    k/v_scale:    [P, page_size, Hkv] f32 when the pool is int8-quantized
-                  (engine/kv_cache.py); dequant happens in VMEM per page.
+    k/v_scale:    [P, page_size, Hkv] f32 when the pool is quantized —
+                  int8 codes or uint8 nibble-packed int4 (trailing dim
+                  D/2); dequant happens in VMEM per page.
     Returns [B, S, Hq, D] in q.dtype.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     quantized = k_scale is not None
+    # uint8 pool = nibble-packed int4 codes (engine/kv_cache.py); the
+    # pool's trailing dim is D/2 bytes and the kernel unpacks in VMEM.
+    packed = k_pages.dtype == jnp.uint8
     b, s, hq, d = q.shape
-    _, page_size, hkv, _ = k_pages.shape
+    _, page_size, hkv, d_pool = k_pages.shape
     n_rep = hq // hkv
     mp = block_tables.shape[1]
     scale = 1.0 / (d ** 0.5)
@@ -171,7 +190,7 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
         def page_idx(i, qb, p, bt, kl, qo):
             return bt[i, p]
 
-    page_spec = pl.BlockSpec((1, page_size, hkv, d),
+    page_spec = pl.BlockSpec((1, page_size, hkv, d_pool),
                              lambda i, qb, p, bt, kl, qo: (
                                  page_idx(i, qb, p, bt, kl, qo), 0, 0, 0))
     in_specs = [
@@ -205,7 +224,7 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
     out = pl.pallas_call(
         functools.partial(_prefill_kernel, page_size=page_size, block_q=bq,
                           n_rep=n_rep, scale=scale, quantized=quantized,
-                          sliding_window=sliding_window),
+                          packed=packed, sliding_window=sliding_window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_qb, hkv, bq * n_rep, d),
                                        q.dtype),

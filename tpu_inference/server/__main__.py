@@ -71,10 +71,13 @@ def main() -> None:
                         "as int8 + per-channel scales (int4: 4-bit + "
                         "group-128 scales, quartering), halving the HBM "
                         "weight traffic that bounds decode throughput")
-    p.add_argument("--kv-quant", default="none", choices=("none", "int8"),
+    p.add_argument("--kv-quant", default="none",
+                   choices=("none", "int8", "int4"),
                    help="KV-cache quantization: int8 codes + per-token-"
                         "head scales — halves KV HBM traffic and doubles "
-                        "the context a same-sized pool holds")
+                        "the context a same-sized pool holds; int4 "
+                        "nibble-packs (quarter traffic, lossier — int8 "
+                        "is the accuracy-safe tier)")
     p.add_argument("--draft-model", default=None,
                    help="enable speculative decoding with this draft "
                         "preset or HF checkpoint dir")
