@@ -26,7 +26,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/results
-STAGES=${@:-"bench mosaic replay bench8b longctx sweep bench32 bench16k turns"}
+STAGES=${@:-"bench mosaic replay bench8b longctx sweep bench32 bench64 bench16k turns"}
 CKPT=/tmp/real-llama-1b
 
 guard() {
@@ -108,6 +108,15 @@ bench32)
   guard 1400 env BENCH_BATCH=32 python bench.py \
     2>benchmarks/results/bench_r5_bs32.err \
     | tee benchmarks/results/bench_r5_bs32.jsonl
+  ;;
+bench64)
+  # Decode reads the weights once per step regardless of batch: if the
+  # bs32 lane still scales ~linearly, 64 slots push hbm_util further
+  # toward the roofline (HBM supports it at 1B scale; autosize math).
+  echo "== bench.py BENCH_BATCH=64 (roofline-push batch lane)"
+  guard 1400 env BENCH_BATCH=64 python bench.py \
+    2>benchmarks/results/bench_r5_bs64.err \
+    | tee benchmarks/results/bench_r5_bs64.jsonl
   ;;
 bench16k)
   echo "== bench.py BENCH_KSTEPS=16 (fused-K A/B vs the K=8 headline)"

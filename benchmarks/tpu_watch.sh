@@ -35,7 +35,9 @@ sys.exit(0 if rec and rec.get('platform') == 'tpu' else 1)"; then
     # Replay first: the saturated BurstGPT replay is the round's most
     # valuable missing artifact (bench/mosaic headline already landed
     # 01:15; a mid-battery re-wedge must not cost it again).
-    bash benchmarks/run_tpu_round5.sh replay bench bench8b longctx bench32 sweep bench16k turns
+    # mosaic re-runs even though 6/6 landed 01:15: swa_decode4 (int4 KV
+    # unpack) was added after that run and needs its Mosaic proof.
+    bash benchmarks/run_tpu_round5.sh replay bench mosaic bench8b longctx bench32 bench64 sweep bench16k turns
     exit 0
   fi
   echo "[watch] $(date -u +%H:%M:%S) probe $n: tunnel still wedged; sleeping ${INTERVAL}s"

@@ -153,7 +153,7 @@ def auto_size(model_cfg, *, hbm_bytes: Optional[float] = None,
             f"{model_cfg.name}: KV budget ({budget / 1e9:.2f} GB/chip) "
             f"holds only {num_pages} pages < one full sequence "
             f"({max_pages_per_seq}); lower --max-pages-per-seq or "
-            "--kv-quant int8")
+            "shrink the pool bytes with --kv-quant int8 (or int4)")
     ctx = int(target_ctx) if target_ctx else (page_size * max_pages_per_seq
                                               // 2)
     ctx = max(1, min(ctx, page_size * max_pages_per_seq))

@@ -38,14 +38,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _unpack_int4(packed):
-    """uint8 nibble-packed [..., D//2] -> f32 [..., D]. ONE copy of the
-    packing contract (engine/kv_cache.py unpack_int4_kv: integer
-    compare/select sign extension, Mosaic-friendly); the f32 cast is
-    this kernel's consumption dtype."""
-    from tpu_inference.engine.kv_cache import unpack_int4_kv
-
-    return unpack_int4_kv(packed).astype(jnp.float32)
+# Shared with the decode kernel — one f32-consuming unpack wrapper over
+# the single packing contract in engine/kv_cache.py.
+from tpu_inference.kernels.paged_attention import _unpack_int4  # noqa: E402
 
 
 def _prefill_kernel(block_tables_ref, kv_len_ref, q_offset_ref, q_ref, k_ref,
