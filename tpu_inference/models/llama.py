@@ -103,15 +103,22 @@ def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
     return x, kv
 
 
-def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
-                   positions: jax.Array, kv: Any,
-                   attn: AttentionFn) -> Tuple[jax.Array, Any]:
-    """Token ids -> final hidden states. tokens, positions: [B, S]."""
+def embed_tokens(params: dict, cfg: ModelConfig,
+                 tokens: jax.Array) -> jax.Array:
+    """Token ids -> input embeddings (shared with parallel/pipeline.py)."""
     x = params["embed"][tokens].astype(cfg.dtype)
     if cfg.embed_scale:
         # Gemma: HF casts the sqrt(d) normalizer to the activation dtype
         # before multiplying; match that rounding for parity.
         x = x * jnp.asarray(cfg.d_model ** 0.5, dtype=cfg.dtype)
+    return x
+
+
+def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                   positions: jax.Array, kv: Any,
+                   attn: AttentionFn) -> Tuple[jax.Array, Any]:
+    """Token ids -> final hidden states. tokens, positions: [B, S]."""
+    x = embed_tokens(params, cfg, tokens)
 
     def body(carry, scanned):
         x, kv = carry
