@@ -289,3 +289,27 @@ def test_orbax_native_checkpoint_roundtrip(tmp_path):
     import jax
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_orbax_roundtrip_quantized_params(tmp_path):
+    """Checkpoint/resume composes with weight quantization: a
+    QuantizedArray pytree (codes + scales custom node) survives Orbax
+    save/restore bit-exactly, node types included — restart-after-
+    failure never has to re-quantize from a bf16 source."""
+    import numpy as np
+
+    from tpu_inference import config as cfgs
+    from tpu_inference.models import build_model
+    from tpu_inference.models.quant import QuantizedArray, quantize_params
+    from tpu_inference.models.weights import load_native, save_native
+
+    cfg = cfgs.tiny_llama(vocab_size=128)
+    params, _ = build_model(cfg, seed=3)
+    qp = quantize_params(params, "int8")
+    path = str(tmp_path / "qckpt")
+    save_native(qp, path)
+    restored = load_native(path, qp)
+    assert isinstance(restored["blocks"]["wq"], QuantizedArray)
+    import jax
+    for a, b in zip(jax.tree.leaves(qp), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -74,9 +74,11 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     return params
 
 
-def _block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict, x: jax.Array,
-           positions: jax.Array, kv: Any, attn: AttentionFn):
-    """One transformer block. x: [B, S, D]."""
+def decoder_block(cfg: ModelConfig, layer_idx: jax.Array, lp: dict,
+                  x: jax.Array, positions: jax.Array, kv: Any,
+                  attn: AttentionFn):
+    """One transformer block. x: [B, S, D]. Public: parallel/pipeline.py
+    runs per-stage layer slabs through it."""
     b, s, d = x.shape
     hd = cfg.head_dim
 
@@ -123,7 +125,7 @@ def forward_hidden(params: dict, cfg: ModelConfig, tokens: jax.Array,
     def body(carry, scanned):
         x, kv = carry
         layer_idx, lp = scanned
-        x, kv = _block(cfg, layer_idx, lp, x, positions, kv, attn)
+        x, kv = decoder_block(cfg, layer_idx, lp, x, positions, kv, attn)
         return (x, kv), None
 
     layer_ids = jnp.arange(cfg.n_layers)

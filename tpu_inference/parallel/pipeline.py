@@ -57,6 +57,10 @@ def pp_forward(params: dict, cfg: ModelConfig, tokens: jax.Array,
     fills the pipe). Total steps = n_micro + pp - 1.
     """
     pp = mesh.shape["pp"]
+    if cfg.family != "llama":
+        raise ValueError(
+            f"pp_forward supports the llama family (got {cfg.family!r}); "
+            "MoE layer stacks ([L, E, ...] experts) need EP-aware stages")
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers {cfg.n_layers} % pp {pp} != 0")
     b = tokens.shape[0]
@@ -79,8 +83,8 @@ def pp_forward(params: dict, cfg: ModelConfig, tokens: jax.Array,
 
             def body(carry, scanned):
                 layer_idx, lp = scanned
-                x, _ = llama._block(cfg, layer_idx, lp, carry, pos,
-                                    None, attn)
+                x, _ = llama.decoder_block(cfg, layer_idx, lp, carry,
+                                           pos, None, attn)
                 return x, None
 
             x, _ = jax.lax.scan(body, x, (ids, blocks))
