@@ -50,6 +50,23 @@ def test_pp_forward_swa_dialect():
                                atol=1e-4, rtol=1e-4)
 
 
+def test_pp_forward_quantized_slabs():
+    """PP composes with weight quantization: QuantizedArray layer slabs
+    (codes + per-channel scales, both [L, ...]) shard their layer axis
+    across stages like plain weights — the memory story for serving a
+    model that only fits quantized AND staged."""
+    from tpu_inference.models.quant import quantize_params
+
+    cfg, params, toks, pos, _ = _case(n_layers=2)
+    qp = quantize_params(params, "int8")
+    want, _ = llama.forward(qp, cfg, toks, pos, None,
+                            common.make_dense_attn())
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    got = pp_forward(qp, cfg, toks, pos, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+
+
 def test_pp_forward_rejects_bad_shapes():
     cfg, params, toks, pos, _ = _case(n_layers=2)
     mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
