@@ -185,8 +185,9 @@ def main() -> dict:
 
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu":
-            jax.config.update("jax_num_cpu_devices",
-                              max(1, args.tp * args.sp))
+            from tpu_inference.compat import set_cpu_device_count
+
+            set_cpu_device_count(max(1, args.tp * args.sp))
 
     # Snapshot before run_once mutates args (enable_prefix_cache toggles).
     out = {"config": dict(vars(args))}

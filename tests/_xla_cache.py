@@ -13,8 +13,14 @@ cpu_aot_loader machine-feature warning per load (XLA's pseudo-features
 like +prefer-no-scatter are absent from the host-feature string), so
 TF_CPP_MIN_LOG_LEVEL silences C++ logging below FATAL; tests assert via
 Python exceptions, not glog. Numeric parity tests would catch a
-genuinely bad cached executable; delete the dir to force recompiles, or
-set TPU_INF_NO_XLA_CACHE=1 to opt out.
+genuinely bad cached executable; delete the dir to force recompiles.
+
+Debugging knobs (ADVICE r5 — a blanket log gag must never survive into
+a debugging run):
+- ``TPU_INF_NO_XLA_CACHE=1`` opts out of the cache entirely AND skips
+  the log suppression, so a debugging run gets full XLA logs.
+- ``TPU_INF_XLA_LOGS=1`` keeps the (fast) cache but skips the
+  suppression — full logs without paying cold recompiles.
 """
 
 import os
@@ -22,8 +28,12 @@ import os
 
 def enable(jax) -> None:
     if os.environ.get("TPU_INF_NO_XLA_CACHE"):
+        # No cache -> no cosmetic reuse warning to hide, so the blanket
+        # TF_CPP_MIN_LOG_LEVEL suppression is skipped too: debugging
+        # runs see every XLA warning/error.
         return
-    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    if not os.environ.get("TPU_INF_XLA_LOGS"):
+        os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("TPU_INF_XLA_CACHE",
                                      "/tmp/tpu_inference_xla_cache"))

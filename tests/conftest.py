@@ -19,7 +19,11 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# jax < 0.5 has no jax_num_cpu_devices; the compat shim falls back to
+# XLA_FLAGS, which the lazy backend init still honors at this point.
+from tpu_inference.compat import set_cpu_device_count  # noqa: E402
+
+set_cpu_device_count(8)
 # XLA:CPU's oneDNN matmuls run in reduced precision by default (~1e-1 abs
 # error on standard-normal f32 inputs), which swamps parity tolerances.
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,405 @@
+"""Replica supervision: health state machine, step watchdog, failover,
+and admission control (README "Failure handling & degraded operation").
+
+Engine-level fault injection (EngineConfig.chaos_step_*) makes the
+documented TPU failure modes — per-step exceptions and wedged dispatches
+— deterministic on CPU, so these tests drive the full path: injected
+fault -> quarantine -> resubmission on a healthy replica -> tokens
+identical to a no-fault run, plus the 429/503 + Retry-After shedding the
+harness's traffic generator backs off on.
+"""
+
+import asyncio
+import json
+import re
+import threading
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                  ParallelConfig, ServerConfig, tiny_llama)
+from tpu_inference.engine.engine import Sequence
+from tpu_inference.server.http import InferenceServer, build_engine_group
+from tpu_inference.server.replicas import (DEGRADED, HEALTHY, QUARANTINED,
+                                           RECOVERED, ReplicaHealth)
+
+ENGINE_KW = dict(page_size=8, num_pages=64, max_pages_per_seq=4,
+                 max_batch_size=2, prefill_buckets=(16,))
+
+
+def _cfg(dp=1, **server_kw) -> FrameworkConfig:
+    return FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(**ENGINE_KW),
+        parallel=(ParallelConfig(dp=2, tp=2) if dp == 2 else
+                  ParallelConfig()),
+        server=ServerConfig(model_name="t", tokenizer="byte", **server_kw))
+
+
+def _run(server, coro_fn):
+    async def wrapper():
+        app = server.make_app()
+        async with TestClient(TestServer(app)) as client:
+            return await coro_fn(client)
+
+    return asyncio.run(wrapper())
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_health_state_machine():
+    """healthy -> degraded -> quarantined -> recovered -> healthy, with
+    probation failure going straight back to quarantine."""
+    cfg = ServerConfig(quarantine_after_failures=3,
+                       quarantine_cooldown_s=0.05)
+    h = ReplicaHealth(cfg)
+    assert h.state == HEALTHY and h.routable
+
+    h.on_error()
+    assert h.state == DEGRADED and h.routable
+    h.on_ok()                               # one clean step heals
+    assert h.state == HEALTHY and h.consecutive_failures == 0
+
+    for _ in range(3):
+        h.on_error()
+    assert h.state == QUARANTINED and not h.routable
+    assert h.quarantines == 1
+
+    h.on_ok()                               # a late success does not
+    assert h.state == QUARANTINED           # beat the cooldown
+
+    time.sleep(0.06)
+    h.maybe_recover()
+    assert h.state == RECOVERED and h.routable
+
+    h.on_error()                            # probation failure
+    assert h.state == QUARANTINED and h.quarantines == 2
+
+    time.sleep(0.06)
+    h.maybe_recover()
+    h.on_ok()                               # probation pass
+    assert h.state == HEALTHY
+
+    # Watchdog path: wedge transitions exactly once.
+    assert h.mark_wedged() is True
+    assert h.state == QUARANTINED and h.wedges == 1
+    assert h.mark_wedged() is False         # already quarantined
+
+
+# ------------------------------------------------- group-level failover
+
+
+def _submit_and_wait(group, rid, prompt, max_new, timeout=60.0):
+    """Submit one request through the group; return (tokens, finish_seq)
+    once its on_finish fires."""
+    tokens, done, box = [], threading.Event(), {}
+
+    def on_token(s, t):
+        tokens.append(t)
+
+    def on_finish(s):
+        box["seq"] = s
+        done.set()
+
+    seq = Sequence(request_id=rid, prompt_tokens=list(prompt),
+                   max_new_tokens=max_new)
+    group.submit(seq, on_token, on_finish)
+    assert done.wait(timeout), "request did not finish"
+    return tokens, box["seq"]
+
+
+def _occupy(group, sched, rid, max_new=64):
+    """Pin load on one scheduler so the least-loaded router sends the
+    next request elsewhere. Returns an event set on finish."""
+    got_token, done = threading.Event(), threading.Event()
+    seq = Sequence(request_id=rid, prompt_tokens=[5, 6, 7],
+                   max_new_tokens=max_new)
+    sched.submit(seq, lambda s, t: got_token.set(), lambda s: done.set())
+    assert got_token.wait(30), "busy request produced no token"
+    return done
+
+
+def test_step_failure_quarantines_and_fails_over():
+    """Acceptance path: dp=2, chaos_step_failure_rate pinned on replica 1
+    -> the sick replica is quarantined, the in-flight request resubmitted
+    to replica 0 and its tokens are identical to a no-fault run, with the
+    quarantine and retry counters visible in health/stats snapshots."""
+    cfg = _cfg(dp=2, quarantine_after_failures=1, failover_max_retries=1,
+               quarantine_cooldown_s=3600.0)
+    group = build_engine_group(cfg).start()
+    try:
+        probe = [1, 2, 3, 4]
+        baseline, seq0 = _submit_and_wait(group, 100, probe, 8)
+        assert seq0.finish_reason in ("stop", "length") and baseline
+
+        # Replica 0 busy -> the probe routes to replica 1, which now
+        # fails every dispatch.
+        busy_done = _occupy(group, group.schedulers[0], 101)
+        group.engines[1].chaos_step_failure_rate = 1.0
+
+        r0_before = group.schedulers[0].stats.requests_finished
+        tokens, fseq = _submit_and_wait(group, 102, probe, 8)
+        assert fseq.finish_reason in ("stop", "length")
+        assert tokens == baseline, (
+            "failover must replay from the prompt and match a no-fault run")
+
+        assert group.health[1].state == QUARANTINED
+        assert group.schedulers[0].stats.requests_finished > r0_before
+        assert group.schedulers[1].stats.step_failures >= 1
+
+        snap = group.health_snapshot()
+        assert snap["status"] == "degraded"
+        assert snap["replicas"][1]["state"] == QUARANTINED
+        assert snap["supervision"]["retries_attempted"] >= 1
+        assert snap["supervision"]["retries_succeeded"] >= 1
+
+        stats = group.stats_snapshot()
+        assert stats["supervision"]["retries_succeeded"] >= 1
+        assert stats["replicas"][1]["health"]["state"] == QUARANTINED
+
+        busy_done.wait(30)
+    finally:
+        group.stop(drain=False, timeout=5.0)
+
+
+def test_wedged_step_watchdog_failover():
+    """A dispatch that hangs (chaos_step_wedge_s) trips the in-process
+    watchdog: the replica is quarantined mid-flight and its stranded
+    request is resubmitted to the healthy replica."""
+    cfg = _cfg(dp=2, step_watchdog_s=0.15, quarantine_after_failures=3,
+               failover_max_retries=1, quarantine_cooldown_s=3600.0)
+    group = build_engine_group(cfg)
+    # Compile everything OUTSIDE the scheduler threads first: a cold
+    # first dispatch includes XLA compile, which would trip the 150ms
+    # watchdog on a healthy replica (the documented --no-warmup caveat).
+    group.warmup()
+    group.start()
+    try:
+        probe = [9, 2, 4, 8]
+        baseline, _ = _submit_and_wait(group, 200, probe, 6)
+
+        busy_done = _occupy(group, group.schedulers[0], 201)
+        group.engines[1].chaos_step_wedge_s = 0.8
+
+        tokens, fseq = _submit_and_wait(group, 202, probe, 6)
+        assert fseq.finish_reason in ("stop", "length")
+        assert tokens == baseline
+
+        assert group.health[1].state == QUARANTINED
+        assert group.health[1].snapshot()["wedges"] >= 1
+        assert group.supervision_counters()["failovers"] >= 1
+
+        busy_done.wait(30)
+    finally:
+        # Replica 1's engine thread may still be sleeping in the wedge
+        # gate; disarm so drainless stop joins promptly.
+        group.engines[1].chaos_step_wedge_s = 0.0
+        group.stop(drain=False, timeout=5.0)
+
+
+def test_streamed_request_fails_cleanly_not_regenerated():
+    """A request that already delivered tokens must NOT be silently
+    re-generated after its replica dies mid-stream: it finishes with an
+    error instead."""
+    cfg = _cfg(dp=2, quarantine_after_failures=1, failover_max_retries=1,
+               quarantine_cooldown_s=3600.0)
+    group = build_engine_group(cfg).start()
+    try:
+        busy_done = _occupy(group, group.schedulers[0], 301)
+
+        got_token, done, box = threading.Event(), threading.Event(), {}
+
+        def on_token(s, t):
+            # Arm chaos only after the first token streamed: the NEXT
+            # decode dispatch on replica 1 fails the request mid-stream.
+            group.engines[1].chaos_step_failure_rate = 1.0
+            got_token.set()
+
+        seq = Sequence(request_id=302, prompt_tokens=[3, 1, 4],
+                       max_new_tokens=32)
+        group.submit(seq, on_token,
+                     lambda s: (box.setdefault("seq", s), done.set()))
+        assert done.wait(60)
+        assert got_token.is_set()
+        assert box["seq"].finish_reason == "error"
+        assert group.supervision_counters()["retries_attempted"] == 0
+
+        busy_done.wait(30)
+    finally:
+        group.stop(drain=False, timeout=5.0)
+
+
+# ------------------------------------------------------- HTTP shedding
+
+
+def test_admission_queue_cap_sheds_with_retry_after():
+    """Saturation returns 429 + Retry-After immediately instead of
+    queueing to request_timeout_s."""
+    cfg = _cfg(admission_queue_depth=1, retry_after_s=2.5)
+    srv = InferenceServer(cfg)
+
+    async def scenario(client):
+        resp = await client.post("/api/generate", json={
+            "prompt": "occupy the only slot", "stream": True,
+            "max_tokens": 64})
+        assert resp.status == 200
+        await resp.content.readline()       # admitted: first token out
+
+        shed = await client.post("/api/generate", json={
+            "prompt": "over cap", "stream": False, "max_tokens": 2})
+        assert shed.status == 429
+        assert shed.headers["Retry-After"] == "3"   # ceil(2.5)
+        body = await shed.json()
+        assert "admission queue cap" in body["error"]
+        await resp.read()       # drain the occupying stream cleanly
+
+        stats = await (await client.get("/metrics")).json()
+        assert stats["supervision"]["requests_shed"] >= 1
+
+    _run(srv, scenario)
+
+
+def test_wedged_fleet_returns_503_and_healthz_degrades():
+    """dp=1 wedge: the watchdog quarantines the only replica, the
+    stranded request gets a clean retryable 503 (no other replica to
+    fail over to), and /healthz flips to 503/unavailable."""
+    cfg = _cfg(step_watchdog_s=0.15, quarantine_cooldown_s=3600.0,
+               failover_max_retries=1, retry_after_s=1.0)
+    srv = InferenceServer(cfg)
+    srv.engine.chaos_step_wedge_s = 0.8
+
+    async def scenario(client):
+        health = await client.get("/healthz")
+        assert health.status == 200
+        assert (await health.json())["status"] == "ok"
+
+        resp = await client.post("/api/generate", json={
+            "prompt": "wedge me", "stream": False, "max_tokens": 4})
+        assert resp.status == 503
+        assert "Retry-After" in resp.headers
+        assert "replica failure" in (await resp.json())["error"]
+
+        health = await client.get("/healthz")
+        assert health.status == 503
+        body = await health.json()
+        assert body["status"] == "unavailable"
+        assert body["replicas"][0]["state"] == QUARANTINED
+        assert body["replicas"][0]["wedges"] >= 1
+
+        # Fully quarantined fleet sheds new work at admission — embed
+        # clients included, and both count as unavailable rejections.
+        rej = await client.post("/api/generate", json={
+            "prompt": "nope", "stream": False, "max_tokens": 2})
+        assert rej.status == 503
+        assert "Retry-After" in rej.headers
+        emb = await client.post("/api/embed", json={"input": "x"})
+        assert emb.status == 503
+        assert "Retry-After" in emb.headers
+        stats = await (await client.get("/metrics")).json()
+        assert stats["supervision"]["requests_unavailable"] >= 2
+
+    try:
+        _run(srv, scenario)
+    finally:
+        srv.engine.chaos_step_wedge_s = 0.0
+
+
+def test_debug_chaos_endpoint_arms_engine_faults():
+    """POST /debug/chaos arms/disarms engine-level injection per replica
+    at runtime (debug-only surface)."""
+    cfg = _cfg(enable_debug=True)
+    srv = InferenceServer(cfg)
+
+    async def scenario(client):
+        resp = await client.post("/debug/chaos", json={
+            "replica": 0, "step_failure_rate": 0.5, "step_wedge_s": 0.1})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["replicas"][0] == {"step_failure_rate": 0.5,
+                                       "step_wedge_s": 0.1}
+        assert srv.engine.chaos_step_failure_rate == 0.5
+
+        resp = await client.post("/debug/chaos", json={
+            "replica": None, "step_failure_rate": 0.0, "step_wedge_s": 0.0})
+        assert resp.status == 200
+        assert srv.engine.chaos_step_failure_rate == 0.0
+
+        bad = await client.post("/debug/chaos", json={"replica": 7})
+        assert bad.status == 400
+
+    _run(srv, scenario)
+
+
+# ----------------------------------------------------------- satellites
+
+
+def test_chaos_gate_covers_chat_and_embed():
+    """HTTP fault injection applies to chat and embed clients too, not
+    just /api/generate."""
+    cfg = _cfg(chaos_failure_rate=1.0)
+    srv = InferenceServer(cfg)
+
+    async def scenario(client):
+        chat = await client.post("/api/chat", json={
+            "model": "t", "messages": [{"role": "user", "content": "x"}]})
+        assert chat.status == 503
+        for route in ("/api/embed", "/api/embeddings"):
+            emb = await client.post(route, json={"input": "x"})
+            assert emb.status == 503
+
+    _run(srv, scenario)
+
+
+def test_api_ps_ollama_semantics():
+    """/api/ps reports ONE model copy (dp exposed separately) and
+    Ollama-shaped parameter_size / quantization_level strings."""
+    srv = InferenceServer(_cfg())
+
+    async def scenario(client):
+        body = await (await client.get("/api/ps")).json()
+        entry = body["models"][0]
+        assert entry["size"] == int(srv.engine.weight_bytes)
+        assert entry["replicas"] == 1
+        details = entry["details"]
+        assert re.fullmatch(r"\d+(\.\d+)?[BMK]", details["parameter_size"])
+        assert details["quantization_level"] in (
+            "F32", "F16", "BF16", "Q8_0", "Q4_0")
+        tags = await (await client.get("/api/tags")).json()
+        assert (tags["models"][0]["details"]["parameter_size"]
+                == details["parameter_size"])
+
+    _run(srv, scenario)
+
+
+def test_traffic_generator_resilience_accounting():
+    """429/503 backoff honors Retry-After (never below exponential
+    backoff) and the collector tracks retry/shed counts."""
+    from traffic_generator.generator import TrafficGenerator
+    from traffic_generator.metrics import MetricCollector
+
+    gen = object.__new__(TrafficGenerator)   # _shed_delay needs config only
+    gen.config = {"retry_backoff_s": 0.25}
+
+    class Resp:
+        def __init__(self, headers):
+            self.headers = headers
+
+    d = gen._shed_delay(Resp({"Retry-After": "3"}), attempt=0)
+    assert 3.0 <= d <= 3.0 * 1.25            # hint wins, jitter above
+    d = gen._shed_delay(Resp({}), attempt=2)
+    assert 1.0 <= d <= 1.0 * 1.25            # 0.25 * 2**2
+    d = gen._shed_delay(Resp({"Retry-After": "nonsense"}), attempt=0)
+    assert 0.25 <= d <= 0.25 * 1.25          # bad hint -> backoff only
+
+    mc = MetricCollector()
+    mc.init_query(0, n_input_tokens=3, scheduled_start=0.0)
+    mc.record_retry(0)
+    mc.record_retry(0)
+    mc.record_shed(0)
+    assert mc.metrics[0]["num_retries"] == 2
+    assert mc.metrics[0]["shed"] is True
+    assert mc.metrics[0]["success"] is False
+    assert mc.retries_total == 2 and mc.shed_total == 1

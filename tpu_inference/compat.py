@@ -1,0 +1,65 @@
+"""jax version compatibility shims.
+
+The codebase targets the modern ``jax.shard_map`` API (jax >= 0.5);
+older runtimes (0.4.x) only ship ``jax.experimental.shard_map`` whose
+replication-check kwarg is spelled ``check_rep`` instead of
+``check_vma``. Everything in-repo imports ``shard_map`` from here so a
+version bump (either direction) is a one-file change.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Ask for ``n`` virtual CPU devices before any computation runs.
+
+    ``jax_num_cpu_devices`` is the modern knob; jax < 0.5 only honors
+    ``--xla_force_host_platform_device_count``, which XLA parses at lazy
+    backend initialization — so mutating XLA_FLAGS after import (but
+    before the first computation) still works."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, inside shard_map.
+
+    ``jax.lax.axis_size`` is the modern spelling; on 0.4.x the constant
+    fold of ``psum(1, axis)`` is the canonical way to get the same
+    static int."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pvary(vals, axes):
+    """Mark values device-varying over ``axes`` for shard_map's
+    varying-axis typing. pcast is the current spelling, pvary the
+    deprecated one (attribute access alone warns, so probe pcast
+    first); 0.4.x shard_map has no varying-axis typing at all, so
+    values pass through untouched."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(vals, axes, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(vals, axes)
+    return vals
+
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

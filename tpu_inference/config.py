@@ -374,6 +374,15 @@ class EngineConfig:
     # Prefix caching: finished sequences publish their full KV pages for
     # reuse by later requests sharing the prefix (multi-turn chats).
     enable_prefix_cache: bool = True
+    # Engine-level fault injection (the in-process counterpart of
+    # ServerConfig.chaos_*): every prefill/decode dispatch raises with
+    # this probability, exercising the scheduler error paths and the
+    # replica health machine deterministically on CPU. Off in production.
+    chaos_step_failure_rate: float = 0.0
+    # Each dispatch sleeps this long first, simulating the documented TPU
+    # wedge failure mode (benchmarks/run_tpu_round5.sh guards against it
+    # out-of-process; the step watchdog detects it in-process).
+    chaos_step_wedge_s: float = 0.0
 
     @property
     def max_context(self) -> int:
@@ -408,10 +417,38 @@ class ServerConfig:
     enable_debug: bool = False
     profile_dir: str = "/tmp/jax-trace"
     # Fault injection (SURVEY.md §5 failure detection: "HTTP-stub chaos
-    # mode"): randomly reject this fraction of /api/generate requests with
-    # 503 and/or delay them, to test client resilience. Off in production.
+    # mode"): randomly reject this fraction of generate/chat/embed
+    # requests with 503 and/or delay them, to test client resilience.
+    # Off in production.
     chaos_failure_rate: float = 0.0
     chaos_delay_s: float = 0.0
+    # --- Replica supervision (server/replicas.py health state machine) ---
+    # A replica whose decode/prefill dispatch stays in flight longer than
+    # this is wedged (the round-5 TPU failure mode): it is quarantined and
+    # its in-flight requests fail over. 0 disables the watchdog — the
+    # first dispatch after a cold boot without warmup includes XLA
+    # compile, which can legitimately take minutes at 70B scale, so the
+    # deadline is opt-in (the CLI enables it with --step-watchdog-s).
+    step_watchdog_s: float = 0.0
+    # Consecutive step failures before healthy -> degraded -> quarantined
+    # (the first failure degrades; this many quarantine).
+    quarantine_after_failures: int = 3
+    # A quarantined replica waits this long, then re-enters as
+    # "recovered" (probation): one clean step re-promotes it to healthy,
+    # one failure re-quarantines it immediately.
+    quarantine_cooldown_s: float = 30.0
+    # Failover budget: a request failed/stranded by a sick replica with
+    # NO tokens delivered yet is resubmitted from its prompt to a healthy
+    # replica at most this many times. Requests that already streamed
+    # tokens fail cleanly instead of being silently re-generated.
+    failover_max_retries: int = 1
+    # Admission control: reject (HTTP 429 + Retry-After) when the least
+    # loaded routable replica already has this many requests queued or
+    # running. 0 = unlimited (legacy behavior: queue until
+    # request_timeout_s).
+    admission_queue_depth: int = 0
+    # Retry-After hint (seconds) sent with 429/503 shed responses.
+    retry_after_s: float = 1.0
 
 
 @dataclasses.dataclass

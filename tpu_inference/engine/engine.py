@@ -21,6 +21,7 @@ SURVEY.md §0); capability parity is defined by BASELINE.json configs 1-4.
 from __future__ import annotations
 
 import dataclasses
+import random as _chaos_random
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -29,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_inference.compat import shard_map
 from tpu_inference.config import EngineConfig, ModelConfig
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.kv_cache import KVPages, PageAllocator
@@ -83,7 +85,7 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
                 ring_attention_local as sp_local)
 
         spec = P(None, "sp", "tp", None)       # [B, S, H, D]: seq × heads
-        return jax.shard_map(
+        return shard_map(
             _partial(sp_local, axis_name="sp",
                      sliding_window=cfg.sliding_window),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -109,7 +111,7 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
             scale_p = P(None, None, "tp")              # [P, pg, Hkv]
             args += [kv.k_scale[layer_idx], kv.v_scale[layer_idx]]
             specs += [scale_p, scale_p]
-        return jax.shard_map(
+        return shard_map(
             kernel, mesh=mesh, in_specs=tuple(specs), out_specs=out_spec,
             check_vma=False)(*args)
 
@@ -178,6 +180,14 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
         return out, kv
 
     return attn
+
+
+class ChaosStepError(RuntimeError):
+    """Injected engine-step failure (EngineConfig.chaos_step_failure_rate).
+
+    A distinct type so supervision tests can tell injected faults from
+    real engine bugs; the scheduler treats both identically (any step
+    exception feeds the replica health machine)."""
 
 
 @dataclasses.dataclass
@@ -299,6 +309,10 @@ class InferenceEngine:
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh,
                                      scale_sharding=kv_scale_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
+        # Fault injection, copied out of the frozen config so tests and
+        # the /debug/chaos endpoint can arm/disarm per replica at runtime.
+        self.chaos_step_failure_rate = engine_cfg.chaos_step_failure_rate
+        self.chaos_step_wedge_s = engine_cfg.chaos_step_wedge_s
         spec_on = (draft_cfg is not None
                    and engine_cfg.num_speculative_tokens > 0)
         self.prefix_cache = None
@@ -952,6 +966,7 @@ class InferenceEngine:
         (first token sampled and bookkeeping done)."""
         prompt = seq.prefill_prompt
         assert prompt is not None, "prefill_step without prefill_begin"
+        self._chaos_step_gate()
         seq.prefill_offset, tok = self._prefill_one_chunk(
             seq, prompt, seq.prefill_offset)
         if seq.prefill_offset < len(prompt):
@@ -1025,6 +1040,7 @@ class InferenceEngine:
 
         Prompts needing multiple chunks fall back to the serial path.
         """
+        self._chaos_step_gate()
         ecfg = self.engine_cfg
         chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
         slots = self.free_slots()
@@ -1050,6 +1066,17 @@ class InferenceEngine:
         for (bucket, use_sp), group in groups.items():
             for i in range(0, len(group), cap):
                 self._prefill_run_batched(group[i:i + cap], bucket, use_sp)
+
+    def _chaos_step_gate(self) -> None:
+        """Engine-level fault injection, mirroring the HTTP _chaos_gate:
+        runs at the top of every prefill/decode dispatch. The wedge
+        sleeps BEFORE the failure roll so a wedged-and-failing replica
+        exercises the watchdog first, like a real hung-then-killed call."""
+        if self.chaos_step_wedge_s > 0:
+            time.sleep(self.chaos_step_wedge_s)
+        if (self.chaos_step_failure_rate > 0
+                and _chaos_random.random() < self.chaos_step_failure_rate):
+            raise ChaosStepError("chaos: injected engine step failure")
 
     def _maybe_finish(self, seq: Sequence, tok: int) -> None:
         if seq.eos_token_id is not None and tok == seq.eos_token_id:
@@ -1190,6 +1217,7 @@ class InferenceEngine:
         ``_maybe_finish`` stays the source of truth for finish state.
         ``max_steps`` additionally caps every lane (decode_step uses 1).
         """
+        self._chaos_step_gate()
         if self._inflight:
             # Mixing entry points: fold any dispatch-ahead state first so
             # ctx/pages bookkeeping stays consistent (tokens surface in
@@ -1355,7 +1383,8 @@ class InferenceEngine:
         """
         depth = self.engine_cfg.decode_pipeline_depth
         if depth <= 1 or self.spec_enabled:
-            return self.decode_steps()
+            return self.decode_steps()         # gate runs inside
+        self._chaos_step_gate()
         call = self._stage_decode_call()
         if call is not None:
             self._inflight.append(call)

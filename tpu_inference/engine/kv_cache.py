@@ -189,14 +189,19 @@ def write_kv(kv: KVPages, layer_idx: jax.Array, k_new: jax.Array,
 
 def gather_kv(kv: KVPages, layer_idx: jax.Array,
               block_tables: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Gather each sequence's pages into contiguous [B, max_pages*pg, H, D].
+    """Gather each sequence's pages into contiguous
+    [B, max_pages*pg, H, head_dim].
 
+    ``d_pool`` is the pool's trailing dim as STORED — head_dim, except
+    head_dim/2 for packed-int4 pools (two nibbles per byte; the kernels'
+    d_pool convention) — so the gather below is [B, max_pages*pg, H,
+    d_pool] until unpack_int4_kv doubles it back to head_dim.
     Quantized pools dequantize after the gather (f32 out — the dense
     attention path computes in f32 anyway)."""
     b, mp = block_tables.shape
-    _, _, pg, H, D = kv.k.shape
-    k = kv.k[layer_idx][block_tables].reshape(b, mp * pg, H, D)
-    v = kv.v[layer_idx][block_tables].reshape(b, mp * pg, H, D)
+    _, _, pg, H, d_pool = kv.k.shape
+    k = kv.k[layer_idx][block_tables].reshape(b, mp * pg, H, d_pool)
+    v = kv.v[layer_idx][block_tables].reshape(b, mp * pg, H, d_pool)
     if kv.packed_int4:
         k, v = unpack_int4_kv(k), unpack_int4_kv(v)
     if kv.quantized:

@@ -43,6 +43,7 @@ class SchedulerStats:
     tokens_prefix_cached: int = 0      # prompt tokens served from KV reuse
     requests_finished: int = 0
     requests_rejected: int = 0
+    step_failures: int = 0             # prefill/decode dispatch exceptions
     batch_occupancy_sum: float = 0.0
     peak_pages_in_use: int = 0
     # Ring of recent decode-dispatch wall times (seconds): the host-side
@@ -83,6 +84,7 @@ class SchedulerStats:
             "tokens_prefix_cached": self.tokens_prefix_cached,
             "requests_finished": self.requests_finished,
             "requests_rejected": self.requests_rejected,
+            "step_failures": self.step_failures,
             "mean_batch_occupancy": occ,
             "kv_pages_total": total,
             "kv_pages_in_use": total - engine.allocator.num_free,
@@ -142,8 +144,35 @@ class EngineScheduler:
         self._work = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Supervision hooks (set by EngineGroup): fire on the engine
+        # thread after every dispatch. step_inflight_since is the
+        # monotonic start of the dispatch currently on device, or None —
+        # the watchdog reads it from the monitor thread (GIL-atomic
+        # float/None store, no lock on the hot path).
+        self.step_inflight_since: Optional[float] = None
+        self.on_step_ok: Optional[Callable[[], None]] = None
+        self.on_step_error: Optional[Callable[[BaseException], None]] = None
+
+    # ---------------------------------------------- supervision plumbing
+
+    def _note_ok(self) -> None:
+        if self.on_step_ok is not None:
+            self.on_step_ok()
+
+    def _note_error(self, exc: BaseException) -> None:
+        self.stats.step_failures += 1
+        if self.on_step_error is not None:
+            self.on_step_error(exc)
 
     # -------------------------------------------------- submission API
+
+    @property
+    def load(self) -> int:
+        """Queued + admitted (not yet finished) requests — the number the
+        least-loaded router and the admission-control queue cap compare.
+        _callbacks (not active_sequences) so mid-incremental-prefill
+        requests still count."""
+        return len(self._waiting) + len(self._callbacks)
 
     def submit(self, seq: Sequence, on_token: TokenCallback,
                on_finish: FinishCallback) -> None:
@@ -226,15 +255,20 @@ class EngineScheduler:
             self._prefilling = None
             self._finish(seq)
             return
+        self.step_inflight_since = time.monotonic()
         try:
             finished = self.engine.prefill_step(seq)
-        except Exception:  # noqa: BLE001 — keep the engine loop alive
+        except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
             import traceback
             traceback.print_exc()
+            self._note_error(exc)
             self._prefilling = None
             seq.done, seq.finish_reason = True, "error"
             self._finish(seq)
             return
+        finally:
+            self.step_inflight_since = None
+        self._note_ok()
         if finished:
             self._prefilling = None
             self._prefill_done(pending)
@@ -289,9 +323,10 @@ class EngineScheduler:
             seq = start_chunked.seq
             try:
                 self.engine.prefill_begin(seq)
-            except Exception:  # noqa: BLE001
+            except Exception as exc:  # noqa: BLE001
                 import traceback
                 traceback.print_exc()
+                self._note_error(exc)
                 seq.done, seq.finish_reason = True, "error"
                 self._finish(seq)
                 return admitted
@@ -300,17 +335,22 @@ class EngineScheduler:
             return admitted + 1
         if not batch:
             return admitted
+        self.step_inflight_since = time.monotonic()
         try:
             self.engine.prefill_many([p.seq for p in batch])
-        except Exception:  # noqa: BLE001 — keep the engine loop alive
+        except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
             import traceback
             traceback.print_exc()
+            self._note_error(exc)
             # Coarse failure domain: the whole batch errors (admission
             # control makes device OOM here exceptional, not routine).
             for pending in batch:
                 pending.seq.done, pending.seq.finish_reason = True, "error"
                 self._finish(pending.seq)   # releases pages/slot
             return admitted
+        finally:
+            self.step_inflight_since = None
+        self._note_ok()
         for pending in batch:
             self._prefill_done(pending)
         return admitted + len(batch)
@@ -401,6 +441,7 @@ class EngineScheduler:
                 # decode has its own emission cadence; leave it alone.
                 thresh = engine.engine_cfg.latency_decode_threshold
                 t_call = time.perf_counter()
+                self.step_inflight_since = time.monotonic()
                 if (0 < len(active) <= thresh and not self._waiting
                         and self._prefilling is None
                         and not engine.pipeline_pending
@@ -409,15 +450,19 @@ class EngineScheduler:
                 else:
                     new_tokens = engine.decode_steps_pipelined()
                 self.stats.record_decode_call(time.perf_counter() - t_call)
-            except Exception:  # noqa: BLE001 — keep the engine loop alive
+            except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
                 import traceback
                 traceback.print_exc()
+                self._note_error(exc)
                 engine.abort_pipeline()   # stale in-flight state would
                 for s in active:          # poison reused slots
                     s.done, s.finish_reason = True, "error"
                     s.finish_time = time.perf_counter()
                     self._finish(s)
                 continue
+            finally:
+                self.step_inflight_since = None
+            self._note_ok()
             self.stats.steps += 1
             self.stats.batch_occupancy_sum += len(active)
             done_seqs = self._reapable()

@@ -43,6 +43,8 @@ import functools
 import jax
 from jax.sharding import Mesh
 
+from tpu_inference import compat
+
 def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                             axis_name: str = "sp",
                             sliding_window: int = 0) -> jax.Array:
@@ -62,7 +64,7 @@ def ulysses_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     collective)."""
     from tpu_inference.models.common import dense_causal_attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = compat.axis_size(axis_name)
     hq, hkv = q.shape[2], k.shape[2]
     if n == 1:
         return dense_causal_attention(q, k, v, sliding_window=sliding_window)

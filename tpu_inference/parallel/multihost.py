@@ -46,12 +46,32 @@ def initialize(coordinator_address: Optional[str] = None,
     server automatically; pass them explicitly for CPU/GPU multi-process
     or tests. Safe to call more than once.
     """
-    if jax.distributed.is_initialized():
-        return
+    # jax.distributed.is_initialized arrived after 0.4.x; on older jax
+    # probe the runtime's own already-initialized state (the same fields
+    # whose presence makes a second initialize() raise).
+    initialized = getattr(jax.distributed, "is_initialized", None)
+    if initialized is not None:
+        if initialized():
+            return
+    else:
+        from jax._src.distributed import global_state
+        if (global_state.client is not None
+                or global_state.coordinator_address is not None):
+            return
     if (coordinator_address is None
             and os.environ.get("JAX_COORDINATOR_ADDRESS") is None
             and num_processes is None and jax.process_count() == 1):
         return                      # single-process: nothing to set up
+    # 0.4.x jaxlib ships the CPU backend with collectives off ("none"),
+    # so a multi-process CPU psum dies with "Multiprocess computations
+    # aren't implemented"; select gloo when the knob exists and nothing
+    # chose otherwise. CPU-only: TPU/GPU collectives are unaffected.
+    try:
+        if jax.config.read("jax_cpu_collectives_implementation") in (
+                None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

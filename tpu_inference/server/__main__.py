@@ -100,6 +100,40 @@ def main() -> None:
                    help="with --platform cpu: number of virtual CPU "
                         "devices (0 = max(1, dp*tp*sp), enough for the "
                         "requested mesh)")
+    p.add_argument("--step-watchdog-s", type=float, default=0.0,
+                   help="quarantine a replica whose prefill/decode "
+                        "dispatch stays in flight this long (the wedged-"
+                        "TPU failure mode); 0 = off. Use with --no-warmup "
+                        "cautiously: the first dispatch includes XLA "
+                        "compile")
+    p.add_argument("--quarantine-after", type=int, default=3,
+                   help="consecutive step failures before a replica is "
+                        "quarantined (first failure marks it degraded)")
+    p.add_argument("--quarantine-cooldown-s", type=float, default=30.0,
+                   help="quarantined replicas re-enter (probation) after "
+                        "this long; one clean step re-promotes, one "
+                        "failure re-quarantines")
+    p.add_argument("--failover-retries", type=int, default=1,
+                   help="resubmit a request failed/stranded by a sick "
+                        "replica (before any token streamed) to a "
+                        "healthy one at most this many times")
+    p.add_argument("--admission-queue-depth", type=int, default=0,
+                   help="shed load (429 + Retry-After) when every "
+                        "routable replica has this many requests queued "
+                        "or running; 0 = queue without bound (legacy)")
+    p.add_argument("--chaos-failure-rate", type=float, default=0.0,
+                   help="HTTP fault injection: 503 this fraction of "
+                        "generate/chat/embed requests (harness testing)")
+    p.add_argument("--chaos-delay-s", type=float, default=0.0,
+                   help="HTTP fault injection: delay requests uniformly "
+                        "up to this many seconds")
+    p.add_argument("--chaos-step-failure-rate", type=float, default=0.0,
+                   help="engine fault injection: each prefill/decode "
+                        "dispatch raises with this probability "
+                        "(exercises quarantine + failover end to end)")
+    p.add_argument("--chaos-step-wedge-s", type=float, default=0.0,
+                   help="engine fault injection: each dispatch sleeps "
+                        "this long first (exercises the step watchdog)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--debug", action="store_true",
                    help="expose the unauthenticated /debug/* endpoints "
@@ -122,8 +156,10 @@ def main() -> None:
 
         jax.config.update("jax_platforms", args.platform)
         if args.platform == "cpu":
+            from tpu_inference.compat import set_cpu_device_count
+
             n = args.cpu_devices or max(1, args.dp * args.tp * args.sp)
-            jax.config.update("jax_num_cpu_devices", n)
+            set_cpu_device_count(n)
 
     if args.debug_nans:
         import jax
@@ -143,6 +179,16 @@ def main() -> None:
                           draft_model=args.draft_model,
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,
+                          server_overrides=dict(
+                              step_watchdog_s=args.step_watchdog_s,
+                              quarantine_after_failures=args.quarantine_after,
+                              quarantine_cooldown_s=args.quarantine_cooldown_s,
+                              failover_max_retries=args.failover_retries,
+                              admission_queue_depth=args.admission_queue_depth,
+                              chaos_failure_rate=args.chaos_failure_rate,
+                              chaos_delay_s=args.chaos_delay_s),
+                          chaos_step_failure_rate=args.chaos_step_failure_rate,
+                          chaos_step_wedge_s=args.chaos_step_wedge_s,
                           attn_backend=args.attn_backend,
                           sp_attn=args.sp_attn,
                           quant=args.quant, kv_quant=args.kv_quant,

@@ -45,6 +45,7 @@ from aiohttp import web
 from tpu_inference.config import FrameworkConfig
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.sampling import PENALTY_WINDOW
+from tpu_inference.server.replicas import (FleetSaturated, FleetUnavailable)
 from tpu_inference.server.tokenizer import (IncrementalDecoder, StopMatcher,
                                             build_tokenizer)
 
@@ -90,7 +91,7 @@ def build_engine_group(cfg: FrameworkConfig, load_params=None,
         engines.append(InferenceEngine(
             cfg.model, cfg.engine, params=params, seed=cfg.seed, mesh=mesh,
             draft_cfg=draft_cfg, draft_params=draft_params))
-    return EngineGroup(engines)
+    return EngineGroup(engines, cfg.server)
 
 
 class InferenceServer:
@@ -127,7 +128,7 @@ class InferenceServer:
                 "embedding table")
         t0 = time.perf_counter()
         if group is None:
-            group = (EngineGroup([engine]) if engine is not None
+            group = (EngineGroup([engine], cfg.server) if engine is not None
                      else build_engine_group(cfg))
         self.group = group
         self.engine = group.engine            # primary replica (tests/bench)
@@ -153,6 +154,7 @@ class InferenceServer:
         if self.cfg.server.enable_debug:
             app.router.add_get("/debug/requests", self.handle_debug_requests)
             app.router.add_post("/debug/profile", self.handle_profile)
+            app.router.add_post("/debug/chaos", self.handle_chaos)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -161,6 +163,16 @@ class InferenceServer:
         if self.cfg.server.warmup:
             secs = self.group.warmup()
             print(f"engine warmup: compiled all graphs in {secs:.1f}s")
+        scfg = self.cfg.server
+        wd = (f"{scfg.step_watchdog_s:g}s" if scfg.step_watchdog_s > 0
+              else "off")
+        cap = scfg.admission_queue_depth or "off"
+        print(f"supervision: dp={len(self.group.engines)} "
+              f"step_watchdog={wd} "
+              f"quarantine_after={scfg.quarantine_after_failures} "
+              f"cooldown={scfg.quarantine_cooldown_s:g}s "
+              f"failover_retries={scfg.failover_max_retries} "
+              f"queue_cap={cap}")
         self.group.start()
 
     async def _on_cleanup(self, app) -> None:
@@ -168,38 +180,74 @@ class InferenceServer:
 
     # ------------------------------------------------------------- routes
 
+    @staticmethod
+    def _retry_after_headers(retry_after_s: float) -> dict:
+        # Retry-After takes integer seconds; round up so "0.5" doesn't
+        # become "retry immediately".
+        return {"Retry-After": str(max(1, int(-(-retry_after_s // 1))))}
+
     async def handle_health(self, request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        """Fleet health: per-replica state machine + shed/retry counters.
+        200 while at least one replica is routable ("ok"/"degraded"),
+        503 with Retry-After when the whole fleet is quarantined — load
+        balancers and the traffic generator back off on exactly this."""
+        snap = self.group.health_snapshot()
+        if snap["status"] == "unavailable":
+            return web.json_response(
+                snap, status=503, headers=self._retry_after_headers(
+                    self.cfg.server.retry_after_s))
+        return web.json_response(snap)
 
     async def handle_version(self, request: web.Request) -> web.Response:
         from tpu_inference import __version__
 
         return web.json_response({"version": __version__})
 
+    def _parameter_size(self) -> str:
+        """Ollama-shaped parameter_size ("8.0B", "124.4M") computed from
+        the actual parameter count, not the config name (ADVICE r5)."""
+        n = self.engine.n_params
+        for div, suffix in ((1e9, "B"), (1e6, "M"), (1e3, "K")):
+            if n >= div:
+                return f"{n / div:.1f}{suffix}"
+        return str(n)
+
+    def _quantization_level(self) -> str:
+        """Ollama quantization_level vocabulary ("Q8_0"/"Q4_0"-style;
+        unquantized models report the serving dtype, F16/BF16/F32)."""
+        q = {"int8": "Q8_0", "int4": "Q4_0"}.get(self.cfg.engine.quant)
+        if q is not None:
+            return q
+        import jax.numpy as jnp
+        dtype = self.cfg.model.dtype
+        return {jnp.bfloat16: "BF16", jnp.float16: "F16"}.get(dtype, "F32")
+
     async def handle_tags(self, request: web.Request) -> web.Response:
         return web.json_response({"models": [{
             "name": self.cfg.server.model_name,
             "model": self.cfg.server.model_name,
             "details": {"family": self.cfg.model.family,
-                        "parameter_size": self.cfg.model.name},
+                        "parameter_size": self._parameter_size(),
+                        "quantization_level": self._quantization_level()},
         }]})
 
     async def handle_ps(self, request: web.Request) -> web.Response:
         """Ollama GET /api/ps: the loaded ("running") models. One entry —
         this server loads its model at boot and never unloads it, so
-        ``expires_at`` is the zero time (Ollama's "never")."""
+        ``expires_at`` is the zero time (Ollama's "never"). ``size`` is
+        ONE model copy (Ollama semantics — ADVICE r5); the dp replica
+        count is exposed separately so fleet HBM is size * replicas."""
         mc = self.cfg.model
-        # dp replicas each hold a full weights copy: resident HBM is
-        # per-replica bytes x replica count.
-        size = int(self.engine.weight_bytes) * len(self.group.engines)
+        size = int(self.engine.weight_bytes)
         return web.json_response({"models": [{
             "name": self.cfg.server.model_name,
             "model": self.cfg.server.model_name,
             "size": size,
             "size_vram": size,     # weights live in HBM, nothing on host
+            "replicas": len(self.group.engines),   # additive field: dp
             "details": {"family": mc.family,
-                        "parameter_size": mc.name,
-                        "quantization_level": self.cfg.engine.quant},
+                        "parameter_size": self._parameter_size(),
+                        "quantization_level": self._quantization_level()},
             "expires_at": "0001-01-01T00:00:00Z",
         }]})
 
@@ -212,8 +260,8 @@ class InferenceServer:
         return web.json_response({
             "modelfile": "",
             "details": {"family": mc.family, "format": "safetensors",
-                        "parameter_size": mc.name,
-                        "quantization_level": ec.quant},
+                        "parameter_size": self._parameter_size(),
+                        "quantization_level": self._quantization_level()},
             "model_info": {
                 "general.architecture": mc.family,
                 "general.parameter_count": self.engine.n_params,
@@ -242,6 +290,10 @@ class InferenceServer:
         and /api/embed ({"input": str | [str]} -> {"embeddings": [[..]]}).
         Mean-pooled final hidden states from the loaded model. Runs in a
         worker thread so compile/forward never stalls the event loop."""
+        # Same fault-injection gate as generate/chat: embedding clients
+        # get exercised against failures too (previously only
+        # /api/generate was chaos-gated).
+        await self._chaos_gate()
         try:
             body = await request.json()
             assert isinstance(body, dict)
@@ -274,7 +326,13 @@ class InferenceServer:
             ids = [self.tokenizer.encode(t) for t in texts]
             return self.group.embed_many(ids).tolist()
 
-        vecs = await asyncio.to_thread(compute)
+        try:
+            vecs = await asyncio.to_thread(compute)
+        except FleetUnavailable as e:
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps({"error": str(e)}),
+                content_type="application/json",
+                headers=self._retry_after_headers(e.retry_after_s))
         if legacy:
             return web.json_response({"embedding": vecs[0]})
         return web.json_response({"model": self.cfg.server.model_name,
@@ -337,8 +395,11 @@ class InferenceServer:
             content_type="application/json")
 
     async def _chaos_gate(self) -> None:
-        """Fault injection for harness-resilience testing (off unless
-        ServerConfig.chaos_* set; SURVEY.md §5)."""
+        """HTTP-level fault injection for harness-resilience testing (off
+        unless ServerConfig.chaos_* set; SURVEY.md §5). Applied to
+        generate, chat, AND embed — every client type gets exercised.
+        The engine-level counterpart (EngineConfig.chaos_step_*) injects
+        below the router instead, exercising supervision itself."""
         scfg = self.cfg.server
         if scfg.chaos_delay_s > 0:
             await asyncio.sleep(random.uniform(0, scfg.chaos_delay_s))
@@ -348,6 +409,40 @@ class InferenceServer:
                     {"error": "chaos: injected failure"}),
                     content_type="application/json")
 
+    async def handle_chaos(self, request: web.Request) -> web.Response:
+        """Arm/disarm engine-level fault injection at runtime:
+        ``POST {"replica": i | null, "step_failure_rate": p,
+        "step_wedge_s": s}`` — null replica applies to all. Returns the
+        per-replica settings now in effect. Debug-only (with
+        /debug/requests), so chaos cannot be armed on a production
+        endpoint that didn't opt in."""
+        try:
+            body = await request.json()
+            assert isinstance(body, dict)
+        except (json.JSONDecodeError, UnicodeDecodeError, AssertionError):
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "body must be a JSON object"}),
+                content_type="application/json")
+        engines = self.group.engines
+        replica = body.get("replica")
+        try:
+            targets = (engines if replica is None
+                       else [engines[int(replica)]])
+            rate = body.get("step_failure_rate")
+            wedge = body.get("step_wedge_s")
+            for eng in targets:
+                if rate is not None:
+                    eng.chaos_step_failure_rate = float(rate)
+                if wedge is not None:
+                    eng.chaos_step_wedge_s = float(wedge)
+        except (IndexError, TypeError, ValueError) as e:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": f"invalid chaos spec: {e}"}),
+                content_type="application/json")
+        return web.json_response({"replicas": [
+            {"step_failure_rate": e.chaos_step_failure_rate,
+             "step_wedge_s": e.chaos_step_wedge_s} for e in engines]})
+
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         """Ollama ``/api/chat``: messages-based wrapper over the same
         engine path (the reference's notebooks drive this via ChatOllama —
@@ -356,6 +451,7 @@ class InferenceServer:
         tokenizer has one, else flatten to a role-prefix transcript;
         responses use the ``message`` record shape instead of
         ``response``."""
+        await self._chaos_gate()
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -395,6 +491,9 @@ class InferenceServer:
         return await self._generate_impl(request, body, chat=True)
 
     async def handle_generate(self, request: web.Request) -> web.StreamResponse:
+        # Gate here, not in _generate_impl: handle_chat gates itself, and
+        # gating the shared impl too would double the chat failure rate.
+        await self._chaos_gate()
         try:
             body = await request.json()
         except (json.JSONDecodeError, UnicodeDecodeError):
@@ -405,7 +504,6 @@ class InferenceServer:
     async def _generate_impl(self, request: web.Request, body: dict,
                              chat: bool = False) -> web.StreamResponse:
         recv_t = time.perf_counter()
-        await self._chaos_gate()
         prompt = body.get("prompt")
         if not isinstance(prompt, str):
             raise web.HTTPBadRequest(text=json.dumps(
@@ -532,7 +630,20 @@ class InferenceServer:
         def on_finish(s: Sequence) -> None:
             loop.call_soon_threadsafe(queue.put_nowait, ("finish", s))
 
-        self.group.submit(seq, on_token, on_finish)
+        try:
+            self.group.submit(seq, on_token, on_finish)
+        except FleetSaturated as e:
+            # Admission control: reject NOW with a backoff hint instead
+            # of queueing until request_timeout_s.
+            raise web.HTTPTooManyRequests(
+                text=json.dumps({"error": str(e)}),
+                content_type="application/json",
+                headers=self._retry_after_headers(e.retry_after_s))
+        except FleetUnavailable as e:
+            raise web.HTTPServiceUnavailable(
+                text=json.dumps({"error": str(e)}),
+                content_type="application/json",
+                headers=self._retry_after_headers(e.retry_after_s))
         try:
             if stream:
                 return await self._stream_response(request, queue, seq,
@@ -612,8 +723,13 @@ class InferenceServer:
             await resp.write(json.dumps(self._token_line(
                 model_name, text, chat)).encode() + b"\n")
 
-        async def finish(stopped: bool) -> web.StreamResponse:
-            final = self._final_record(seq, model_name, recv_t, chat,
+        async def finish(stopped: bool, fseq: Sequence = seq
+                         ) -> web.StreamResponse:
+            # fseq is the sequence the finish event delivered — after a
+            # failover it is the resubmitted attempt, which carries the
+            # real tokens/timings (the closure seq is the dead first
+            # attempt).
+            final = self._final_record(fseq, model_name, recv_t, chat,
                                        warnings)
             if stopped:
                 # The engine thread may still be appending to
@@ -647,6 +763,19 @@ class InferenceServer:
                     return await finish(stopped=True)
                 await write_line(emit)
             else:
+                if (payload.finish_reason in ("error", "unavailable")
+                        and not consumed and not prepared):
+                    # The replica died (or was quarantined) before a
+                    # single token left the server and the failover
+                    # budget is spent: headers are unsent, so fail as a
+                    # clean retryable 503 instead of a 200 whose terminal
+                    # record buries done_reason="error".
+                    raise web.HTTPServiceUnavailable(
+                        text=json.dumps(
+                            {"error": "replica failure before first token"}),
+                        content_type="application/json",
+                        headers=self._retry_after_headers(
+                            self.cfg.server.retry_after_s))
                 if not prepared:
                     await resp.prepare(request)
                     prepared = True
@@ -655,7 +784,7 @@ class InferenceServer:
                     tail += matcher.flush()
                 if tail:
                     await write_line(tail)
-                return await finish(stopped)
+                return await finish(stopped, fseq=payload)
 
     async def _unary_response(self, request: web.Request, queue: asyncio.Queue,
                               seq: Sequence, model_name: str,
@@ -697,6 +826,17 @@ class InferenceServer:
                     self.group.cancel(seq.request_id)
                     return respond(seq, stopped=True)
             else:
+                if (payload.finish_reason in ("error", "unavailable")
+                        and not consumed):
+                    # Replica failure before any token, failover budget
+                    # spent: clean retryable 503 (mirrors the streaming
+                    # path).
+                    raise web.HTTPServiceUnavailable(
+                        text=json.dumps(
+                            {"error": "replica failure before first token"}),
+                        content_type="application/json",
+                        headers=self._retry_after_headers(
+                            self.cfg.server.retry_after_s))
                 tail, stopped = matcher.push(decoder.flush())
                 parts.append(tail)
                 if not stopped:
@@ -710,6 +850,7 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                  draft_model: Optional[str] = None,
                  draft_checkpoint: Optional[str] = None,
                  enable_debug: bool = False,
+                 server_overrides: Optional[dict] = None,
                  **engine_overrides) -> InferenceServer:
     """Convenience constructor used by CLI, tests, and benchmarks.
 
@@ -717,6 +858,8 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
     checkpoint directory (architecture read from its config.json), or
     "auto" with ``checkpoint`` set. ``tokenizer="auto"`` uses the
     checkpoint directory's tokenizer files when present, else bytes.
+    ``server_overrides`` are extra ServerConfig fields (supervision
+    knobs: step_watchdog_s, admission_queue_depth, ...).
     """
     import os
 
@@ -739,7 +882,8 @@ def build_server(model: str = "tiny-llama", tokenizer: str = "byte",
                           server=ServerConfig(model_name=model,
                                               tokenizer=tokenizer,
                                               warmup=warmup,
-                                              enable_debug=enable_debug),
+                                              enable_debug=enable_debug,
+                                              **(server_overrides or {})),
                           checkpoint_path=checkpoint)
     draft_cfg = None
     if draft_model:

@@ -8,29 +8,194 @@ scheduler thread; the HTTP layer routes each request to the least-loaded
 replica. The reference's analogue is the load balancer in front of its
 external endpoint (implicit, out of repo — SURVEY.md §0); here it is
 in-process.
+
+Supervision (README "Failure handling & degraded operation"): each
+replica carries a health state machine
+
+    healthy -> degraded -> quarantined -> recovered -> healthy
+
+driven by consecutive step failures (engine exceptions surfaced through
+the scheduler hooks) and a step watchdog that detects wedged dispatches
+(the round-5 TPU failure mode: a decode call that never returns).
+Quarantined replicas receive no traffic; their failed or stranded
+requests fail over — resubmitted from the prompt to a healthy replica
+when no tokens were delivered yet, failed cleanly otherwise. Admission
+control sheds load (FleetSaturated/FleetUnavailable -> HTTP 429/503 with
+Retry-After) instead of queueing to the request timeout.
 """
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
 
+from tpu_inference.config import ServerConfig
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.scheduler import EngineScheduler
 
 
+class AdmissionError(RuntimeError):
+    """Request rejected before submission; carries the Retry-After hint."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class FleetSaturated(AdmissionError):
+    """Every routable replica is at the admission queue cap (HTTP 429)."""
+
+
+class FleetUnavailable(AdmissionError):
+    """No routable replica at all — fleet fully quarantined (HTTP 503)."""
+
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+RECOVERED = "recovered"
+
+
+class ReplicaHealth:
+    """Per-replica health state machine (thread-safe; hooks fire on the
+    replica's engine thread, the watchdog on the monitor thread, and
+    snapshots on HTTP handler threads)."""
+
+    def __init__(self, cfg: ServerConfig):
+        self.cfg = cfg
+        self.state = HEALTHY
+        self.consecutive_failures = 0
+        self.wedges = 0                 # watchdog firings
+        self.quarantines = 0            # entries into QUARANTINED
+        self.since = time.monotonic()   # last state change
+        self._lock = threading.Lock()
+
+    def _transition(self, state: str) -> None:
+        if state == QUARANTINED and self.state != QUARANTINED:
+            self.quarantines += 1
+        if state != self.state:
+            self.state = state
+            self.since = time.monotonic()
+
+    def on_ok(self) -> None:
+        # Hot path: one clean step per decode call — skip the lock when
+        # there is provably nothing to do.
+        if self.state == HEALTHY and self.consecutive_failures == 0:
+            return
+        with self._lock:
+            self.consecutive_failures = 0
+            if self.state in (DEGRADED, RECOVERED):
+                # RECOVERED -> HEALTHY is the probation pass.
+                self._transition(HEALTHY)
+            # QUARANTINED stays: a late success from a previously wedged
+            # call does not beat the cooldown (the fault may recur).
+
+    def on_error(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == RECOVERED:
+                # Probation failure: straight back to quarantine.
+                self._transition(QUARANTINED)
+            elif self.consecutive_failures >= self.cfg.quarantine_after_failures:
+                self._transition(QUARANTINED)
+            elif self.state == HEALTHY:
+                self._transition(DEGRADED)
+
+    def mark_wedged(self) -> bool:
+        """Watchdog deadline exceeded. True only on the transition, so
+        the caller fails over stranded requests exactly once."""
+        with self._lock:
+            if self.state == QUARANTINED:
+                return False
+            self.wedges += 1
+            self._transition(QUARANTINED)
+            return True
+
+    def maybe_recover(self) -> None:
+        """QUARANTINED -> RECOVERED after the cooldown. The caller must
+        not invoke this while the replica's dispatch is still wedged."""
+        with self._lock:
+            if (self.state == QUARANTINED
+                    and time.monotonic() - self.since
+                    >= self.cfg.quarantine_cooldown_s):
+                self._transition(RECOVERED)
+
+    @property
+    def routable(self) -> bool:
+        return self.state != QUARANTINED
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "wedges": self.wedges,
+                "quarantines": self.quarantines,
+                "state_age_s": round(time.monotonic() - self.since, 3),
+            }
+
+
+def _clone_request(seq: Sequence) -> Sequence:
+    """A pristine copy of the client-supplied request fields — engine-
+    filled state (slot, pages, generated, timings) starts fresh, so a
+    failover attempt replays from the prompt exactly like a new submit."""
+    return Sequence(
+        request_id=seq.request_id,
+        prompt_tokens=list(seq.prompt_tokens),
+        max_new_tokens=seq.max_new_tokens,
+        temperature=seq.temperature, top_p=seq.top_p, top_k=seq.top_k,
+        seed=seq.seed, repeat_penalty=seq.repeat_penalty,
+        repeat_last_n=seq.repeat_last_n, eos_token_id=seq.eos_token_id)
+
+
+# Finish reasons a zero-delivery request may be resubmitted after.
+_RETRYABLE = ("error",)
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """Group-side state for one in-flight request across attempts."""
+
+    template: Sequence                  # pristine request for resubmission
+    on_token: Callable
+    on_finish: Callable
+    sched: EngineScheduler
+    delivered: int = 0                  # tokens forwarded to the caller
+    attempts: int = 0                   # failover resubmissions so far
+    generation: int = 0                 # bumped to orphan stale callbacks
+
+
 class EngineGroup:
-    """dp EngineSchedulers with least-loaded request routing.
+    """dp EngineSchedulers with least-loaded routing, health supervision,
+    failover, and admission control.
 
     With one engine this is a transparent pass-through, so the server
     always talks to an EngineGroup.
     """
 
-    def __init__(self, engines: List[InferenceEngine]):
+    def __init__(self, engines: List[InferenceEngine],
+                 server_cfg: Optional[ServerConfig] = None):
         assert engines
         self.engines = engines
+        self.server_cfg = server_cfg or ServerConfig()
         self.schedulers = [EngineScheduler(e) for e in engines]
-        # request_id -> scheduler that owns it (ids are globally unique).
-        self._owner = {}
+        self.health = [ReplicaHealth(self.server_cfg) for _ in engines]
+        for sched, health in zip(self.schedulers, self.health):
+            sched.on_step_ok = health.on_ok
+            sched.on_step_error = lambda exc, h=health: h.on_error()
+        # request_id -> tracked entry (ids are globally unique).
+        self._tracked: Dict[int, _Tracked] = {}
+        self._lock = threading.Lock()
+        # Fleet counters (surfaced via stats_snapshot / /healthz).
+        self.retries_attempted = 0
+        self.retries_succeeded = 0
+        self.failovers = 0              # stranded-by-wedge resubmissions
+        self.requests_shed = 0          # 429: queue cap
+        self.requests_unavailable = 0   # 503: no routable replica
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
 
     @property
     def engine(self) -> InferenceEngine:
@@ -43,43 +208,250 @@ class EngineGroup:
     def start(self) -> "EngineGroup":
         for s in self.schedulers:
             s.start()
+        self._watch_stop.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch, name="replica-watchdog", daemon=True)
+        self._watch_thread.start()
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5.0)
+            self._watch_thread = None
         for s in self.schedulers:
             s.stop(drain=drain, timeout=timeout)
 
-    def _least_loaded(self) -> EngineScheduler:
-        def load(s: EngineScheduler) -> int:
-            return len(s._waiting) + len(s.engine.active_sequences())
+    # ------------------------------------------------------- supervision
 
-        return min(self.schedulers, key=load)
+    def _watch_interval(self) -> float:
+        cfg = self.server_cfg
+        interval = 0.25
+        if cfg.step_watchdog_s > 0:
+            interval = min(interval, cfg.step_watchdog_s / 5)
+        if cfg.quarantine_cooldown_s > 0:
+            interval = min(interval, max(0.05, cfg.quarantine_cooldown_s / 5))
+        return max(0.02, interval)
+
+    def _wedged(self, sched: EngineScheduler) -> bool:
+        wd = self.server_cfg.step_watchdog_s
+        t0 = sched.step_inflight_since
+        return wd > 0 and t0 is not None and time.monotonic() - t0 > wd
+
+    def _watch(self) -> None:
+        """Monitor thread: watchdog deadlines + quarantine cooldowns."""
+        interval = self._watch_interval()
+        while not self._watch_stop.wait(interval):
+            for sched, health in zip(self.schedulers, self.health):
+                if self._wedged(sched):
+                    if health.mark_wedged():
+                        self._failover_stranded(sched)
+                else:
+                    health.maybe_recover()
+
+    def _routable(self) -> List[EngineScheduler]:
+        out = []
+        for sched, health in zip(self.schedulers, self.health):
+            # Lazy cooldown check too, so a fleet whose monitor tick has
+            # not fired yet (or tests driving the group directly) still
+            # re-admits a cooled-down replica at submit time.
+            if not self._wedged(sched):
+                health.maybe_recover()
+            if health.routable:
+                out.append(sched)
+        return out
+
+    def _least_loaded(self) -> EngineScheduler:
+        routable = self._routable()
+        if not routable:
+            raise FleetUnavailable(
+                "all replicas quarantined",
+                self._retry_after())
+        return min(routable, key=lambda s: s.load)
+
+    def _retry_after(self) -> float:
+        return self.server_cfg.retry_after_s
 
     def embed_many(self, batch) -> "np.ndarray":  # noqa: F821
         """Embeddings on the least-loaded replica — pinning them to
         replica 0 would interleave dense forwards with its decode loop
         while the other replicas idle."""
-        return self._least_loaded().engine.embed_many(batch)
+        try:
+            sched = self._least_loaded()
+        except FleetUnavailable:
+            # Same counter as submit(): embed 503s must be visible in
+            # /healthz and stats, not just generate ones.
+            with self._lock:
+                self.requests_unavailable += 1
+            raise
+        return sched.engine.embed_many(batch)
+
+    # -------------------------------------------------------- submission
 
     def submit(self, seq: Sequence, on_token: Callable,
                on_finish: Callable) -> None:
-        sched = self._least_loaded()
-        self._owner[seq.request_id] = sched
+        """Route to the least-loaded healthy replica.
 
-        def done(s: Sequence) -> None:
-            self._owner.pop(s.request_id, None)
-            on_finish(s)
+        Raises FleetUnavailable (no routable replica) or FleetSaturated
+        (admission queue cap) instead of queueing — the HTTP layer maps
+        these to 503/429 with Retry-After. Scheduler-level rejections
+        (queue_full, too_large) still arrive via on_finish.
+        """
+        try:
+            sched = self._least_loaded()
+        except FleetUnavailable:
+            with self._lock:
+                self.requests_unavailable += 1
+            raise
+        cap = self.server_cfg.admission_queue_depth
+        if cap > 0 and sched.load >= cap:
+            with self._lock:
+                self.requests_shed += 1
+            raise FleetSaturated(
+                f"admission queue cap reached ({sched.load} >= {cap} "
+                "on the least-loaded replica)", self._retry_after())
+        entry = _Tracked(template=_clone_request(seq), on_token=on_token,
+                         on_finish=on_finish, sched=sched)
+        with self._lock:
+            self._tracked[seq.request_id] = entry
+        self._dispatch(entry, seq, sched)
 
-        sched.submit(seq, on_token, done)
+    def _dispatch(self, entry: _Tracked, seq: Sequence,
+                  sched: EngineScheduler) -> None:
+        gen = entry.generation
+        entry.sched = sched
+
+        def tok(s: Sequence, t: int) -> None:
+            if entry.generation != gen:     # stale attempt (failed over)
+                return
+            entry.delivered += 1
+            entry.on_token(s, t)
+
+        def fin(s: Sequence) -> None:
+            self._attempt_finished(entry, s, gen)
+
+        sched.submit(seq, tok, fin)
+
+    def _retry_target(self, failed: EngineScheduler
+                      ) -> Optional[EngineScheduler]:
+        routable = self._routable()
+        others = [s for s in routable if s is not failed]
+        pool = others or routable           # degraded-but-routable self ok
+        return min(pool, key=lambda s: s.load) if pool else None
+
+    def _attempt_finished(self, entry: _Tracked, seq: Sequence,
+                          gen: int) -> None:
+        """Terminal or retryable end of one attempt (engine thread).
+
+        The whole decision — is this attempt still current, does it
+        retry, which counters move — happens under one lock hold, so it
+        cannot interleave with _failover_stranded deciding about the
+        same entry from the watchdog thread (whoever bumps generation
+        first wins; the loser returns without acting)."""
+        rid = entry.template.request_id
+        with self._lock:
+            if entry.generation != gen:     # stranded failover took over
+                return
+            retryable = (seq.finish_reason in _RETRYABLE
+                         and entry.delivered == 0
+                         and entry.attempts
+                         < self.server_cfg.failover_max_retries)
+            target = self._retry_target(entry.sched) if retryable else None
+            if target is not None:
+                entry.attempts += 1
+                entry.generation += 1
+                self.retries_attempted += 1
+            else:
+                self._tracked.pop(rid, None)
+                if entry.attempts and seq.finish_reason in ("stop", "length"):
+                    self.retries_succeeded += 1
+        if target is not None:
+            self._dispatch(entry, _clone_request(entry.template), target)
+            return
+        entry.on_finish(seq)
+
+    def _failover_stranded(self, sched: EngineScheduler) -> None:
+        """A replica was quarantined by the watchdog mid-dispatch: its
+        engine thread may be stuck for minutes (or forever), so its
+        requests cannot finish through callbacks. Detach them here and
+        resubmit (zero tokens delivered, budget left) or fail them
+        cleanly; flag the originals done so the wedged thread, if it ever
+        wakes, reaps them instead of streaming into the void."""
+        actions = []
+        with self._lock:
+            # Decide everything inside one lock hold (see
+            # _attempt_finished): the generation bump atomically orphans
+            # both late wake-up callbacks AND any _attempt_finished
+            # racing from the wedged engine thread.
+            for rid, entry in list(self._tracked.items()):
+                if entry.sched is not sched:
+                    continue
+                entry.generation += 1
+                target = self._retry_target(sched)
+                can_retry = (entry.delivered == 0
+                             and entry.attempts
+                             < self.server_cfg.failover_max_retries
+                             and target is not None)
+                if can_retry:
+                    entry.attempts += 1
+                    self.retries_attempted += 1
+                    self.failovers += 1
+                else:
+                    self._tracked.pop(rid, None)
+                actions.append((rid, entry, can_retry, target))
+        for rid, entry, can_retry, target in actions:
+            sched.cancel(rid)               # reap-on-wake; frees queue slot
+            if can_retry:
+                self._dispatch(entry, _clone_request(entry.template), target)
+            else:
+                ghost = _clone_request(entry.template)
+                ghost.done = True
+                ghost.finish_reason = ("unavailable" if target is None
+                                       else "error")
+                ghost.finish_time = time.perf_counter()
+                entry.on_finish(ghost)
 
     def cancel(self, request_id: int) -> None:
         # Pop (not get): a request cancelled while still QUEUED never
-        # reaches _finish/on_finish, so the owner entry must be released
+        # reaches _finish/on_finish, so the tracked entry must be released
         # here or it leaks one dict entry per timed-out/disconnected
         # request. Double-pop from a later on_finish is harmless.
-        sched = self._owner.pop(request_id, None)
-        if sched is not None:
-            sched.cancel(request_id)
+        with self._lock:
+            entry = self._tracked.pop(request_id, None)
+            if entry is not None:
+                entry.generation += 1       # silence in-flight callbacks
+        if entry is not None:
+            entry.sched.cancel(request_id)
+
+    # ----------------------------------------------------- observability
+
+    def health_snapshot(self) -> dict:
+        """Operator view served by /healthz: per-replica states + fleet
+        status + shed/retry counters."""
+        replicas = [h.snapshot() for h in self.health]
+        routable = sum(1 for h in self.health if h.routable)
+        if routable == 0:
+            status = "unavailable"
+        elif all(r["state"] == HEALTHY for r in replicas):
+            status = "ok"
+        else:
+            status = "degraded"
+        return {
+            "status": status,
+            "replicas": replicas,
+            "supervision": self.supervision_counters(),
+        }
+
+    def supervision_counters(self) -> dict:
+        with self._lock:
+            return {
+                "retries_attempted": self.retries_attempted,
+                "retries_succeeded": self.retries_succeeded,
+                "failovers": self.failovers,
+                "requests_shed": self.requests_shed,
+                "requests_unavailable": self.requests_unavailable,
+                "states": [h.state for h in self.health],
+            }
 
     def recent_snapshot(self, n: int) -> List[dict]:
         """Most recent n finished-request timelines ACROSS replicas
@@ -100,8 +472,12 @@ class EngineGroup:
     def stats_snapshot(self) -> dict:
         """Aggregate counters + per-replica breakdown."""
         per = [s.stats.snapshot(s.engine) for s in self.schedulers]
+        for d, h in zip(per, self.health):
+            d["health"] = h.snapshot()
         if len(per) == 1:
-            return per[0]
+            out = dict(per[0])
+            out["supervision"] = self.supervision_counters()
+            return out
         agg = dict(per[0])
         for d in per[1:]:
             for k, v in d.items():
@@ -109,6 +485,10 @@ class EngineGroup:
                         or not isinstance(v, (int, float))):
                     continue
                 agg[k] = agg.get(k, 0) + v
+        # Replica 0's health dict would masquerade as the fleet's;
+        # per-replica health lives under "replicas", fleet under
+        # "supervision".
+        agg.pop("health", None)
         agg["mean_batch_occupancy"] = (
             sum(d["mean_batch_occupancy"] for d in per) / len(per))
         if "prefix_cache" in per[0]:
@@ -131,4 +511,5 @@ class EngineGroup:
                 "acceptance_rate": (accepted / drafted) if drafted else 0.0}
         agg["replicas"] = per
         agg["dp"] = len(per)
+        agg["supervision"] = self.supervision_counters()
         return agg

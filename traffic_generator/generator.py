@@ -10,6 +10,7 @@ to the shared session epoch.
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Optional, Sequence
 
 import aiohttp
@@ -69,65 +70,101 @@ class TrafficGenerator:
                 return rec["eval_count"]
         return max(0, n_lines - 1)
 
+    def _shed_delay(self, resp, attempt: int) -> float:
+        """Backoff before retrying a 429/503: honor the server's
+        Retry-After hint when present, never below exponential backoff
+        with jitter (so a fleet of clients doesn't re-stampede the
+        server on the exact hinted second)."""
+        base = float(self.config.get("retry_backoff_s", 0.25))
+        try:
+            hinted = float(resp.headers.get("Retry-After", ""))
+        except ValueError:
+            hinted = 0.0
+        delay = max(hinted, base * (2 ** attempt))
+        return delay * (1.0 + 0.25 * random.random())
+
     async def inference_call(self, session: aiohttp.ClientSession,
                              prompt: str, len_output: int, sleep_time: float,
                              query_id: int) -> None:
         collector = self.logger
         await asyncio.sleep(sleep_time)
+        # Load-shed resilience: a chaos- or admission-control-enabled
+        # server answers 429/503 + Retry-After instead of queueing;
+        # retrying with backoff turns those into clean latency records
+        # (num_retries) instead of raw failures. Budget exhaustion is
+        # recorded as a shed query, still not an exception.
+        max_retries = int(self.config.get("max_retries", 4))
         try:
-            async with session.post(
-                    self.config["url"], json=self._payload(prompt, len_output),
-                    trace_request_ctx={"query_id": query_id,
-                                       "collector": collector}) as resp:
-                resp.raise_for_status()
-                first = True
-                n_lines = 0
-                buf = b""
-                last_line = b""
-                # Streaming smoothness: fused K-step decode flushes tokens
-                # in bursts, so the worst inter-chunk gap (not just mean
-                # TPOT) is what a user perceives as a stall. Additive
-                # metric field; reference schema otherwise preserved.
-                prev_chunk_t = None
-                max_gap = 0.0
-                async for _chunk in resp.content:
-                    now = collector.elapsed()
-                    if first:
-                        collector.record(query_id, "first_token_arrive_time",
-                                         now)
-                        first = False
-                    else:
-                        max_gap = max(max_gap, now - prev_chunk_t)
-                    prev_chunk_t = now
-                    n_lines += _chunk.count(b"\n")
-                    # Track the last COMPLETE line whole: the terminal
-                    # record carries the full `context` id list and can be
-                    # arbitrarily long, so a fixed-size tail would truncate
-                    # it on exactly the long requests being measured.
-                    buf += _chunk
-                    if b"\n" in buf:
-                        parts = buf.split(b"\n")
-                        last_line = parts[-2]
-                        buf = parts[-1]
-                collector.record(query_id, "response_end_time",
-                                 collector.elapsed())
-                collector.record(query_id, "num_output_tokens",
-                                 self._count_tokens(buf or last_line,
-                                                    n_lines))
-                collector.record(query_id, "max_interchunk_gap", max_gap)
-                collector.record(query_id, "success", True)
-                end = collector.metrics[query_id]["response_end_time"]
-                start = collector.metrics[query_id].get(
-                    "request_start_time", end)
-                # Per-request turnaround line (reference main.py:267).
-                print(f"[END] ID: {query_id}, End: {end:.1f}, "
-                      f"turnaround: {end - start:.1f}")
+            for attempt in range(max_retries + 1):
+                async with session.post(
+                        self.config["url"],
+                        json=self._payload(prompt, len_output),
+                        trace_request_ctx={"query_id": query_id,
+                                           "collector": collector}) as resp:
+                    if resp.status in (429, 503):
+                        if attempt >= max_retries:
+                            collector.record_shed(query_id)
+                            print(f"[SHED] query {query_id}: "
+                                  f"{resp.status} after {attempt} retries")
+                            return
+                        delay = self._shed_delay(resp, attempt)
+                        collector.record_retry(query_id)
+                        print(f"[RETRY] query {query_id}: {resp.status}, "
+                              f"backoff {delay:.2f}s")
+                        await asyncio.sleep(delay)
+                        continue
+                    resp.raise_for_status()
+                    await self._consume_stream(resp, query_id)
+                    return
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:
             # ClientError covers response/connection AND payload errors
             # (mid-stream resets); one failed query must never abort the
             # whole gather and lose the run's metrics.
             collector.record(query_id, "success", False)
             print(f"[FAIL] query {query_id}: {exc!r}")
+
+    async def _consume_stream(self, resp, query_id: int) -> None:
+        """Stream the NDJSON body of one successful response, recording
+        TTFT, end-to-end latency, token count, and chunk smoothness."""
+        collector = self.logger
+        first = True
+        n_lines = 0
+        buf = b""
+        last_line = b""
+        # Streaming smoothness: fused K-step decode flushes tokens
+        # in bursts, so the worst inter-chunk gap (not just mean
+        # TPOT) is what a user perceives as a stall. Additive
+        # metric field; reference schema otherwise preserved.
+        prev_chunk_t = None
+        max_gap = 0.0
+        async for _chunk in resp.content:
+            now = collector.elapsed()
+            if first:
+                collector.record(query_id, "first_token_arrive_time", now)
+                first = False
+            else:
+                max_gap = max(max_gap, now - prev_chunk_t)
+            prev_chunk_t = now
+            n_lines += _chunk.count(b"\n")
+            # Track the last COMPLETE line whole: the terminal
+            # record carries the full `context` id list and can be
+            # arbitrarily long, so a fixed-size tail would truncate
+            # it on exactly the long requests being measured.
+            buf += _chunk
+            if b"\n" in buf:
+                parts = buf.split(b"\n")
+                last_line = parts[-2]
+                buf = parts[-1]
+        collector.record(query_id, "response_end_time", collector.elapsed())
+        collector.record(query_id, "num_output_tokens",
+                         self._count_tokens(buf or last_line, n_lines))
+        collector.record(query_id, "max_interchunk_gap", max_gap)
+        collector.record(query_id, "success", True)
+        end = collector.metrics[query_id]["response_end_time"]
+        start = collector.metrics[query_id].get("request_start_time", end)
+        # Per-request turnaround line (reference main.py:267).
+        print(f"[END] ID: {query_id}, End: {end:.1f}, "
+              f"turnaround: {end - start:.1f}")
 
     async def issue_queries(self) -> dict:
         timeout = aiohttp.ClientTimeout(
@@ -145,6 +182,9 @@ class TrafficGenerator:
                                                  qid))
             self.logger.start_session()
             await asyncio.gather(*calls)
+        if self.logger.retries_total or self.logger.shed_total:
+            print(f"[RESILIENCE] retries={self.logger.retries_total} "
+                  f"shed={self.logger.shed_total}")
         return self.logger.metrics
 
     def start_profile(self) -> dict:

@@ -35,6 +35,11 @@ config = {
     "stream": True,
     "save_log": True,         # reference main.py:311 (there: declared only)
     "log_path": "logs/log.json",
+    # Load-shed resilience: 429/503 responses (chaos mode / admission
+    # control) retry with exponential backoff + jitter, honoring the
+    # server's Retry-After hint, before counting as shed.
+    "max_retries": 4,
+    "retry_backoff_s": 0.25,
 }
 
 

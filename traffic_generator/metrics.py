@@ -28,6 +28,12 @@ class MetricCollector:
         self.metrics: Dict[int, dict] = {}
         self.session_start_timestamp: float = 0.0
         self.trace_config = RequestTracer()
+        # Harness-level resilience counters (chaos-enabled servers shed
+        # with 429/503; the generator retries with backoff): how many
+        # attempts were retried, and how many queries were ultimately
+        # shed after exhausting the retry budget.
+        self.retries_total: int = 0
+        self.shed_total: int = 0
 
     def start_session(self) -> None:
         self.session_start_timestamp = time.perf_counter()
@@ -48,11 +54,27 @@ class MetricCollector:
             "num_output_tokens": None,
             "max_interchunk_gap": None,
             "scheduled_start_time": scheduled_start,
+            "num_retries": 0,
+            "shed": False,
             "success": None,
         }
 
     def record(self, query_id: int, field: str, value) -> None:
         self.metrics.setdefault(query_id, {})[field] = value
+
+    def record_retry(self, query_id: int) -> None:
+        """One 429/503 response retried with backoff."""
+        entry = self.metrics.setdefault(query_id, {})
+        entry["num_retries"] = entry.get("num_retries", 0) + 1
+        self.retries_total += 1
+
+    def record_shed(self, query_id: int) -> None:
+        """Query dropped after exhausting the retry budget (the server
+        kept shedding) — a clean record, not a raw exception."""
+        entry = self.metrics.setdefault(query_id, {})
+        entry["shed"] = True
+        entry["success"] = False
+        self.shed_total += 1
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:
@@ -77,8 +99,13 @@ class RequestTracer(aiohttp.TraceConfig):
         collector, qid = self._ctx(context)
         if collector is None:
             return
-        collector.record(qid, "request_start_time", collector.elapsed())
-        print(f"[START] query {qid}")
+        # First attempt only: a 429/503 retry re-fires this hook, and
+        # overwriting would make turnaround exclude the earlier attempts
+        # and backoff sleeps — exactly the client-perceived latency a
+        # shed/retried query is supposed to show.
+        if collector.metrics.get(qid, {}).get("request_start_time") is None:
+            collector.record(qid, "request_start_time", collector.elapsed())
+            print(f"[START] query {qid}")
 
     async def _on_end(self, session, context, params) -> None:
         collector, qid = self._ctx(context)
