@@ -229,6 +229,15 @@ def lane_child(spec: str) -> None:
                               if s is not None]))
     head = [int(t) for t in engine.slots[0].generated[:8]]
     weight_bytes = int(engine.weight_bytes)  # same math as /api/ps
+    # Step-phase accounting for the lane (telemetry.py): dispatch wall
+    # vs host bubble percentiles, so the roofline question ("where do
+    # the missing tok/s go — compute or host?") is answered by the
+    # bench artifact itself.
+    phases = {k: {kk: v[kk] for kk in ("count", "sum", "p50", "p95", "p99")}
+              for k, v in engine.telemetry.phase_snapshot().items()
+              if k in ("decode_dispatch_s", "decode_sync_s",
+                       "dispatch_bubble_s", "prefill_dispatch_s",
+                       "tokens_per_dispatch")}
     print(json.dumps({
         "lane": spec, "model": cfg.name, "platform": platform,
         "sync_tok_s": sync_tok_s, "chained_tok_s": chained_tok_s,
@@ -236,6 +245,7 @@ def lane_child(spec: str) -> None:
         "mean_ctx": mean_ctx, "head": head,
         "kv_bytes_per_token": 2 * 2 * cfg.n_layers * cfg.n_kv_heads
                               * cfg.head_dim,
+        "phases": phases,
     }), flush=True)
     del engine
     gc.collect()
@@ -464,6 +474,11 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "weight_bytes_int8": int8["weight_bytes"] if int8 else None,
         "weight_bytes_int4": int4["weight_bytes"] if int4 else None,
         "mean_ctx": _r(any_lane.get("mean_ctx") if any_lane else None, 1),
+        # Winning lane's step-phase histograms (dispatch wall / sync /
+        # host bubble, p50/p95/p99): the instrumented answer to "weights
+        # vs KV vs dispatch vs bubbles".
+        "phase_breakdown": (win.get("phases") if any_lane and best
+                            else None),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
         "backends_token_equal": heads_equal,

@@ -26,6 +26,7 @@ import socket
 import sys
 import threading
 import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -36,6 +37,49 @@ def _percentiles(xs, ps=(50, 99)):
     if not xs:
         return {f"p{p}": None for p in ps}
     return {f"p{p}": round(float(np.percentile(xs, p)), 4) for p in ps}
+
+
+def scrape_metrics(port: int, fmt: str = None) -> tuple:
+    """GET /metrics over real HTTP (the same path an external Prometheus
+    collector takes — NOT an in-process shortcut, so this lane proves
+    the scrape path end-to-end). Returns (body, content_type)."""
+    url = f"http://127.0.0.1:{port}/metrics"
+    if fmt == "json":
+        url += "?format=json"
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read().decode(), r.headers.get("Content-Type", "")
+
+
+def phase_breakdown(before: dict, after: dict) -> dict:
+    """Diff two /metrics?format=json scrapes into the run window's phase
+    histograms: dispatch wall vs host bubble vs queue wait (p50/p95/p99)
+    plus the per-request phase sums, with a sum-check of queue + prefill
+    + decode against E2E — the artifact that answers "where does the
+    roofline go" without archaeology."""
+    from tpu_inference import telemetry as tm
+
+    aph = after.get("phases") or {}
+    bph = before.get("phases") or {}
+    out = {}
+    for key in ("decode_dispatch_s", "decode_sync_s", "dispatch_bubble_s",
+                "prefill_dispatch_s", "tokens_per_dispatch", "queue_wait_s",
+                "prefill_phase_s", "decode_phase_s", "ttft_s", "e2e_s"):
+        if key in aph:
+            d = tm.diff_phase(aph[key], bph.get(key))
+            out[key] = {k: d[k] for k in ("count", "sum", "p50", "p95",
+                                          "p99")}
+    phase_sum = sum(out.get(k, {}).get("sum") or 0.0
+                    for k in ("queue_wait_s", "prefill_phase_s",
+                              "decode_phase_s"))
+    e2e_sum = out.get("e2e_s", {}).get("sum") or 0.0
+    out["sum_check"] = {
+        # queue + prefill + decode vs e2e: same timestamps on the server
+        # side, so the ratio must be ~1.0 (the artifact's self-test).
+        "queue_plus_prefill_plus_decode_s": round(phase_sum, 6),
+        "e2e_s": round(e2e_sum, 6),
+        "ratio": round(phase_sum / e2e_sum, 4) if e2e_sum else None,
+    }
+    return out
 
 
 def summarize(metrics: dict, n_chips: int = 1) -> dict:
@@ -106,7 +150,11 @@ def start_server(args) -> tuple:
         kv_quant=getattr(args, "kv_quant", "none"),
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
         num_speculative_tokens=(args.num_speculative_tokens
-                                if args.draft_model else 0))
+                                if args.draft_model else 0),
+        # Smoke lane: small prefill buckets so the CPU tier-1 run
+        # compiles in seconds, not minutes.
+        **({"prefill_buckets": (16, 32, 64)}
+           if getattr(args, "smoke", False) else {}))
     loop = asyncio.new_event_loop()
     ready = threading.Event()
     boot_err: list = []
@@ -182,7 +230,24 @@ def main() -> dict:
                         "(tp*sp virtual devices) before any computation")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU smoke lane (tier-1): tiny model, tiny trace, "
+                        "small engine — exercises the full server boot + "
+                        "replay + /metrics scrape + phase_breakdown "
+                        "artifact path in seconds")
     args = p.parse_args()
+
+    if args.smoke:
+        # One switch pins every knob to the CPU-affordable shape so the
+        # tier-1 lane cannot drift from what CI actually runs.
+        args.model, args.tokenizer = "tiny-llama", "byte"
+        args.platform = "cpu"
+        args.max_trace = min(args.max_trace, 4)
+        args.max_batch_size, args.num_pages = 4, 128
+        args.page_size, args.max_pages_per_seq = 8, 8
+        args.decode_steps_per_call = 4
+        if args.out is None:
+            args.out = "benchmarks/results/replay_smoke.json"
 
     if args.platform != "auto":
         # Before any jax computation (env vars are read too early in
@@ -191,10 +256,15 @@ def main() -> dict:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-        if args.platform == "cpu":
+        if args.platform == "cpu" and args.tp * args.sp > 1:
+            # Only force the virtual-device count when the run actually
+            # needs a multi-device mesh: the CPU default is 1 device,
+            # and shrinking a host that asked for more (the in-process
+            # --smoke test runs inside pytest's 8-device session) would
+            # pin the whole process to 1 device before backend init.
             from tpu_inference.compat import set_cpu_device_count
 
-            set_cpu_device_count(max(1, args.tp * args.sp))
+            set_cpu_device_count(args.tp * args.sp)
 
     from tpu_inference.engine.autosize import resolve_sizing_args
 
@@ -211,18 +281,34 @@ def main() -> dict:
         schedule = Scheduler.get_schedule_from_trace(args.trace,
                                                      args.max_trace)
         collector = MetricCollector()
+        gen_kw = ({"max_prompt_len": 48, "max_gen_len": 12}
+                  if args.smoke else {})
         gen = TrafficGenerator(
             data, schedule,
             {"url": f"http://127.0.0.1:{port}/api/generate",
              "model": args.model, "temperature": args.temperature,
              "max_tokens": None, "stream": True},
-            collector)
+            collector, **gen_kw)
+        # Pre-run scrape over real HTTP: phase_breakdown diffs the
+        # histograms so only THIS run's window is attributed.
+        before_json, _ = scrape_metrics(port, fmt="json")
+        before = json.loads(before_json)
         t0 = time.perf_counter()
         metrics = gen.start_profile()
         replay_s = time.perf_counter() - t0
+        after_json, _ = scrape_metrics(port, fmt="json")
+        after = json.loads(after_json)
+        prom_text, prom_ctype = scrape_metrics(port)
         summary = summarize(metrics, n_chips=args.tp * args.sp)
         summary["replay_s"] = round(replay_s, 3)
-        summary["server_stats"] = srv.group.stats_snapshot()
+        summary["server_stats"] = after
+        summary["phase_breakdown"] = phase_breakdown(before, after)
+        summary["prometheus_scrape"] = {
+            "content_type": prom_ctype,
+            "families": prom_text.count("# TYPE "),
+            "samples": sum(1 for l in prom_text.splitlines()
+                           if l and not l.startswith("#")),
+        }
     finally:
         stop()
 

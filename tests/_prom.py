@@ -1,0 +1,92 @@
+"""Minimal Prometheus text-format (0.0.4) parser for test assertions.
+
+Independent of tpu_inference/telemetry.py's renderer on purpose: the
+exposition tests are parser-level — they must catch a renderer bug, so
+they cannot share its code. Strictness matches what real scrapers
+enforce: metric/label name charsets, quoted escaped label values, one
+value per line, HELP/TYPE comment grammar.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt,
+                                                             "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse(text: str) -> Tuple[Dict[str, dict], List[tuple]]:
+    """-> (meta, samples): meta[name] = {"type", "help"}, samples =
+    [(name, labels dict, float value)]. Raises AssertionError on any
+    line that is not valid exposition format."""
+    meta: Dict[str, dict] = {}
+    samples: List[tuple] = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, help_ = line[len("# HELP "):].split(" ", 1)
+            meta.setdefault(name, {})["help"] = help_
+        elif line.startswith("# TYPE "):
+            name, kind = line[len("# TYPE "):].split(" ", 1)
+            assert kind.strip() in ("counter", "gauge", "histogram",
+                                    "summary", "untyped"), line
+            meta.setdefault(name, {})["type"] = kind.strip()
+        elif line.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            raw = m.group(2) or ""
+            labels = {lm.group(1): _unescape(lm.group(2))
+                      for lm in _LABEL_RE.finditer(raw)}
+            # The label section must be nothing but well-formed pairs.
+            stripped = _LABEL_RE.sub("", raw).replace(",", "").strip()
+            assert stripped == "", f"malformed labels in: {line!r}"
+            v = m.group(3)
+            value = float("inf") if v == "+Inf" else float(v)
+            samples.append((m.group(1), labels, value))
+    return meta, samples
+
+
+def family(name: str, meta: Dict[str, dict]) -> str:
+    """Map a histogram series name (_bucket/_sum/_count) back to its
+    declared family; plain names map to themselves."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name[:-len(suffix)] in meta:
+            return name[:-len(suffix)]
+    return name
+
+
+def histogram_series(samples: List[tuple], name: str) -> Dict[tuple, list]:
+    """Group ``name_bucket`` samples by non-le labelset; each value is
+    the (le, cumulative count) list sorted by le."""
+    out: Dict[tuple, list] = {}
+    for n, labels, v in samples:
+        if n != name + "_bucket":
+            continue
+        key = tuple(sorted((k, val) for k, val in labels.items()
+                           if k != "le"))
+        le = labels["le"]
+        out.setdefault(key, []).append(
+            (float("inf") if le == "+Inf" else float(le), v))
+    for key in out:
+        out[key].sort()
+    return out

@@ -145,6 +145,14 @@ def test_step_failure_quarantines_and_fails_over():
         assert fseq.finish_reason in ("stop", "length")
         assert tokens == baseline, (
             "failover must replay from the prompt and match a no-fault run")
+        # Chaos-injected failover marks the resubmitted span: the
+        # finishing sequence and its /debug/requests timeline both carry
+        # attempt >= 1, so a replayed request is distinguishable from a
+        # first try.
+        assert fseq.attempt >= 1
+        marked = [t for t in group.recent_snapshot(50)
+                  if t["request_id"] == 102]
+        assert marked and any(t["attempt"] >= 1 for t in marked)
 
         assert group.health[1].state == QUARANTINED
         assert group.schedulers[0].stats.requests_finished > r0_before
@@ -256,7 +264,7 @@ def test_admission_queue_cap_sheds_with_retry_after():
         assert "admission queue cap" in body["error"]
         await resp.read()       # drain the occupying stream cleanly
 
-        stats = await (await client.get("/metrics")).json()
+        stats = await (await client.get("/metrics?format=json")).json()
         assert stats["supervision"]["requests_shed"] >= 1
 
     _run(srv, scenario)
@@ -298,7 +306,7 @@ def test_wedged_fleet_returns_503_and_healthz_degrades():
         emb = await client.post("/api/embed", json={"input": "x"})
         assert emb.status == 503
         assert "Retry-After" in emb.headers
-        stats = await (await client.get("/metrics")).json()
+        stats = await (await client.get("/metrics?format=json")).json()
         assert stats["supervision"]["requests_unavailable"] >= 2
 
     try:

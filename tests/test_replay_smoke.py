@@ -1,0 +1,57 @@
+"""Tier-1 CPU lane for ``benchmarks/replay.py --smoke``.
+
+The bench-side consumer of the metrics pipeline (HTTP /metrics scrape ->
+phase_breakdown artifact) must not rot between chip windows, so this
+exercises the whole path end-to-end on CPU: server boot + warmup, trace
+replay through the vendored traffic generator, a real-HTTP Prometheus
+scrape, and the committed artifact's phase_breakdown with its sum-check.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_replay():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "replay_smoke_mod", os.path.join(root, "benchmarks", "replay.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return root, mod
+
+
+def test_replay_smoke_commits_phase_breakdown(tmp_path, monkeypatch):
+    root, replay = _load_replay()
+    out = tmp_path / "replay_smoke.json"
+    monkeypatch.chdir(root)                 # trace/data paths repo-relative
+    monkeypatch.setattr(sys, "argv",
+                        ["replay.py", "--smoke", "--out", str(out)])
+    summary = replay.main()
+
+    # Every smoke request succeeded and produced tokens.
+    assert summary["succeeded"] == summary["requests"] > 0
+    assert summary["output_tokens"] > 0
+
+    art = json.loads(out.read_text())
+    assert art["config"]["smoke"] is True
+    pb = art["summary"]["phase_breakdown"]
+    # The roofline-attribution phases all carry data + percentiles.
+    for key in ("decode_dispatch_s", "dispatch_bubble_s", "queue_wait_s",
+                "prefill_dispatch_s", "e2e_s"):
+        assert pb[key]["count"] > 0, f"{key} never observed"
+        assert pb[key]["p50"] is not None
+        assert pb[key]["p95"] is not None
+        assert pb[key]["p99"] is not None
+        assert pb[key]["p50"] <= pb[key]["p99"]
+    # Sum-check: queue + prefill + decode == e2e (identical server-side
+    # timestamps; rounding only).
+    sc = pb["sum_check"]
+    assert sc["ratio"] is not None
+    assert abs(sc["ratio"] - 1.0) < 0.01
+    # The Prometheus scrape went over real HTTP and parsed.
+    prom = art["summary"]["prometheus_scrape"]
+    assert prom["content_type"].startswith("text/plain; version=0.0.4")
+    assert prom["families"] >= 10
+    assert prom["samples"] > 50

@@ -7,6 +7,7 @@ import json
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
+import _prom
 from tpu_inference.config import (EngineConfig, FrameworkConfig, ServerConfig,
                                   tiny_llama)
 from tpu_inference.server.http import InferenceServer
@@ -239,7 +240,7 @@ def test_aux_routes(server):
         assert (await client.get("/healthz")).status == 200
         tags = await (await client.get("/api/tags")).json()
         assert tags["models"][0]["name"] == "tiny-llama"
-        metrics = await (await client.get("/metrics")).json()
+        metrics = await (await client.get("/metrics?format=json")).json()
         assert "kv_pages_in_use" in metrics
         version = await (await client.get("/api/version")).json()
         assert "version" in version
@@ -299,7 +300,7 @@ def test_debug_requests_and_profile(server, profile_dir):
         assert t["queue_wait_s"] >= 0 and t["decode_s"] >= 0
         assert t["tpot_s"] > 0
 
-        resp = await client.get("/metrics")
+        resp = await client.get("/metrics?format=json")
         stats = await resp.json()
         assert stats["model_params"] > 0
         assert stats["approx_flops_per_token"] == 2 * stats["model_params"]
@@ -442,15 +443,142 @@ def test_dp_replica_serving(quant, kv_quant):
 
         bodies = await asyncio.gather(*[one(i) for i in range(6)])
         assert all(b["done"] and b["eval_count"] >= 1 for b in bodies)
-        stats = await (await client.get("/metrics")).json()
+        stats = await (await client.get("/metrics?format=json")).json()
         assert stats["dp"] == 2
         assert stats["quant"] == quant
         assert stats["kv_quant"] == kv_quant
         # Both replicas did work under concurrent load.
         assert all(r["requests_finished"] >= 1 for r in stats["replicas"])
+        # Fleet phase histograms merge across replicas (not replica 0's
+        # copy masquerading): every request shows up in the e2e count.
+        assert stats["phases"]["e2e_s"]["count"] == sum(
+            r["phases"]["e2e_s"]["count"] for r in stats["replicas"])
+        # Prometheus exposition separates replicas by label: the same
+        # family carries one series per replica, plus fleet-level
+        # supervision series without a replica label.
+        meta, samples = _prom.parse(
+            await (await client.get("/metrics")).text())
+        steps = {l.get("replica"): v for n, l, v in samples
+                 if n == "tpu_inf_steps_total"}
+        assert set(steps) == {"0", "1"}
+        assert any(n == "tpu_inf_replicas" and "replica" not in l
+                   for n, l, _ in samples)
 
     _run(srv, scenario)
 
+
+
+def test_metrics_prometheus_exposition(server):
+    """GET /metrics (default format) is standards-compliant Prometheus
+    text: correct content type, HELP/TYPE for every family, histogram
+    buckets cumulative-monotone with le="+Inf" == _count, and the step-
+    phase metric names the round-6 dashboards will scrape."""
+    async def go(client):
+        resp = await client.post("/api/generate", json={
+            "prompt": "scrape me", "stream": False, "max_tokens": 6,
+            "temperature": 0.0})
+        assert resp.status == 200
+
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        meta, samples = _prom.parse(await resp.text())
+
+        # Every sample belongs to a declared family with HELP and TYPE.
+        for name, labels, value in samples:
+            fam = _prom.family(name, meta)
+            assert "type" in meta[fam], f"no TYPE for {name}"
+            assert "help" in meta[fam], f"no HELP for {name}"
+        names = {_prom.family(n, meta) for n, _, _ in samples}
+        for expected in ("tpu_inf_decode_dispatch_seconds",
+                         "tpu_inf_prefill_dispatch_seconds",
+                         "tpu_inf_dispatch_bubble_seconds",
+                         "tpu_inf_tokens_per_dispatch",
+                         "tpu_inf_queue_wait_seconds",
+                         "tpu_inf_e2e_seconds",
+                         "tpu_inf_kv_pages_in_use",
+                         "tpu_inf_kv_page_allocs_total",
+                         "tpu_inf_tokens_generated_total",
+                         "tpu_inf_requests_finished_total"):
+            assert expected in names, f"{expected} missing from /metrics"
+
+        # Histogram contract per labelset: buckets monotone in le, last
+        # le=+Inf, +Inf bucket == _count, and _sum present.
+        counts = {(n[:-len("_count")], tuple(sorted(l.items()))): v
+                  for n, l, v in samples if n.endswith("_count")}
+        sums = {(n[:-len("_sum")], tuple(sorted(l.items()))): v
+                for n, l, v in samples if n.endswith("_sum")}
+        checked = 0
+        for fam, info in meta.items():
+            if info.get("type") != "histogram":
+                continue
+            for key, buckets in _prom.histogram_series(samples,
+                                                       fam).items():
+                vals = [v for _, v in buckets]
+                assert vals == sorted(vals), f"{fam} not cumulative"
+                assert buckets[-1][0] == float("inf")
+                assert counts[(fam, key)] == vals[-1]
+                assert sums[(fam, key)] >= 0
+                checked += 1
+        assert checked >= 5
+
+        # The decode phase actually ran: non-zero observations.
+        series = _prom.histogram_series(
+            samples, "tpu_inf_decode_dispatch_seconds")
+        assert any(b[-1][1] > 0 for b in series.values())
+        # Per-reason finish counter carries a label.
+        assert any(n == "tpu_inf_requests_finished_total"
+                   and l.get("reason") == "length"
+                   for n, l, _ in samples)
+        # JSON mode is preserved and still carries the legacy keys.
+        js = await (await client.get("/metrics?format=json")).json()
+        assert "kv_pages_in_use" in js and "phases" in js
+
+    _run(server, go)
+
+
+def test_request_id_propagation_and_span_accounting(server):
+    """X-Request-Id flows ingress -> engine -> response header, terminal
+    record, and the /debug/requests span; the span's queue + prefill +
+    decode phases sum to E2E (same clock stamps), and the new dispatch-
+    wall/bubble phases are populated."""
+    async def go(client):
+        resp = await client.post("/api/generate", json={
+            "prompt": "trace this request", "stream": False,
+            "max_tokens": 6, "temperature": 0.0},
+            headers={"X-Request-Id": "trace-me-42"})
+        assert resp.status == 200
+        assert resp.headers["X-Request-Id"] == "trace-me-42"
+        rec = await resp.json()
+        assert rec["request_id"] == "trace-me-42"
+
+        timelines = await (await client.get("/debug/requests")).json()
+        spans = [t for t in timelines if t.get("trace_id") == "trace-me-42"]
+        assert spans, "span for the traced request must be recorded"
+        t = spans[-1]
+        assert t["attempt"] == 0
+        # Phase sum-check: identical timestamps on both sides, so the
+        # identity holds to rounding noise.
+        phase_sum = t["queue_wait_s"] + t["prefill_s"] + t["decode_s"]
+        assert abs(phase_sum - t["e2e_s"]) < 1e-3
+        assert t["ttft_s"] >= t["queue_wait_s"]
+        assert t["dispatch_wall_s"] > 0
+        assert t["bubble_s"] >= 0
+        # Dispatch exposure can't exceed the request's wall clock.
+        assert t["dispatch_wall_s"] <= t["e2e_s"] + 1e-3
+
+        # Streaming + no client id: the server mints one and echoes it.
+        resp = await client.post("/api/generate", json={
+            "prompt": "minted id", "stream": True, "max_tokens": 4,
+            "temperature": 0.0})
+        assert resp.status == 200
+        minted = resp.headers.get("X-Request-Id")
+        assert minted
+        lines = [json.loads(l) for l in (await resp.read()).splitlines()]
+        assert lines[-1]["request_id"] == minted
+
+    _run(server, go)
 
 
 def test_context_continuation_hits_prefix_cache(server):
@@ -463,13 +591,13 @@ def test_context_continuation_hits_prefix_cache(server):
             "temperature": 0.0})
         assert resp.status == 200
         first = await resp.json()
-        before = (await (await client.get("/metrics")).json()
+        before = (await (await client.get("/metrics?format=json")).json()
                   )["tokens_prefix_cached"]
         cont = await (await client.post("/api/generate", json={
             "prompt": " keep going", "stream": False, "max_tokens": 4,
             "temperature": 0.0, "context": first["context"]})).json()
         assert cont["done"]
-        after = (await (await client.get("/metrics")).json()
+        after = (await (await client.get("/metrics?format=json")).json()
                  )["tokens_prefix_cached"]
         assert after > before
 

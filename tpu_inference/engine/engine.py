@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_inference import telemetry
 from tpu_inference.compat import shard_map
 from tpu_inference.config import EngineConfig, ModelConfig
 from tpu_inference.engine import kv_cache as kvc
@@ -182,6 +183,35 @@ def make_paged_attn(cfg: ModelConfig, page_size: int, block_tables: jax.Array,
     return attn
 
 
+def int4_mosaic_validated() -> bool:
+    """True when an on-chip Mosaic validation artifact covers the int4
+    KV path (ADVICE r5: the nibble-packed kernels have only ever been
+    proven under interpret-mode Pallas unless a benchmarks/results
+    mosaic_*.json from a real-TPU run says otherwise).
+
+    ``TPU_INF_INT4_VALIDATED=1`` is the operator override for
+    deployments that validated out-of-repo.
+    """
+    import glob
+    import json as _json
+    import os
+
+    if os.environ.get("TPU_INF_INT4_VALIDATED"):
+        return True
+    results = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "..", "..", "benchmarks", "results")
+    for path in glob.glob(os.path.join(results, "mosaic_*.json")):
+        try:
+            with open(path) as f:
+                rec = _json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (rec.get("platform") == "tpu" and rec.get("ok")
+                and any("int4" in k for k in rec.get("checks", {}))):
+            return True
+    return False
+
+
 class ChaosStepError(RuntimeError):
     """Injected engine-step failure (EngineConfig.chaos_step_failure_rate).
 
@@ -226,6 +256,19 @@ class Sequence:
     prefill_start: float = 0.0
     first_token_time: float = 0.0
     finish_time: float = 0.0
+    # End-to-end tracing (telemetry.py): trace_id is the client-visible
+    # request id propagated from HTTP ingress (X-Request-Id) into
+    # structured logs and response metadata; attempt counts failover
+    # resubmissions (server/replicas.py) so a resubmitted span is marked.
+    trace_id: str = ""
+    attempt: int = 0
+    # Phase accounting accrued by the engine: wall time of device
+    # dispatches this request participated in, and its share of the
+    # host-side bubble between decode calls. Shared dispatches accrue
+    # fully to every participant (they wait on the same call), so these
+    # are per-request *exposure*, not an additive fleet total.
+    dispatch_wall_s: float = 0.0
+    bubble_s: float = 0.0
 
     @property
     def last_token(self) -> int:
@@ -309,6 +352,15 @@ class InferenceEngine:
         self.kv = kvc.alloc_kv_pages(model_cfg, engine_cfg, sharding=kv_sh,
                                      scale_sharding=kv_scale_sh)
         self.allocator = PageAllocator(engine_cfg.num_pages)
+        # Step-phase telemetry (telemetry.py): dispatch/bubble histograms
+        # + read-through page/param gauges. TPU_INF_TELEMETRY=0 swaps in
+        # no-op metrics (the overhead-comparison arm).
+        self.telemetry = telemetry.EngineTelemetry(self)
+        # Host-side bubble tracking: perf_counter at the end of the last
+        # decode dispatch, None when the decode streak broke (idle batch
+        # or an interleaved prefill) so cross-idle gaps never count.
+        self._last_decode_end: Optional[float] = None
+        self._check_degraded_modes()
         # Fault injection, copied out of the frozen config so tests and
         # the /debug/chaos endpoint can arm/disarm per replica at runtime.
         self.chaos_step_failure_rate = engine_cfg.chaos_step_failure_rate
@@ -733,6 +785,60 @@ class InferenceEngine:
             fwd, errors=checkify.float_checks))(self.params, toks, pos)
         err.throw()
 
+    def _check_degraded_modes(self) -> None:
+        """Boot-time gate for known-degraded serving configurations
+        (ADVICE r5): int4 KV on the Pallas backend on a real TPU without
+        an on-chip Mosaic validation artifact has never had its
+        nibble-packed kernels proven under the Mosaic compiler — warn
+        loudly through the structured logger and hold the
+        tpu_inf_degraded_mode gauge at 1 so dashboards see it."""
+        if (self.attn_backend == "pallas"
+                and self.engine_cfg.kv_quant == "int4"
+                and jax.default_backend() == "tpu"
+                and not int4_mosaic_validated()):
+            self.telemetry.degraded_mode.set(1)
+            telemetry.log_event(
+                "degraded_mode", level="warning",
+                reason="kv_quant=int4 + pallas on real TPU without an "
+                       "on-chip Mosaic validation artifact "
+                       "(benchmarks/results/mosaic_*.json with an int4 "
+                       "check, or TPU_INF_INT4_VALIDATED=1)",
+                model=self.model_cfg.name,
+                attn_backend=self.attn_backend,
+                kv_quant=self.engine_cfg.kv_quant)
+
+    # -- Decode dispatch/bubble accounting (telemetry.py phase model).
+
+    def _note_decode_entry(self, active_seqs: List["Sequence"]) -> float:
+        """Record the host-side bubble since the last decode dispatch
+        ended (if the decode streak is unbroken) and return the dispatch
+        start timestamp."""
+        now = time.perf_counter()
+        last = self._last_decode_end
+        if last is not None and self.telemetry.enabled:
+            gap = now - last
+            self.telemetry.dispatch_bubble_s.observe(gap)
+            for seq in active_seqs:
+                seq.bubble_s += gap
+        return now
+
+    def _note_decode_exit(self, t0: float,
+                          active_seqs: List["Sequence"]) -> None:
+        """Record one decode dispatch's host wall and refresh the bubble
+        reference point. The streak survives only while some sequence is
+        still live — cross-idle gaps are not bubbles."""
+        now = time.perf_counter()
+        tel = self.telemetry
+        if tel.enabled:
+            dt = now - t0
+            tel.decode_dispatch_s.observe(dt)
+            tel.decode_dispatches.inc()
+            for seq in active_seqs:
+                seq.dispatch_wall_s += dt
+        self._last_decode_end = (
+            now if any(s is not None and not s.done for s in self.slots)
+            else None)
+
     def _next_key(self) -> jax.Array:
         self._step_count += 1
         return jax.random.fold_in(self._base_key, self._step_count)
@@ -909,6 +1015,8 @@ class InferenceEngine:
         toks[0, :len(chunk)] = chunk
         use_sp = self._use_sp(offset, len(chunk), len(prompt), bucket)
         prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
+        t0 = time.perf_counter()
+        self._last_decode_end = None     # prefill breaks the decode streak
         self.kv, tok, _ = prefill(
             self.params, self.kv, jnp.asarray(toks),
             jnp.asarray([len(chunk)], np.int32),
@@ -926,6 +1034,11 @@ class InferenceEngine:
                 self.draft_params, self.draft_kv, jnp.asarray(toks),
                 jnp.asarray([len(chunk)], np.int32),
                 jnp.asarray([offset], np.int32), jnp.asarray(bt))
+        if self.telemetry.enabled:
+            dt = time.perf_counter() - t0
+            self.telemetry.prefill_dispatch_s.observe(dt)
+            self.telemetry.prefill_dispatches.inc()
+            seq.dispatch_wall_s += dt
         return offset + len(chunk), tok
 
     def _prefill_chunked(self, seq: Sequence, prompt: List[int]) -> None:
@@ -1019,6 +1132,8 @@ class InferenceEngine:
             if rpens[i] != 1.0:
                 wins[i] = self._penalty_window_row(seq)
         prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
+        t0 = time.perf_counter()
+        self._last_decode_end = None     # prefill breaks the decode streak
         self.kv, tok, _ = prefill(
             self.params, self.kv, jnp.asarray(toks), jnp.asarray(plen),
             jnp.asarray(pref), jnp.asarray(bts), self._next_key(),
@@ -1030,6 +1145,12 @@ class InferenceEngine:
                 self.draft_params, self.draft_kv, jnp.asarray(toks),
                 jnp.asarray(plen), jnp.asarray(pref), jnp.asarray(bts))
         toks_out = np.asarray(tok)
+        if self.telemetry.enabled:
+            dt = time.perf_counter() - t0    # includes the token readback
+            self.telemetry.prefill_dispatch_s.observe(dt)
+            self.telemetry.prefill_dispatches.inc()
+            for seq, _ in group:
+                seq.dispatch_wall_s += dt
         for i, (seq, prompt) in enumerate(group):
             self._prefill_finish(seq, prompt, int(toks_out[i]))
 
@@ -1262,6 +1383,7 @@ class InferenceEngine:
         # token) instead of masking K-1 steps of the fused graph.
         decode = self._decode_one_jit if k_steps == 1 else \
             self._decode_multi_jit
+        t0 = self._note_decode_entry(active_seqs)
         self.kv, outs, _, _ = decode(
             self.params, self.kv, jnp.asarray(tokens), jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
@@ -1269,6 +1391,7 @@ class InferenceEngine:
             jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
             jnp.asarray(rlasts), jnp.asarray(windows))
         outs = np.asarray(outs)                                 # [K, B]
+        self._note_decode_exit(t0, active_seqs)
 
         result: Dict[int, List[int]] = {}
         for seq in active_seqs:
@@ -1276,6 +1399,9 @@ class InferenceEngine:
                 seq, (int(outs[s, seq.slot]) for s in range(k_steps)))
             if got:
                 result[seq.request_id] = got
+        if self.telemetry.enabled:
+            self.telemetry.tokens_per_dispatch.observe(
+                sum(len(t) for t in result.values()))
         return result
 
     # ------------------------------------------------------------------
@@ -1347,12 +1473,17 @@ class InferenceEngine:
             tokens_d = jnp.where(carried_d, call["final"], tokens_d)
             window_d = jnp.where(carried_d[:, None], call["final_window"],
                                  window_d)
+        t0 = self._note_decode_entry(staged)
         self.kv, outs, final, final_window = self._decode_multi_jit(
             self.params, self.kv, tokens_d, jnp.asarray(ctx_lens),
             jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
             self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
             jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
             jnp.asarray(rlasts), window_d)
+        # Non-blocking dispatch: the wall recorded here is host dispatch
+        # overhead; the device wait surfaces in decode_sync_s at
+        # _sync_oldest.
+        self._note_decode_exit(t0, staged)
         return {"outs": outs, "final": final,
                 "final_window": final_window,
                 "allowed": allowed_by_slot,
@@ -1363,7 +1494,24 @@ class InferenceEngine:
         host state; tokens for lanes that finished in an earlier call are
         discarded (their compute was speculative)."""
         call = self._inflight.pop(0)
+        t0 = time.perf_counter()
         outs = np.asarray(call["outs"])               # [K, B]
+        if self.telemetry.enabled:
+            dt = time.perf_counter() - t0
+            self.telemetry.decode_sync_s.observe(dt)
+            for seq in call["seqs"].values():
+                if not seq.done and self.slots[seq.slot] is seq:
+                    seq.dispatch_wall_s += dt
+        # The blocking sync is DEVICE time (already in decode_sync_s /
+        # dispatch_wall_s): refresh the bubble reference point so the
+        # next decode entry measures only host work after it — without
+        # this, dispatch-ahead mode would re-count every device step as
+        # "host-side bubble" and the phase_breakdown would blame the
+        # host for a busy device.
+        self._last_decode_end = (
+            time.perf_counter()
+            if any(s is not None and not s.done for s in self.slots)
+            else None)
         result: Dict[int, List[int]] = {}
         for slot, seq in call["seqs"].items():
             if seq.done or self.slots[seq.slot] is not seq:
@@ -1372,6 +1520,9 @@ class InferenceEngine:
                 seq, (int(outs[s, slot]) for s in range(outs.shape[0])))
             if got:
                 result[seq.request_id] = got
+        if self.telemetry.enabled:
+            self.telemetry.tokens_per_dispatch.observe(
+                sum(len(t) for t in result.values()))
         return result
 
     def decode_steps_pipelined(self) -> Dict[int, List[int]]:
@@ -1466,13 +1617,21 @@ class InferenceEngine:
         window_dev = jnp.asarray(windows)
         outs_all = []
         for c in range(n_calls):
+            t0 = self._note_decode_entry(active_seqs)
             self.kv, outs, tokens_dev, window_dev = self._decode_multi_jit(
                 self.params, self.kv, tokens_dev,
                 jnp.asarray(ctx_lens + c * allowed, np.int32), bts_d,
                 allowed_d, no_eos, self._next_key(), temps_d, top_ps_d,
                 top_ks_d, seeds_d, rpens_d, rlasts_d, window_dev)
             outs_all.append(outs)
+            self._note_decode_exit(t0, active_seqs)
+        t_sync = time.perf_counter()
         jax.block_until_ready(tokens_dev)
+        if self.telemetry.enabled:
+            self.telemetry.decode_sync_s.observe(
+                time.perf_counter() - t_sync)
+        # Device wait, not host bubble (same rationale as _sync_oldest).
+        self._last_decode_end = time.perf_counter()
 
         result: Dict[int, List[int]] = {rid.request_id: []
                                         for rid in active_seqs}
@@ -1552,6 +1711,7 @@ class InferenceEngine:
         # sampler consumes randomness at a data-dependent rate, so a
         # position-keyed stream would not reproduce anyway); spec uses the
         # engine-global key.
+        t0 = self._note_decode_entry(active_seqs)
         out = self._spec_jit(
             self.params, self.draft_params, self.kv, self.draft_kv,
             jnp.asarray(tokens), jnp.asarray(ctx_lens), jnp.asarray(bts),
@@ -1560,6 +1720,7 @@ class InferenceEngine:
         self.kv, self.draft_kv = out.kv, out.draft_kv
         emitted = np.asarray(out.emitted)                   # [B, gamma+1]
         n_acc = np.asarray(out.n_accepted)
+        self._note_decode_exit(t0, active_seqs)
 
         result: Dict[int, List[int]] = {}
         for seq in active_seqs:
@@ -1585,6 +1746,9 @@ class InferenceEngine:
             self.spec_accepted += min(int(n_acc[seq.slot]), drafted)
             if got:
                 result[seq.request_id] = got
+        if self.telemetry.enabled:
+            self.telemetry.tokens_per_dispatch.observe(
+                sum(len(t) for t in result.values()))
         return result
 
     # ------------------------------------------------------------------

@@ -230,6 +230,12 @@ class PageAllocator:
         # engine thread so metrics scrapes from other threads read a
         # GIL-atomic int instead of iterating a mutating dict.
         self.evictable_count = 0
+        # Lifetime alloc/free churn counters, exported by telemetry as
+        # tpu_inf_kv_page_{allocs,frees}_total (read-through, so the
+        # allocator itself never imports the metrics layer). Plain ints:
+        # engine-thread writes, GIL-atomic reads from scrape threads.
+        self.pages_allocated_total = 0
+        self.pages_freed_total = 0
 
     @property
     def num_free(self) -> int:
@@ -257,6 +263,7 @@ class PageAllocator:
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._refs[p] = 1
+        self.pages_allocated_total += n
         return pages
 
     def share(self, page: int) -> int:
@@ -278,6 +285,7 @@ class PageAllocator:
             self._refs[p] -= 1
             if self._refs[p] == 0:
                 self._free.append(p)
+                self.pages_freed_total += 1
             elif self._refs[p] == 1 and self._cached[p]:
                 self.evictable_count += 1   # cache is now sole holder
 

@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional
 
+from tpu_inference import telemetry
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 
@@ -106,6 +107,11 @@ class SchedulerStats:
             d, a = engine.spec_drafted, engine.spec_accepted
             out["speculative"] = {"drafted": d, "accepted": a,
                                   "acceptance_rate": (a / d) if d else 0.0}
+        # Step-phase histograms (telemetry.py): dispatch wall, bubble,
+        # queue-wait, per-request phases — cumulative buckets + estimated
+        # percentiles, diffable across scrapes (benchmarks commit the
+        # diff as phase_breakdown). Empty dict when TPU_INF_TELEMETRY=0.
+        out["phases"] = engine.telemetry.phase_snapshot()
         return out
 
 
@@ -131,6 +137,9 @@ class EngineScheduler:
         self.max_prefills_per_step = max_prefills_per_step
         self.idle_sleep_s = idle_sleep_s
         self.stats = SchedulerStats()
+        # Read-through Prometheus counters over this scheduler's stats
+        # (steps/prefills/tokens/queue depth) join the engine's registry.
+        engine.telemetry.bind_scheduler(self)
         # Per-request event timeline ring (SURVEY.md §5 observability:
         # "per-request event timeline: enqueue -> schedule -> prefill ->
         # decode -> stream"). Read by /debug/requests.
@@ -243,6 +252,10 @@ class EngineScheduler:
         self.stats.prefills += 1
         self.stats.tokens_generated += 1
         self.stats.tokens_prefix_cached += seq.cached_tokens
+        tel = self.engine.telemetry
+        if tel.enabled and seq.enqueue_time:
+            tel.queue_wait_s.observe(
+                max(0.0, seq.prefill_start - seq.enqueue_time))
         pending.on_token(seq, seq.generated[-1])
         if seq.done:
             self._finish(seq)
@@ -360,10 +373,39 @@ class EngineScheduler:
             pending = self._callbacks.pop(seq.request_id, None)
         self.engine.release(seq)
         self.stats.requests_finished += 1
+        self._observe_finish(seq)
         with self._lock:
             self.recent.append(self._timeline(seq))
         if pending is not None:
             pending.on_finish(seq)
+
+    def _observe_finish(self, seq: Sequence) -> None:
+        """Fold one finished request into the phase histograms + the
+        structured log stream (telemetry.py). Phases come from the same
+        timestamps as the /debug/requests timeline, so queue + prefill +
+        decode sums to e2e by construction — the invariant the bench
+        artifact sum-checks."""
+        tel = self.engine.telemetry
+        tel.request_finished(seq.finish_reason)
+        fin = seq.finish_time or time.perf_counter()
+        first = seq.first_token_time or fin
+        start = seq.prefill_start or fin
+        enq = seq.enqueue_time or start
+        if tel.enabled and seq.enqueue_time:
+            tel.prefill_phase_s.observe(max(0.0, first - start))
+            tel.decode_phase_s.observe(max(0.0, fin - first))
+            tel.ttft_s.observe(max(0.0, first - enq))
+            tel.e2e_s.observe(max(0.0, fin - enq))
+        telemetry.log_event(
+            "request_finish", level="info",
+            request_id=seq.trace_id or str(seq.request_id),
+            reason=seq.finish_reason, attempt=seq.attempt,
+            prompt_tokens=len(seq.prompt_tokens),
+            output_tokens=len(seq.generated),
+            queue_wait_s=round(max(0.0, start - enq), 6),
+            prefill_s=round(max(0.0, first - start), 6),
+            decode_s=round(max(0.0, fin - first), 6),
+            e2e_s=round(max(0.0, fin - enq), 6))
 
     def recent_snapshot(self, n: int) -> List[dict]:
         """Thread-safe copy of the last ``n`` request timelines (the deque
@@ -381,6 +423,11 @@ class EngineScheduler:
         n_out = len(seq.generated)
         return {
             "request_id": seq.request_id,
+            # Client-visible trace id (X-Request-Id) and failover attempt
+            # count: a resubmitted span carries attempt >= 1 so operators
+            # can tell a replayed request from a first try.
+            "trace_id": seq.trace_id,
+            "attempt": seq.attempt,
             "finished_unix": round(time.time(), 3),
             "prompt_tokens": len(seq.prompt_tokens),
             "cached_tokens": seq.cached_tokens,
@@ -391,6 +438,14 @@ class EngineScheduler:
             "prefill_s": round(max(0.0, first - (seq.prefill_start or first)),
                                6),
             "decode_s": round(max(0.0, fin - first), 6),
+            "e2e_s": round(max(0.0, fin - (seq.enqueue_time
+                                           or seq.prefill_start or fin)), 6),
+            "ttft_s": round(max(0.0, first - (seq.enqueue_time or first)), 6),
+            # Engine-accrued phase exposure: wall time of device
+            # dispatches this request participated in, and its share of
+            # host-side bubbles between decode calls.
+            "dispatch_wall_s": round(seq.dispatch_wall_s, 6),
+            "bubble_s": round(seq.bubble_s, 6),
             "tpot_s": round((fin - first) / (n_out - 1), 6)
             if n_out > 1 else None,
         }

@@ -38,10 +38,12 @@ import itertools
 import json
 import random
 import time
+import uuid
 from typing import Any, Optional
 
 from aiohttp import web
 
+from tpu_inference import telemetry
 from tpu_inference.config import FrameworkConfig
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.sampling import PENALTY_WINDOW
@@ -339,7 +341,15 @@ class InferenceServer:
                                   "embeddings": vecs})
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
-        return web.json_response(self.group.stats_snapshot())
+        """Prometheus text exposition (the default — scrapeable by any
+        standard collector, per-replica labels under dp>1); the legacy
+        JSON snapshot is preserved under ``?format=json`` (which also
+        carries the diffable "phases" histograms the bench scrapes)."""
+        if request.query.get("format") == "json":
+            return web.json_response(self.group.stats_snapshot())
+        return web.Response(
+            text=self.group.prometheus_text(),
+            headers={"Content-Type": telemetry.PROMETHEUS_CONTENT_TYPE})
 
     async def handle_debug_requests(self, request: web.Request
                                     ) -> web.Response:
@@ -614,12 +624,26 @@ class InferenceServer:
                 prompt_ids = prompt_ids[1:]
             prompt_ids = list(ctx_ids) + prompt_ids
         rid = next(self._ids)
+        # End-to-end request tracing: honor a client-supplied
+        # X-Request-Id (sanitized: printable, capped) or mint one. It
+        # rides the Sequence through the scheduler/engine into the
+        # structured logs, the /debug/requests span, the response's
+        # X-Request-Id header and the terminal record's request_id.
+        trace_id = (request.headers.get("X-Request-Id") or "").strip()
+        trace_id = ("".join(c for c in trace_id if c.isprintable())[:64]
+                    or uuid.uuid4().hex[:16])
         seq = Sequence(request_id=rid, prompt_tokens=prompt_ids,
                        max_new_tokens=max_tokens, temperature=temperature,
                        top_p=top_p, top_k=top_k, seed=seed,
                        repeat_penalty=repeat_penalty,
                        repeat_last_n=repeat_last_n,
-                       eos_token_id=self.tokenizer.eos_token_id)
+                       eos_token_id=self.tokenizer.eos_token_id,
+                       trace_id=trace_id)
+        telemetry.log_event(
+            "request_received", level="info", request_id=trace_id,
+            route="chat" if chat else "generate",
+            prompt_tokens=len(prompt_ids), max_tokens=max_tokens,
+            stream=stream)
 
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()
@@ -682,6 +706,9 @@ class InferenceServer:
         rec = {
             "model": model_name,
             "created_at": _now_iso(),
+            # Propagated trace id (additive field): lets a client join
+            # its response to server-side spans/logs without headers.
+            "request_id": seq.trace_id,
             "response": "",
             "done": True,
             "done_reason": seq.finish_reason or "stop",
@@ -710,7 +737,8 @@ class InferenceServer:
                                warnings: Optional[list] = None
                                ) -> web.StreamResponse:
         resp = web.StreamResponse(status=200, headers={
-            "Content-Type": "application/x-ndjson"})
+            "Content-Type": "application/x-ndjson",
+            "X-Request-Id": seq.trace_id})
         resp.enable_chunked_encoding()
         decoder = IncrementalDecoder(self.tokenizer,
                                      prompt_tail=seq.prompt_tokens[-8:])
@@ -814,7 +842,8 @@ class InferenceServer:
                 final["message"] = {"role": "assistant", "content": text}
             else:
                 final["response"] = text
-            return web.json_response(final)
+            return web.json_response(
+                final, headers={"X-Request-Id": seq.trace_id})
 
         while True:
             kind, payload = await asyncio.wait_for(queue.get(), timeout)

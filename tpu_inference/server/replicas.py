@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from tpu_inference import telemetry
 from tpu_inference.config import ServerConfig
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.scheduler import EngineScheduler
@@ -147,7 +148,8 @@ def _clone_request(seq: Sequence) -> Sequence:
         max_new_tokens=seq.max_new_tokens,
         temperature=seq.temperature, top_p=seq.top_p, top_k=seq.top_k,
         seed=seq.seed, repeat_penalty=seq.repeat_penalty,
-        repeat_last_n=seq.repeat_last_n, eos_token_id=seq.eos_token_id)
+        repeat_last_n=seq.repeat_last_n, eos_token_id=seq.eos_token_id,
+        trace_id=seq.trace_id)
 
 
 # Finish reasons a zero-delivery request may be resubmitted after.
@@ -196,6 +198,40 @@ class EngineGroup:
         self.requests_unavailable = 0   # 503: no routable replica
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        # Fleet-level Prometheus registry: supervision counters (no
+        # replica label — they are fleet decisions) + per-replica health
+        # gauges. Rendered together with each engine's registry (under
+        # replica="i" labels) by prometheus_text().
+        self._fleet_registry = telemetry.Registry()
+        r = self._fleet_registry
+        r.gauge("tpu_inf_replicas", "Configured dp replicas",
+                fn=lambda: len(self.engines))
+        r.counter("tpu_inf_retries_attempted_total",
+                  "Failover resubmissions attempted",
+                  fn=lambda: self.retries_attempted)
+        r.counter("tpu_inf_retries_succeeded_total",
+                  "Failover resubmissions that finished cleanly",
+                  fn=lambda: self.retries_succeeded)
+        r.counter("tpu_inf_failovers_total",
+                  "Requests stranded by a wedged replica and resubmitted",
+                  fn=lambda: self.failovers)
+        r.counter("tpu_inf_requests_shed_total",
+                  "Requests shed at the admission queue cap (HTTP 429)",
+                  fn=lambda: self.requests_shed)
+        r.counter("tpu_inf_requests_unavailable_total",
+                  "Requests rejected with no routable replica (HTTP 503)",
+                  fn=lambda: self.requests_unavailable)
+        for i, health in enumerate(self.health):
+            r.gauge("tpu_inf_replica_routable",
+                    "1 when the replica accepts traffic (not quarantined)",
+                    fn=lambda h=health: float(h.routable),
+                    replica=str(i))
+            r.counter("tpu_inf_replica_quarantines_total",
+                      "Entries into the quarantined state",
+                      fn=lambda h=health: h.quarantines, replica=str(i))
+            r.counter("tpu_inf_replica_wedges_total",
+                      "Step-watchdog firings (wedged dispatches)",
+                      fn=lambda h=health: h.wedges, replica=str(i))
 
     @property
     def engine(self) -> InferenceEngine:
@@ -320,6 +356,9 @@ class EngineGroup:
                   sched: EngineScheduler) -> None:
         gen = entry.generation
         entry.sched = sched
+        # Mark the span: attempt >= 1 means this is a failover
+        # resubmission — the timeline/logs distinguish replays.
+        seq.attempt = entry.attempts
 
         def tok(s: Sequence, t: int) -> None:
             if entry.generation != gen:     # stale attempt (failed over)
@@ -401,6 +440,10 @@ class EngineGroup:
                 actions.append((rid, entry, can_retry, target))
         for rid, entry, can_retry, target in actions:
             sched.cancel(rid)               # reap-on-wake; frees queue slot
+            telemetry.log_event(
+                "request_failover", level="warning",
+                request_id=entry.template.trace_id or str(rid),
+                resubmitted=can_retry, attempts=entry.attempts)
             if can_retry:
                 self._dispatch(entry, _clone_request(entry.template), target)
             else:
@@ -453,6 +496,15 @@ class EngineGroup:
                 "states": [h.state for h in self.health],
             }
 
+    def prometheus_text(self) -> str:
+        """Standards-compliant Prometheus text page: every replica's
+        engine registry under a ``replica="i"`` label plus the fleet
+        registry (supervision counters, replica health gauges)."""
+        groups = [({"replica": str(i)}, s.engine.telemetry.registry)
+                  for i, s in enumerate(self.schedulers)]
+        groups.append(({}, self._fleet_registry))
+        return telemetry.render_prometheus(groups)
+
     def recent_snapshot(self, n: int) -> List[dict]:
         """Most recent n finished-request timelines ACROSS replicas
         (merged by completion time — a plain tail would show only the
@@ -489,6 +541,15 @@ class EngineGroup:
         # per-replica health lives under "replicas", fleet under
         # "supervision".
         agg.pop("health", None)
+        # Fleet phase histograms = element-wise bucket merge across
+        # replicas (replica 0's copy would otherwise masquerade as the
+        # fleet's); per-replica views stay under "replicas".
+        phase_keys = sorted(set().union(
+            *(d.get("phases", {}).keys() for d in per)))
+        agg["phases"] = {
+            k: telemetry.merge_phases(
+                [d.get("phases", {}).get(k) for d in per])
+            for k in phase_keys}
         agg["mean_batch_occupancy"] = (
             sum(d["mean_batch_occupancy"] for d in per) / len(per))
         if "prefix_cache" in per[0]:

@@ -94,11 +94,16 @@ class TrafficGenerator:
         # (num_retries) instead of raw failures. Budget exhaustion is
         # recorded as a shed query, still not an exception.
         max_retries = int(self.config.get("max_retries", 4))
+        # End-to-end tracing: a client-minted X-Request-Id joins this
+        # harness's per-query metrics to the server's structured logs
+        # and /debug/requests spans (the server echoes it back).
+        trace_id = f"tg-{query_id}"
         try:
             for attempt in range(max_retries + 1):
                 async with session.post(
                         self.config["url"],
                         json=self._payload(prompt, len_output),
+                        headers={"X-Request-Id": trace_id},
                         trace_request_ctx={"query_id": query_id,
                                            "collector": collector}) as resp:
                     if resp.status in (429, 503):
@@ -114,6 +119,9 @@ class TrafficGenerator:
                         await asyncio.sleep(delay)
                         continue
                     resp.raise_for_status()
+                    collector.record(query_id, "request_id",
+                                     resp.headers.get("X-Request-Id",
+                                                      trace_id))
                     await self._consume_stream(resp, query_id)
                     return
         except (aiohttp.ClientError, asyncio.TimeoutError) as exc:

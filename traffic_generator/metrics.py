@@ -53,6 +53,10 @@ class MetricCollector:
             "response_end_time": None,
             "num_output_tokens": None,
             "max_interchunk_gap": None,
+            # Trace id shared with the server (X-Request-Id): joins this
+            # record to server-side spans/logs. Additive field; the
+            # reference schema is otherwise preserved.
+            "request_id": None,
             "scheduled_start_time": scheduled_start,
             "num_retries": 0,
             "shed": False,
