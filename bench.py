@@ -251,6 +251,91 @@ def lane_child(spec: str) -> None:
     gc.collect()
 
 
+def admission_lane_child() -> None:
+    """reserve-vs-optimistic admission comparison through the REAL
+    continuous-batching scheduler: the same burst of requests whose
+    clients declare a generous token budget (num_predict) but whose
+    generations stop far short of it — the BurstGPT shape that strands
+    worst-case reservations. Reports occupancy / tok/s / preemption
+    counters per mode; prints ONE JSON record."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tpu_inference.config import EngineConfig
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    platform = jax.devices()[0].platform
+    cfg = bench_cfg(platform)
+    page_size = 16
+    prompt_len = 64
+    cap = 192                      # client-declared budget per request
+    true_lens = [16, 24, 32, 48]   # actual generation lengths (cycled)
+    n_requests = 24
+    batch = 8
+    pages_cap = -(-(prompt_len + cap) // page_size)
+    # Pool holds ~3 worst-case reservations: reserve admission caps the
+    # batch there; optimistic packs toward all 8 slots and preempts.
+    pool = pages_cap * 3 + 1
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+    out = {"lane": "admission", "model": cfg.name, "platform": platform,
+           "cap_tokens": cap, "true_lens": true_lens, "pool_pages": pool}
+    for mode in ("reserve", "optimistic"):
+        ecfg = EngineConfig(page_size=page_size, num_pages=pool,
+                            max_pages_per_seq=pages_cap + 1,
+                            max_batch_size=batch, prefill_buckets=(128,),
+                            decode_steps_per_call=8, admission=mode)
+        engine = InferenceEngine(cfg, ecfg)
+        engine.warmup()
+        sched = EngineScheduler(engine).start()
+        done, events = [], []
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            ev = threading.Event()
+            events.append(ev)
+            true_len = true_lens[i % len(true_lens)]
+
+            def on_token(s, t, true_len=true_len):
+                # Cancel at the trace's ACTUAL length — the EOS a
+                # random-weight model can't emit — so the declared cap
+                # stays a stranded reservation, as in real traffic.
+                if len(s.generated) >= true_len:
+                    sched.cancel(s.request_id)
+
+            sched.submit(Sequence(request_id=i, prompt_tokens=list(p),
+                                  max_new_tokens=cap),
+                         on_token,
+                         lambda s, ev=ev: (done.append(s), ev.set()))
+        for ev in events:
+            if not ev.wait(240):
+                raise TimeoutError(f"admission lane deadlocked ({mode})")
+        wall = time.perf_counter() - t0
+        sched.stop(drain=True, timeout=10)
+        toks = sum(len(s.generated) for s in done)
+        snap = sched.stats.snapshot(engine)
+        out[mode] = {
+            "wall_s": _r(wall, 3),
+            "tok_s": _r(toks / wall),
+            "mean_batch_occupancy": _r(snap["mean_batch_occupancy"], 3),
+            "peak_pages_in_use": snap["peak_pages_in_use"],
+            "preemptions": engine.preemptions_total,
+            "recompute_resumes": engine.resumes_total,
+            "requests_rejected": snap["requests_rejected"],
+        }
+        del engine, sched
+        gc.collect()
+    out["occupancy_gain"] = _r(
+        out["optimistic"]["mean_batch_occupancy"]
+        - out["reserve"]["mean_batch_occupancy"], 3)
+    out["tok_s_gain"] = _ratio(out["optimistic"]["tok_s"],
+                               out["reserve"]["tok_s"])
+    print(json.dumps(out), flush=True)
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestrator (never imports jax — cannot hang on the tunnel).
 # ---------------------------------------------------------------------------
@@ -479,6 +564,11 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         # vs KV vs dispatch vs bubbles".
         "phase_breakdown": (win.get("phases") if any_lane and best
                             else None),
+        # reserve-vs-optimistic admission comparison (occupancy / tok/s
+        # / preemptions) when the lane ran.
+        "admission_comparison": (
+            lanes["admission"] if lanes.get("admission", {}).get("reserve")
+            else None),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
         "backends_token_equal": heads_equal,
@@ -565,12 +655,27 @@ def orchestrate() -> None:
             rec = {"lane": spec, "skipped": f"lane-failed rc={rc}"}
         lanes[spec] = rec
         _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
+    # Admission-mode comparison lane (reserve vs optimistic through the
+    # scheduler): measurement-only extra — it never sets ``value`` and a
+    # failure/skip costs nothing but its own field.
+    if give_up:
+        lanes["admission"] = {"lane": "admission",
+                              "skipped": "tpu-wedged-midrun"}
+    elif budget_left() < lane_timeout:
+        lanes["admission"] = {"lane": "admission",
+                              "skipped": "budget-exhausted"}
+    else:
+        rc, rec = _run_child(["--admission-lane"], lane_timeout, env)
+        lanes["admission"] = rec or {"lane": "admission",
+                                     "skipped": f"lane-failed rc={rc}"}
     _snapshot(probe, lanes, degraded, partial=False, t_start=t_start)
 
 
 if __name__ == "__main__":
     if "--probe" in sys.argv:
         probe_child()
+    elif "--admission-lane" in sys.argv:
+        admission_lane_child()
     elif "--lane" in sys.argv:
         lane_child(sys.argv[sys.argv.index("--lane") + 1])
     else:

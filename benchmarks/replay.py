@@ -85,6 +85,11 @@ def phase_breakdown(before: dict, after: dict) -> dict:
 def summarize(metrics: dict, n_chips: int = 1) -> dict:
     """Reduce the harness's per-request dicts to the headline numbers."""
     ok = {k: m for k, m in metrics.items() if m.get("success")}
+    # Client-side resilience accounting: 429/503 attempts retried with
+    # backoff, and queries given up after the retry budget (shed) — the
+    # shed RATE is the number the admission-mode comparison lane reads.
+    retries = sum(m.get("num_retries") or 0 for m in metrics.values())
+    shed = sum(1 for m in metrics.values() if m.get("shed"))
     ttft, tpot, e2e, gaps, tokens = [], [], [], [], 0
     t_first, t_last = float("inf"), 0.0
     for m in ok.values():
@@ -109,6 +114,9 @@ def summarize(metrics: dict, n_chips: int = 1) -> dict:
     return {
         "requests": len(metrics),
         "succeeded": len(ok),
+        "client_retries": retries,
+        "shed": shed,
+        "shed_rate": round(shed / max(len(metrics), 1), 4),
         "output_tokens": tokens,
         "wall_s": round(wall, 3),
         "tokens_per_s": round(tokens / wall, 2),
@@ -149,6 +157,9 @@ def start_server(args) -> tuple:
         quant=getattr(args, "quant", "none"),
         kv_quant=getattr(args, "kv_quant", "none"),
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
+        admission=getattr(args, "admission", "reserve"),
+        server_overrides={"admission_queue_depth":
+                          getattr(args, "admission_queue_depth", 0)},
         num_speculative_tokens=(args.num_speculative_tokens
                                 if args.draft_model else 0),
         # Smoke lane: small prefill buckets so the CPU tier-1 run
@@ -228,6 +239,20 @@ def main() -> dict:
                    choices=("auto", "cpu", "tpu"),
                    help="jax platform; 'cpu' forces the CPU backend "
                         "(tp*sp virtual devices) before any computation")
+    p.add_argument("--admission", default="reserve",
+                   choices=("reserve", "optimistic"),
+                   help="KV admission mode: worst-case reservation vs "
+                        "optimistic admission with watermark preemption "
+                        "+ recompute-resume")
+    p.add_argument("--admission-queue-depth", type=int, default=0,
+                   help="server-side 429 shed cap (0 = queue unbounded)")
+    p.add_argument("--client-max-retries", type=int, default=4,
+                   help="traffic-generator 429/503 retry budget per "
+                        "query; give-ups are recorded as shed")
+    p.add_argument("--compare-admission", action="store_true",
+                   help="run the trace twice — admission=reserve then "
+                        "optimistic — and commit an occupancy / "
+                        "throughput / shed-rate comparison artifact")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -246,6 +271,14 @@ def main() -> dict:
         args.max_batch_size, args.num_pages = 4, 128
         args.page_size, args.max_pages_per_seq = 8, 8
         args.decode_steps_per_call = 4
+        if args.compare_admission:
+            # The comparison needs a pool TIGHT enough that worst-case
+            # reservation actually binds: generations budgeted well past
+            # their prompts, a pool that holds ~2 worst cases, and a
+            # burst arrival so requests overlap. Optimistic admission
+            # packs more lanes and preempts under pressure — the
+            # occupancy delta is the artifact's point.
+            args.num_pages, args.max_pages_per_seq = 20, 12
         if args.out is None:
             args.out = "benchmarks/results/replay_smoke.json"
 
@@ -270,6 +303,18 @@ def main() -> dict:
 
     args.max_batch_size, args.num_pages = resolve_sizing_args(args)
 
+    if args.compare_admission:
+        return _compare_admission(args)
+
+    summary = run_replay(args)
+    out = {"config": vars(args), "summary": summary}
+    print(json.dumps(summary, indent=1))
+    _write_out(args.out, out)
+    return summary
+
+
+def run_replay(args) -> dict:
+    """Boot one server, replay the trace, scrape, summarize."""
     from traffic_generator.data import DataLoader
     from traffic_generator.generator import TrafficGenerator
     from traffic_generator.metrics import MetricCollector
@@ -280,14 +325,24 @@ def main() -> dict:
         data = DataLoader.get_data_from_path(args.data)
         schedule = Scheduler.get_schedule_from_trace(args.trace,
                                                      args.max_trace)
+        if args.compare_admission:
+            # Burst arrival: all requests land at t=0 so both admission
+            # modes face the same overlapping demand (trace gaps on a
+            # fast CPU model would serialize the run and hide the
+            # occupancy difference being measured).
+            schedule["Timestamp"] = 0.0
         collector = MetricCollector()
-        gen_kw = ({"max_prompt_len": 48, "max_gen_len": 12}
-                  if args.smoke else {})
+        gen_kw = {}
+        if args.smoke:
+            gen_kw = ({"max_prompt_len": 24, "max_gen_len": 48}
+                      if args.compare_admission else
+                      {"max_prompt_len": 48, "max_gen_len": 12})
         gen = TrafficGenerator(
             data, schedule,
             {"url": f"http://127.0.0.1:{port}/api/generate",
              "model": args.model, "temperature": args.temperature,
-             "max_tokens": None, "stream": True},
+             "max_tokens": None, "stream": True,
+             "max_retries": args.client_max_retries},
             collector, **gen_kw)
         # Pre-run scrape over real HTTP: phase_breakdown diffs the
         # histograms so only THIS run's window is attributed.
@@ -302,6 +357,18 @@ def main() -> dict:
         summary = summarize(metrics, n_chips=args.tp * args.sp)
         summary["replay_s"] = round(replay_s, 3)
         summary["server_stats"] = after
+        # Admission-mode lane: the occupancy / preemption / shed numbers
+        # the reserve-vs-optimistic artifact compares.
+        summary["admission"] = {
+            "mode": after.get("admission"),
+            "mean_batch_occupancy": after.get("mean_batch_occupancy"),
+            "preemptions": after.get("preemptions"),
+            "recompute_resumes": after.get("recompute_resumes"),
+            "requests_rejected": after.get("requests_rejected"),
+            "peak_pages_in_use": after.get("peak_pages_in_use"),
+            "pool_pressure": after.get("pool_pressure"),
+            "shed_rate": summary["shed_rate"],
+        }
         summary["phase_breakdown"] = phase_breakdown(before, after)
         summary["prometheus_scrape"] = {
             "content_type": prom_ctype,
@@ -311,14 +378,55 @@ def main() -> dict:
         }
     finally:
         stop()
-
-    out = {"config": vars(args), "summary": summary}
-    print(json.dumps(summary, indent=1))
-    if args.out:
-        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
     return summary
+
+
+def _compare_admission(args) -> dict:
+    """Run the trace under admission=reserve then admission=optimistic
+    (fresh server each) and commit the side-by-side artifact: batch
+    occupancy, tokens/s, shed rate, preemption counts."""
+    summaries = {}
+    for mode in ("reserve", "optimistic"):
+        args.admission = mode
+        print(f"[replay] admission={mode} lane", file=sys.stderr)
+        summaries[mode] = run_replay(args)
+    res, opt = summaries["reserve"], summaries["optimistic"]
+
+    def _occ(s):
+        return s["admission"]["mean_batch_occupancy"] or 0.0
+
+    comparison = {
+        "occupancy_reserve": round(_occ(res), 4),
+        "occupancy_optimistic": round(_occ(opt), 4),
+        "occupancy_gain": round(_occ(opt) - _occ(res), 4),
+        "tokens_per_s_reserve": res["tokens_per_s"],
+        "tokens_per_s_optimistic": opt["tokens_per_s"],
+        "shed_rate_reserve": res["shed_rate"],
+        "shed_rate_optimistic": opt["shed_rate"],
+        "preemptions": opt["admission"]["preemptions"],
+        "recompute_resumes": opt["admission"]["recompute_resumes"],
+        # The artifact's claim: optimistic admission packs more of the
+        # batch (or matches throughput with a lower shed rate).
+        "optimistic_wins": bool(
+            _occ(opt) > _occ(res)
+            or (opt["tokens_per_s"] >= res["tokens_per_s"]
+                and opt["shed_rate"] <= res["shed_rate"])),
+    }
+    out = {"config": vars(args), "reserve": res, "optimistic": opt,
+           "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result["reserve"], result["optimistic"] = res, opt
+    return result
+
+
+def _write_out(path, record) -> None:
+    if not path:
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
 
 
 if __name__ == "__main__":

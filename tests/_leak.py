@@ -1,0 +1,35 @@
+"""Page-leak invariant checker (test helper).
+
+After every request mix — clean finishes, cancels, chaos step failures,
+preemptions, recompute-resumes — the KV pool must return to its
+fully-free state once the prefix cache releases its references and any
+chaos page pressure is disarmed. A page that doesn't come back is a
+leak: under production load the pool ratchets down until the server
+sheds everything.
+"""
+
+from __future__ import annotations
+
+
+def assert_pool_clean(engine) -> None:
+    """Assert the allocator is fully reclaimable: disarm chaos page
+    pressure, drop the prefix cache's references, then require every
+    page free with zero refcounts (page 0, the trash page, excepted)."""
+    assert not engine.pipeline_pending, \
+        "dispatch-ahead calls still in flight; drain before checking"
+    assert not engine._preempted_out, \
+        "preempted sequences never collected (take_preempted)"
+    engine.set_page_pressure(0)
+    if engine.prefix_cache is not None:
+        engine.prefix_cache.clear()
+    alloc = engine.allocator
+    expected = alloc.num_pages - 1          # page 0 = trash page
+    leaked = [p for p in range(1, alloc.num_pages) if alloc._refs[p] > 0]
+    assert alloc.num_free == expected, (
+        f"KV page leak: {expected - alloc.num_free} pages never freed "
+        f"(refs held on pages {leaked[:16]})")
+    assert not leaked, f"pages with stale refcounts: {leaked[:16]}"
+    assert alloc.evictable_count == 0, (
+        f"evictable counter drifted: {alloc.evictable_count} after clear")
+    bound = [i for i, s in enumerate(engine.slots) if s is not None]
+    assert not bound, f"decode slots still bound after drain: {bound}"

@@ -169,6 +169,15 @@ def test_step_failure_quarantines_and_fails_over():
         assert stats["replicas"][1]["health"]["state"] == QUARANTINED
 
         busy_done.wait(30)
+        # Page-leak invariant: after every request terminates (finish,
+        # chaos failure, failover resubmission) both pools must return
+        # to fully free. Stop first so no engine thread is mid-reap
+        # while the allocator is inspected.
+        group.stop(drain=True, timeout=10.0)
+        from tests._leak import assert_pool_clean
+        for sched in group.schedulers:
+            sched.engine.drain_pipeline()
+            assert_pool_clean(sched.engine)
     finally:
         group.stop(drain=False, timeout=5.0)
 
@@ -268,6 +277,9 @@ def test_admission_queue_cap_sheds_with_retry_after():
         assert stats["supervision"]["requests_shed"] >= 1
 
     _run(srv, scenario)
+    # Finished + shed mix left no page behind.
+    from tests._leak import assert_pool_clean
+    assert_pool_clean(srv.engine)
 
 
 def test_wedged_fleet_returns_503_and_healthz_degrades():
@@ -327,8 +339,31 @@ def test_debug_chaos_endpoint_arms_engine_faults():
         assert resp.status == 200
         body = await resp.json()
         assert body["replicas"][0] == {"step_failure_rate": 0.5,
-                                       "step_wedge_s": 0.1}
+                                       "step_wedge_s": 0.1,
+                                       "page_pressure": 0}
         assert srv.engine.chaos_step_failure_rate == 0.5
+
+        # Page-pressure chaos: holds real pages out of the pool. The
+        # mutation applies on the engine thread (the HTTP thread only
+        # stores the target), so poll briefly for it to land.
+        async def wait_free(expect):
+            for _ in range(200):
+                if srv.engine.allocator.num_free == expect:
+                    return
+                await asyncio.sleep(0.01)
+            raise AssertionError(
+                f"page pressure never applied: free="
+                f"{srv.engine.allocator.num_free}, want {expect}")
+
+        free_before = srv.engine.allocator.num_free
+        resp = await client.post("/debug/chaos", json={
+            "replica": 0, "page_pressure": 5})
+        assert (await resp.json())["replicas"][0]["page_pressure"] == 5
+        await wait_free(free_before - 5)
+        resp = await client.post("/debug/chaos", json={
+            "replica": 0, "page_pressure": 0})
+        assert (await resp.json())["replicas"][0]["page_pressure"] == 0
+        await wait_free(free_before)
 
         resp = await client.post("/debug/chaos", json={
             "replica": None, "step_failure_rate": 0.0, "step_wedge_s": 0.0})

@@ -374,6 +374,34 @@ class EngineConfig:
     # Prefix caching: finished sequences publish their full KV pages for
     # reuse by later requests sharing the prefix (multi-turn chats).
     enable_prefix_cache: bool = True
+    # --- Admission control (README "Admission & preemption") ---
+    # "reserve": a request is admitted only when the pool can hold its
+    # prompt plus its FULL max_new_tokens budget — OOM-free by
+    # construction, but BurstGPT-style traffic (generations finishing
+    # far short of their budget) strands a large fraction of the pool
+    # and sheds load while pages are actually free.
+    # "optimistic": admit against the prompt footprint plus a small
+    # decode headroom; KV exhaustion is handled by preempting the most
+    # recently admitted sequence(s) and recompute-resuming them
+    # (re-prefill over prompt+generated, token-identical under greedy
+    # decoding) instead of rejecting or failing.
+    admission: str = "reserve"
+    # Optimistic mode: decode-headroom pages charged per request at
+    # admission on top of its prompt pages.
+    optimistic_headroom_pages: int = 2
+    # Low watermark on free+evictable pages: when a decode grant comes
+    # up short AND the pool is below this, the engine preempts victims
+    # (most recently admitted first) instead of degrading to a stall.
+    preempt_watermark_pages: int = 4
+    # Starvation guard: after this many preemptions a request is
+    # re-admitted under the full worst-case reservation (and is never
+    # chosen as a victim again), so it provably finishes.
+    preempt_max_per_request: int = 3
+    # Fault injection: hold this many real pages out of the pool at
+    # engine boot (runtime-adjustable via engine.set_page_pressure /
+    # POST /debug/chaos {"page_pressure": n}) so pool-exhaustion paths
+    # run deterministically on CPU. Off in production.
+    chaos_page_pressure: int = 0
     # Engine-level fault injection (the in-process counterpart of
     # ServerConfig.chaos_*): every prefill/decode dispatch raises with
     # this probability, exercising the scheduler error paths and the

@@ -245,12 +245,26 @@ class Sequence:
     # freed, and zeroed (engine._evict_behind_window).
     evicted_pages: int = 0
     cached_tokens: int = 0                 # prefix-cache hit length
+    # Preemption / recompute-resume state (admission="optimistic"):
+    # preemptions counts evictions so far (the starvation guard compares
+    # it against preempt_max_per_request); resume_base is the number of
+    # generated tokens present at the last (re)prefill, so the resume
+    # prefill computes prompt + generated[:resume_base] and decode
+    # continues from there. admit_idx orders running sequences by
+    # admission recency (victim selection preempts the newest first).
+    preemptions: int = 0
+    resume_base: int = 0
+    admit_idx: int = -1
     # Incremental multi-chunk prefill state (prefill_begin/prefill_step).
     prefill_prompt: Optional[List[int]] = None
     prefill_offset: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     finish_reason: str = ""
+    # Set (under the scheduler lock) by EngineScheduler._finish so the
+    # terminal path runs exactly once even when the shutdown force-
+    # finish races a slow engine thread's own reap.
+    reaped: bool = False
     # Timing (server metrics; SURVEY.md §5 observability).
     enqueue_time: float = 0.0
     prefill_start: float = 0.0
@@ -365,6 +379,31 @@ class InferenceEngine:
         # the /debug/chaos endpoint can arm/disarm per replica at runtime.
         self.chaos_step_failure_rate = engine_cfg.chaos_step_failure_rate
         self.chaos_step_wedge_s = engine_cfg.chaos_step_wedge_s
+        # Admission mode (README "Admission & preemption"): "reserve"
+        # charges worst case at admission; "optimistic" charges prompt +
+        # headroom and relies on watermark-driven preemption +
+        # recompute-resume as the exhaustion safety net.
+        if engine_cfg.admission not in ("reserve", "optimistic"):
+            raise ValueError(f"unknown admission mode "
+                             f"{engine_cfg.admission!r}; "
+                             "one of ('reserve', 'optimistic')")
+        self.admission = engine_cfg.admission
+        self.preemptions_total = 0        # sequences evicted for pressure
+        self.resumes_total = 0            # recompute-resume prefills
+        self._admit_counter = 0           # admission recency for victims
+        # Sequences preempted since the caller last collected them; the
+        # scheduler requeues these at the head of its wait queue.
+        self._preempted_out: List[Sequence] = []
+        # chaos_page_pressure holds REAL pages out of the pool so the
+        # exhaustion/preemption paths run deterministically on CPU.
+        self._pressure_pages: List[int] = []
+        self.chaos_page_pressure = 0
+        # Cross-thread arm/disarm requests (the /debug/chaos handler
+        # runs on an aiohttp thread; the allocator is engine-thread
+        # only): a plain GIL-atomic store, applied by the engine loop.
+        self._pressure_target: Optional[int] = None
+        if engine_cfg.chaos_page_pressure > 0:
+            self.set_page_pressure(engine_cfg.chaos_page_pressure)
         spec_on = (draft_cfg is not None
                    and engine_cfg.num_speculative_tokens > 0)
         self.prefix_cache = None
@@ -846,6 +885,14 @@ class InferenceEngine:
     def free_slots(self) -> List[int]:
         return [i for i, s in enumerate(self.slots) if s is None]
 
+    def _prefill_tokens(self, seq: Sequence) -> List[int]:
+        """Token stream the next (re)prefill must put into KV: the
+        original prompt, plus — on a recompute-resume — every token
+        generated before the preemption."""
+        if seq.resume_base:
+            return seq.prompt_tokens + seq.generated[:seq.resume_base]
+        return seq.prompt_tokens
+
     def _pages_reserved(self, seq: Sequence) -> int:
         """Worst-case page need for admission control (capped at the
         per-sequence maximum, since ctx is clamped to max_context).
@@ -857,7 +904,8 @@ class InferenceEngine:
         window/page misalignment) — long-generation requests must not
         be queued for capacity they will never hold."""
         ecfg = self.engine_cfg
-        total = len(seq.prompt_tokens) + seq.max_new_tokens
+        base = self._prefill_tokens(seq)
+        total = len(base) + seq.max_new_tokens - seq.resume_base
         need = kvc.pages_needed(total, ecfg.page_size)
         if self.swa_evict:
             # Dispatch-ahead can grant depth*K tokens of head pages
@@ -873,17 +921,80 @@ class InferenceEngine:
             # the dispatch-ahead burst (ADVICE r4: charging only the
             # prefill peak degrades to a decode stall under a
             # fully-committed pool).
-            peak_tokens = min(len(seq.prompt_tokens), ecfg.max_context)
+            peak_tokens = min(len(base), ecfg.max_context)
             transient = kvc.pages_needed(
                 min(peak_tokens + ahead, ecfg.max_context), ecfg.page_size)
             need = min(need, max(window_span, transient))
         return min(need, self.max_pages)
+
+    def _pages_for_admission(self, seq: Sequence) -> int:
+        """Pages a request is charged at admission. "reserve" mode —
+        and the starvation guard's re-admission after
+        preempt_max_per_request preemptions — charge the full worst
+        case; "optimistic" charges the prompt footprint plus a small
+        decode headroom, with watermark preemption as the safety net."""
+        full = self._pages_reserved(seq)
+        if (self.admission != "optimistic"
+                or seq.preemptions >= self.engine_cfg.preempt_max_per_request):
+            return full
+        ecfg = self.engine_cfg
+        prompt_pages = kvc.pages_needed(
+            min(len(self._prefill_tokens(seq)), ecfg.max_context),
+            ecfg.page_size)
+        need = max(1, prompt_pages + ecfg.optimistic_headroom_pages)
+        return min(full, need, self.max_pages)
 
     def _free_plus_evictable(self) -> int:
         n = self.allocator.num_free
         if self.prefix_cache is not None:
             n += self.prefix_cache.evictable
         return n
+
+    @property
+    def pool_pressure(self) -> float:
+        """1 - (free+evictable)/total: 0 = fully reclaimable, 1 = every
+        page pinned by a running sequence (or chaos pressure)."""
+        total = self.engine_cfg.num_pages - 1
+        return 1.0 - self._free_plus_evictable() / max(total, 1)
+
+    @property
+    def under_pressure(self) -> bool:
+        """Below the preemption low watermark — the router prefers
+        replicas where this is False."""
+        return (self._free_plus_evictable()
+                < self.engine_cfg.preempt_watermark_pages)
+
+    def set_page_pressure(self, n_pages: int) -> int:
+        """Arm/disarm chaos_page_pressure: hold ``n_pages`` real pages
+        out of the pool (clamped to what is currently free) so the
+        exhaustion/preemption paths run deterministically on CPU.
+        Returns the number of pages actually held.
+
+        Mutates the allocator — call only from the engine thread (or
+        while no scheduler is running); other threads use
+        request_page_pressure and the engine loop applies it."""
+        self.allocator.free(self._pressure_pages)
+        self._pressure_pages = []
+        n = max(0, min(int(n_pages), self.allocator.num_free))
+        if n > 0:
+            self._pressure_pages = self.allocator.allocate(n)
+        self.chaos_page_pressure = len(self._pressure_pages)
+        return self.chaos_page_pressure
+
+    def request_page_pressure(self, n_pages: int) -> int:
+        """Thread-safe arm/disarm request: stores the target (atomic
+        int store); the scheduler loop applies it on the engine thread
+        within one iteration. Returns the requested target."""
+        n = max(0, int(n_pages))
+        self._pressure_target = n
+        return n
+
+    def apply_pending_page_pressure(self) -> None:
+        """Apply a cross-thread pressure request (engine thread only)."""
+        target = self._pressure_target
+        if target is not None:
+            self._pressure_target = None
+            self.set_page_pressure(target)
 
     def _allocate_reclaiming(self, n: int) -> List[int]:
         """Allocate n pages, evicting LRU prefix-cache pages on pressure —
@@ -944,7 +1055,7 @@ class InferenceEngine:
 
     def can_admit(self, seq: Sequence) -> bool:
         return bool(self.free_slots()) and (
-            self._free_plus_evictable() >= self._pages_reserved(seq))
+            self._free_plus_evictable() >= self._pages_for_admission(seq))
 
     def can_ever_admit(self, seq: Sequence) -> bool:
         """False if the request exceeds the pool even when fully idle."""
@@ -959,9 +1070,15 @@ class InferenceEngine:
         """Allocate pages (with prefix-cache reuse), bind the slot, and
         return the (possibly truncated) prompt to prefill."""
         ecfg = self.engine_cfg
-        # Keep the most recent tokens of over-long prompts (leave room for
-        # at least one generated token).
-        prompt = seq.prompt_tokens[-(ecfg.max_context - 1):]
+        # Keep the most recent tokens of over-long prompts (leave room
+        # for at least one generated token). On a recompute-resume the
+        # "prompt" is the original prompt plus everything generated
+        # before the preemption.
+        prompt = self._prefill_tokens(seq)[-(ecfg.max_context - 1):]
+        seq.admit_idx = self._admit_counter
+        self._admit_counter += 1
+        if seq.resume_base:
+            self.resumes_total += 1
         # Prefix-cache hit: reuse full pages of an identical prior prefix
         # and skip their prefill compute. Always recompute at least the
         # final prompt token — its logits seed the first sampled token.
@@ -984,7 +1101,10 @@ class InferenceEngine:
         """Common post-prefill bookkeeping for one sequence."""
         seq.ctx_len = len(prompt)
         seq.generated.append(first)
-        seq.first_token_time = time.perf_counter()
+        if seq.first_token_time == 0.0:
+            # Resume prefills keep the ORIGINAL first-token time: the
+            # client already received earlier tokens.
+            seq.first_token_time = time.perf_counter()
         self.slots[seq.slot] = seq
         self._maybe_finish(seq, first)
 
@@ -1230,20 +1350,120 @@ class InferenceEngine:
             j += 1
         seq.evicted_pages = j
 
+    def _publish_to_cache(self, seq: Sequence) -> None:
+        """Publish a sequence's full pages (prompt + generated history)
+        to the prefix cache, so a follow-up turn resending the
+        conversation — or a preempted sequence's recompute-resume —
+        reuses them instead of re-prefilling."""
+        if self.prefix_cache is None or not seq.pages:
+            return
+        # Same truncation the prefill used, so tokens align with pages.
+        base = self._prefill_tokens(seq)[-(self.engine_cfg.max_context - 1):]
+        in_kv = base + seq.generated[seq.resume_base:-1]
+        self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages)
+
     def release(self, seq: Sequence) -> None:
         """Free a finished sequence's pages and slot, publishing its full
-        pages (prompt + generated history) to the prefix cache first so a
-        follow-up turn resending the conversation reuses them."""
-        if self.prefix_cache is not None and seq.pages:
-            # Same truncation prefill used, so tokens align with pages.
-            prompt = seq.prompt_tokens[-(self.engine_cfg.max_context - 1):]
-            in_kv = prompt + seq.generated[:-1]
-            self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages)
+        pages to the prefix cache first."""
+        self._publish_to_cache(seq)
         self.allocator.free(seq.pages)
         seq.pages = []
         seq.prefill_prompt = None          # cancel/error mid-prefill
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
+
+    # ------------------------------------------------------------------
+    # Preemption + recompute-resume (admission="optimistic")
+    # ------------------------------------------------------------------
+
+    def preempt(self, seq: Sequence) -> None:
+        """Evict a running sequence under pool pressure: release its
+        slot and pages but KEEP host-side prompt + generated tokens, so
+        a later re-admission recompute-resumes it (re-prefill over
+        prompt + generated; token-identical under greedy decoding).
+
+        Pages are published to the prefix cache first — the resume
+        re-prefill reuses whatever pressure hasn't evicted by then,
+        while the cached copies stay reclaimable capacity."""
+        assert all(seq.slot not in call["allowed"]
+                   for call in self._inflight), \
+            "preempt of a sequence with dispatch-ahead calls in flight"
+        self._publish_to_cache(seq)
+        self.allocator.free(seq.pages)
+        seq.pages = []
+        if seq.slot >= 0 and self.slots[seq.slot] is seq:
+            self.slots[seq.slot] = None
+        seq.slot = -1
+        seq.ctx_len = 0
+        seq.evicted_pages = 0
+        seq.cached_tokens = 0
+        seq.prefill_prompt = None
+        seq.resume_base = len(seq.generated)
+        seq.preemptions += 1
+        self.preemptions_total += 1
+        self._preempted_out.append(seq)
+        telemetry.log_event(
+            "request_preempted", level="info",
+            request_id=seq.trace_id or str(seq.request_id),
+            preemptions=seq.preemptions,
+            generated_tokens=len(seq.generated),
+            free_plus_evictable=self._free_plus_evictable())
+
+    def take_preempted(self) -> List[Sequence]:
+        """Sequences preempted since the last call, in preemption order.
+        The caller requeues them at the HEAD of its wait queue for
+        recompute-resume (FCFS fairness: they were admitted first)."""
+        out, self._preempted_out = self._preempted_out, []
+        return out
+
+    def _preempt_victim(self, cands: List[Sequence]) -> Optional[Sequence]:
+        """Most-recently-admitted candidate still holding preemption
+        budget. Sequences past the starvation guard (re-admitted under
+        full reservation) are exempt, so they provably finish."""
+        limit = self.engine_cfg.preempt_max_per_request
+        eligible = [s for s in cands if s.preemptions < limit]
+        return max(eligible, key=lambda s: s.admit_idx) if eligible else None
+
+    def _preempt_for_pressure(self, active_seqs: List[Sequence],
+                              k_steps: int) -> List[Sequence]:
+        """Optimistic admission's safety net, evaluated before decode
+        grants: when the coming round's page needs cannot all be met AND
+        free+evictable has fallen below the low watermark, preempt the
+        most-recently-admitted sequences until the remainder fits (or no
+        eligible victim is left). Returns the surviving active list."""
+        if self.admission != "optimistic":
+            return active_seqs
+        ecfg = self.engine_cfg
+        active = list(active_seqs)
+        while len(active) > 1:
+            need = sum(
+                kvc.pages_needed(
+                    min(k_steps,
+                        max(0, s.max_new_tokens - len(s.generated)),
+                        max(0, ecfg.max_context - 1 - s.ctx_len)),
+                    ecfg.page_size, already=s.ctx_len)
+                for s in active)
+            avail = self._free_plus_evictable()
+            if need <= avail or avail >= ecfg.preempt_watermark_pages:
+                break
+            victim = self._preempt_victim(active)
+            if victim is None:
+                break
+            self.preempt(victim)
+            active.remove(victim)
+        return active
+
+    def _starved(self, seq: Sequence) -> None:
+        """A lane with zero page slack and zero grantable pages: under
+        optimistic admission (budget allowing) it is preempted and
+        requeued for recompute-resume; otherwise it fails with "oom"
+        (reserve-mode admission makes that path exceptional)."""
+        if (self.admission == "optimistic"
+                and seq.preemptions < self.engine_cfg.preempt_max_per_request):
+            self.preempt(seq)
+            return
+        seq.done, seq.finish_reason = True, "oom"
+        seq.finish_time = time.perf_counter()
 
     def active_sequences(self) -> List[Sequence]:
         """Sequences decode may advance: bound, not finished, and not
@@ -1356,17 +1576,21 @@ class InferenceEngine:
         if not active_seqs:
             return {}
 
+        # Watermark check first: under optimistic admission, pressure
+        # preempts the most-recently-admitted lanes BEFORE any grants,
+        # so the surviving lanes advance at full k_steps.
+        active_seqs = self._preempt_for_pressure(active_seqs, k_steps)
         allowed_by_slot: Dict[int, int] = {}
         for seq in active_seqs:
             steps = self._grant_decode_steps(seq, k_steps)
             if steps <= 0:
-                # No budget/room should have finished already; pool
-                # exhaustion with zero slack fails the sequence safely.
-                seq.done, seq.finish_reason = True, "oom"
-                seq.finish_time = time.perf_counter()
+                # No budget/room should have finished already; zero pool
+                # slack preempts (optimistic) or fails safely (reserve).
+                self._starved(seq)
                 continue
             allowed_by_slot[seq.slot] = steps
-        active_seqs = [s for s in active_seqs if not s.done]
+        active_seqs = [s for s in active_seqs
+                       if not s.done and s.slot >= 0]
         if not active_seqs:
             return {}
 
@@ -1438,17 +1662,23 @@ class InferenceEngine:
             if steps <= 0:
                 if lag == 0:
                     # Nothing in flight can finish it and the pool has
-                    # zero slack: fail the sequence (decode_steps's oom
-                    # semantics). Budget/room exhaustion can't land here
-                    # — _maybe_finish already marked those done.
-                    seq.done, seq.finish_reason = True, "oom"
-                    seq.finish_time = time.perf_counter()
+                    # zero slack: preempt (optimistic; lag == 0 means no
+                    # in-flight call touches it, so eviction is safe) or
+                    # fail the sequence (decode_steps's oom semantics).
+                    # Budget/room exhaustion can't land here —
+                    # _maybe_finish already marked those done.
+                    self._starved(seq)
                 continue                      # ahead calls may still emit
             allowed_by_slot[seq.slot] = steps
             staged.append(seq)
         if not staged:
             return None
 
+        # A lane _starved() preempted above has no slot anymore — drop
+        # it before staging host arrays (seq.slot == -1 would index the
+        # last batch row).
+        active_seqs = [s for s in active_seqs
+                       if not s.done and s.slot >= 0]
         b = ecfg.max_batch_size
         (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
          rpens, rlasts, windows) = self._stage_batch(active_seqs)
@@ -1535,6 +1765,16 @@ class InferenceEngine:
         depth = self.engine_cfg.decode_pipeline_depth
         if depth <= 1 or self.spec_enabled:
             return self.decode_steps()         # gate runs inside
+        if self.admission == "optimistic" and self.under_pressure:
+            # Settle device state before any preemption decision —
+            # in-flight calls hold predicted-ctx page grants — then run
+            # one synchronous round, which preempts as needed (and runs
+            # the chaos gate itself: gating here too would double the
+            # injected failure rate on this branch).
+            result = self.drain_pipeline()
+            for rid, toks in self.decode_steps().items():
+                result.setdefault(rid, []).extend(toks)
+            return result
         self._chaos_step_gate()
         call = self._stage_decode_call()
         if call is not None:
@@ -1663,6 +1903,7 @@ class InferenceEngine:
         active_seqs = self.active_sequences()
         if not active_seqs:
             return {}
+        active_seqs = self._preempt_for_pressure(active_seqs, s_len)
 
         emit_by_slot: Dict[int, int] = {}
         for seq in active_seqs:
@@ -1686,13 +1927,13 @@ class InferenceEngine:
                                slack + grantable * ecfg.page_size)
                 need = min(need, grantable)
             if emit_cap <= 0:
-                seq.done, seq.finish_reason = True, "oom"
-                seq.finish_time = time.perf_counter()
+                self._starved(seq)
                 continue
             if need > 0:
                 seq.pages.extend(self._allocate_reclaiming(need))
             emit_by_slot[seq.slot] = emit_cap
-        active_seqs = [s for s in active_seqs if not s.done]
+        active_seqs = [s for s in active_seqs
+                       if not s.done and s.slot >= 0]
         if not active_seqs:
             return {}
 
@@ -1774,6 +2015,9 @@ class InferenceEngine:
             while pending and self.free_slots() and self.can_admit(pending[0]):
                 self.prefill(pending.pop(0))
             self.decode_steps()
+            # Optimistic admission may have preempted sequences; requeue
+            # them at the head for recompute-resume.
+            pending[0:0] = self.take_preempted()
             for s in [s for s in self.slots if s is not None and s.done]:
                 results[s.request_id] = s.generated
                 self.release(s)

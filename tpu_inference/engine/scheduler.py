@@ -45,6 +45,7 @@ class SchedulerStats:
     requests_finished: int = 0
     requests_rejected: int = 0
     step_failures: int = 0             # prefill/decode dispatch exceptions
+    preemptions: int = 0               # sequences evicted for pool pressure
     batch_occupancy_sum: float = 0.0
     peak_pages_in_use: int = 0
     # Ring of recent decode-dispatch wall times (seconds): the host-side
@@ -86,6 +87,13 @@ class SchedulerStats:
             "requests_finished": self.requests_finished,
             "requests_rejected": self.requests_rejected,
             "step_failures": self.step_failures,
+            # Admission & preemption (README "Admission & preemption"):
+            # mode, watermark evictions, resume prefills, and how much
+            # of the pool is pinned right now.
+            "admission": engine.admission,
+            "preemptions": engine.preemptions_total,
+            "recompute_resumes": engine.resumes_total,
+            "pool_pressure": round(engine.pool_pressure, 4),
             "mean_batch_occupancy": occ,
             "kv_pages_total": total,
             "kv_pages_in_use": total - engine.allocator.num_free,
@@ -173,6 +181,20 @@ class EngineScheduler:
         if self.on_step_error is not None:
             self.on_step_error(exc)
 
+    @staticmethod
+    def _log_step_error(phase: str, exc: BaseException,
+                        seqs: List[Sequence]) -> None:
+        """One structured, greppable error record per step failure
+        (replaces bare traceback.print_exc): phase, exception, the
+        request ids affected, and a trimmed traceback — all through the
+        TPU_INF_LOG stream so operators can join failures to requests."""
+        import traceback
+        telemetry.log_event(
+            "step_error", level="error", phase=phase, error=repr(exc),
+            request_ids=[s.trace_id or str(s.request_id) for s in seqs],
+            traceback="".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__, limit=8)))
+
     # -------------------------------------------------- submission API
 
     @property
@@ -225,25 +247,69 @@ class EngineScheduler:
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
-        """Graceful shutdown; with drain=True, finish in-flight work first."""
+        """Graceful shutdown; with drain=True, finish in-flight work
+        first. Requests still unfinished at the drain deadline are
+        CANCELLED with ``finish_reason="shutdown"`` — every submitted
+        request gets its terminal callback, so client streams end
+        cleanly instead of hanging until their own timeout."""
         if drain:
             deadline = time.monotonic() + timeout
             while (time.monotonic() < deadline
                    and (self._waiting or self._prefilling is not None
+                        or self._callbacks
                         or self.engine.active_sequences())):
                 time.sleep(0.01)
+            self._cancel_stragglers()
         self._stop.set()
         self._work.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
 
+    def _cancel_stragglers(self) -> None:
+        """Drain deadline passed: terminate whatever is still queued or
+        running with finish_reason="shutdown". Queued requests finish
+        directly; engine-bound ones are marked done for the run loop to
+        reap (callbacks fire on the engine thread as usual), with a
+        short grace period — if the engine thread is wedged and never
+        reaps them, their terminal callbacks fire from here so no
+        client hangs."""
+        with self._lock:
+            waiting = list(self._waiting)
+            self._waiting.clear()
+            running = list(self._callbacks.values())
+            for p in waiting:
+                # Register so _finish finds (and pops) the callback.
+                self._callbacks[p.seq.request_id] = p
+        stragglers = waiting + running
+        if not stragglers:
+            return
+        for p in stragglers:
+            if not p.seq.done:
+                p.seq.done = True
+                p.seq.finish_reason = "shutdown"
+                p.seq.finish_time = time.perf_counter()
+        telemetry.log_event(
+            "shutdown_cancel", level="warning",
+            request_ids=[p.seq.trace_id or str(p.seq.request_id)
+                         for p in stragglers])
+        for p in waiting:
+            self._finish(p.seq)
+        grace = time.monotonic() + 2.0
+        while self._callbacks and time.monotonic() < grace:
+            self._work.set()                 # wake the idle wait
+            time.sleep(0.01)
+        for p in list(self._callbacks.values()):
+            self._finish(p.seq)              # engine thread wedged
+
     def _needs_chunking(self, seq: Sequence) -> bool:
         """True when the prompt spans several prefill chunks (so it goes
         through the incremental path instead of stalling the batch).
-        Conservative: a prefix-cache hit could still shrink it to one."""
+        Conservative: a prefix-cache hit could still shrink it to one.
+        Resume prefills measure prompt + already-generated tokens."""
         ecfg = self.engine.engine_cfg
         cap = ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1]
-        return min(len(seq.prompt_tokens), ecfg.max_context - 1) > cap
+        base = len(self.engine._prefill_tokens(seq))
+        return min(base, ecfg.max_context - 1) > cap
 
     def _prefill_done(self, pending: _Pending) -> None:
         """Post-prefill bookkeeping shared by the batched and incremental
@@ -251,9 +317,15 @@ class EngineScheduler:
         seq = pending.seq
         self.stats.prefills += 1
         self.stats.tokens_generated += 1
-        self.stats.tokens_prefix_cached += seq.cached_tokens
+        if not seq.resume_base:
+            # Resume prefills reuse pages THIS request published at its
+            # own preemption — counting them would inflate the cross-
+            # request prefix-cache hit rate the replay artifact reports.
+            self.stats.tokens_prefix_cached += seq.cached_tokens
         tel = self.engine.telemetry
-        if tel.enabled and seq.enqueue_time:
+        if tel.enabled and seq.enqueue_time and not seq.resume_base:
+            # Resume prefills skip the queue-wait histogram: their
+            # enqueue->prefill gap spans the whole first attempt.
             tel.queue_wait_s.observe(
                 max(0.0, seq.prefill_start - seq.enqueue_time))
         pending.on_token(seq, seq.generated[-1])
@@ -272,8 +344,7 @@ class EngineScheduler:
         try:
             finished = self.engine.prefill_step(seq)
         except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
-            import traceback
-            traceback.print_exc()
+            self._log_step_error("incremental_prefill", exc, [seq])
             self._note_error(exc)
             self._prefilling = None
             seq.done, seq.finish_reason = True, "error"
@@ -310,10 +381,12 @@ class EngineScheduler:
                 if pending.seq.done:          # cancelled while queued
                     self._waiting.popleft()
                     continue
-                # Worst-case page accounting across the whole batch —
+                # Admission page accounting across the whole batch —
                 # allocation happens later inside prefill_many, so each
                 # candidate must fit on top of those already selected.
-                need = self.engine._pages_reserved(pending.seq)
+                # reserve mode charges the worst case; optimistic the
+                # prompt footprint + headroom (engine._pages_for_admission).
+                need = self.engine._pages_for_admission(pending.seq)
                 if self.engine._free_plus_evictable() < reserved + need:
                     break
                 if self._needs_chunking(pending.seq):
@@ -337,8 +410,7 @@ class EngineScheduler:
             try:
                 self.engine.prefill_begin(seq)
             except Exception as exc:  # noqa: BLE001
-                import traceback
-                traceback.print_exc()
+                self._log_step_error("prefill_begin", exc, [seq])
                 self._note_error(exc)
                 seq.done, seq.finish_reason = True, "error"
                 self._finish(seq)
@@ -352,8 +424,8 @@ class EngineScheduler:
         try:
             self.engine.prefill_many([p.seq for p in batch])
         except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
-            import traceback
-            traceback.print_exc()
+            self._log_step_error("batched_prefill", exc,
+                                 [p.seq for p in batch])
             self._note_error(exc)
             # Coarse failure domain: the whole batch errors (admission
             # control makes device OOM here exceptional, not routine).
@@ -368,8 +440,40 @@ class EngineScheduler:
             self._prefill_done(pending)
         return admitted + len(batch)
 
+    def _requeue_preempted(self) -> None:
+        """Move sequences the engine preempted this step back to the
+        HEAD of the wait queue (they were admitted before anything still
+        waiting) for recompute-resume. The pending entry leaves
+        _callbacks while it waits — _admit re-registers it — so ``load``
+        counts the request exactly once and cancel() finds it in
+        _waiting. Runs after _deliver: tokens folded before the
+        preemption must reach the client first."""
+        preempted = self.engine.take_preempted()
+        if not preempted:
+            return
+        self.stats.preemptions += len(preempted)
+        cancelled: List[Sequence] = []
+        with self._lock:
+            for seq in reversed(preempted):
+                pending = self._callbacks.get(seq.request_id)
+                if pending is None:
+                    continue
+                if seq.done:          # cancelled while being preempted
+                    cancelled.append(seq)
+                    continue
+                del self._callbacks[seq.request_id]
+                self._waiting.appendleft(pending)
+        for seq in cancelled:
+            self._finish(seq)
+
     def _finish(self, seq: Sequence) -> None:
         with self._lock:
+            if seq.reaped:
+                # Already finished — the shutdown force-finish path and
+                # a slow (but alive) engine thread's own reap can both
+                # reach here; counters/timelines must move once.
+                return
+            seq.reaped = True
             pending = self._callbacks.pop(seq.request_id, None)
         self.engine.release(seq)
         self.stats.requests_finished += 1
@@ -400,6 +504,7 @@ class EngineScheduler:
             "request_finish", level="info",
             request_id=seq.trace_id or str(seq.request_id),
             reason=seq.finish_reason, attempt=seq.attempt,
+            preemptions=seq.preemptions,
             prompt_tokens=len(seq.prompt_tokens),
             output_tokens=len(seq.generated),
             queue_wait_s=round(max(0.0, start - enq), 6),
@@ -432,6 +537,10 @@ class EngineScheduler:
             "prompt_tokens": len(seq.prompt_tokens),
             "cached_tokens": seq.cached_tokens,
             "output_tokens": n_out,
+            # Watermark evictions this request survived (0 = never
+            # preempted); recompute-resume makes them invisible in the
+            # token stream, so the span must say they happened.
+            "preemptions": seq.preemptions,
             "finish_reason": seq.finish_reason,
             "queue_wait_s": round(max(0.0, (seq.prefill_start or fin)
                                       - seq.enqueue_time), 6),
@@ -471,6 +580,9 @@ class EngineScheduler:
     def run(self) -> None:
         engine = self.engine
         while not self._stop.is_set():
+            # Cross-thread chaos page-pressure requests (/debug/chaos)
+            # apply HERE — the allocator is engine-thread only.
+            engine.apply_pending_page_pressure()
             self._admit()
             active = engine.active_sequences()
             if not active:
@@ -506,14 +618,14 @@ class EngineScheduler:
                     new_tokens = engine.decode_steps_pipelined()
                 self.stats.record_decode_call(time.perf_counter() - t_call)
             except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
-                import traceback
-                traceback.print_exc()
+                self._log_step_error("decode", exc, active)
                 self._note_error(exc)
                 engine.abort_pipeline()   # stale in-flight state would
-                for s in active:          # poison reused slots
-                    s.done, s.finish_reason = True, "error"
-                    s.finish_time = time.perf_counter()
-                    self._finish(s)
+                engine.take_preempted()   # poison reused slots; drop any
+                for s in active:          # mid-call preemptions too —
+                    s.done, s.finish_reason = True, "error"  # they fail
+                    s.finish_time = time.perf_counter()      # with the
+                    self._finish(s)                          # batch
                 continue
             finally:
                 self.step_inflight_since = None
@@ -535,5 +647,6 @@ class EngineScheduler:
                                                in_use)
 
             self._deliver(new_tokens)
+            self._requeue_preempted()
             for s in self._reapable():
                 self._finish(s)

@@ -121,6 +121,29 @@ def main() -> None:
                    help="shed load (429 + Retry-After) when every "
                         "routable replica has this many requests queued "
                         "or running; 0 = queue without bound (legacy)")
+    p.add_argument("--admission", default="reserve",
+                   choices=("reserve", "optimistic"),
+                   help="KV admission mode: 'reserve' charges each "
+                        "request prompt+max_new worst case (OOM-free, "
+                        "strands pool under bursty traffic); "
+                        "'optimistic' charges prompt+headroom and "
+                        "preempts/recompute-resumes on exhaustion "
+                        "(token-identical under greedy decoding)")
+    p.add_argument("--optimistic-headroom-pages", type=int, default=2,
+                   help="optimistic admission: decode-headroom pages "
+                        "charged per request on top of its prompt")
+    p.add_argument("--preempt-watermark-pages", type=int, default=4,
+                   help="preempt the most-recently-admitted sequences "
+                        "when a decode grant comes up short and "
+                        "free+evictable pages fall below this")
+    p.add_argument("--preempt-max-per-request", type=int, default=3,
+                   help="starvation guard: after this many preemptions "
+                        "a request re-admits under full worst-case "
+                        "reservation (and is never preempted again)")
+    p.add_argument("--chaos-page-pressure", type=int, default=0,
+                   help="fault injection: hold this many KV pages out "
+                        "of the pool at boot (deterministic exhaustion "
+                        "testing; adjustable via POST /debug/chaos)")
     p.add_argument("--chaos-failure-rate", type=float, default=0.0,
                    help="HTTP fault injection: 503 this fraction of "
                         "generate/chat/embed requests (harness testing)")
@@ -189,6 +212,12 @@ def main() -> None:
                               chaos_delay_s=args.chaos_delay_s),
                           chaos_step_failure_rate=args.chaos_step_failure_rate,
                           chaos_step_wedge_s=args.chaos_step_wedge_s,
+                          chaos_page_pressure=args.chaos_page_pressure,
+                          admission=args.admission,
+                          optimistic_headroom_pages=(
+                              args.optimistic_headroom_pages),
+                          preempt_watermark_pages=args.preempt_watermark_pages,
+                          preempt_max_per_request=args.preempt_max_per_request,
                           attn_backend=args.attn_backend,
                           sp_attn=args.sp_attn,
                           quant=args.quant, kv_quant=args.kv_quant,

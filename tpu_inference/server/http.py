@@ -440,18 +440,31 @@ class InferenceServer:
                        else [engines[int(replica)]])
             rate = body.get("step_failure_rate")
             wedge = body.get("step_wedge_s")
+            pressure = body.get("page_pressure")
             for eng in targets:
                 if rate is not None:
                     eng.chaos_step_failure_rate = float(rate)
                 if wedge is not None:
                     eng.chaos_step_wedge_s = float(wedge)
+                if pressure is not None:
+                    # Holds real pages out of the KV pool (clamped to
+                    # what's free) — deterministic exhaustion testing.
+                    # Applied by the engine loop (the allocator is
+                    # engine-thread only), usually within milliseconds.
+                    eng.request_page_pressure(int(pressure))
         except (IndexError, TypeError, ValueError) as e:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": f"invalid chaos spec: {e}"}),
                 content_type="application/json")
+
+        def _pp(e):
+            t = e._pressure_target
+            return e.chaos_page_pressure if t is None else t
+
         return web.json_response({"replicas": [
             {"step_failure_rate": e.chaos_step_failure_rate,
-             "step_wedge_s": e.chaos_step_wedge_s} for e in engines]})
+             "step_wedge_s": e.chaos_step_wedge_s,
+             "page_pressure": _pp(e)} for e in engines]})
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         """Ollama ``/api/chat``: messages-based wrapper over the same

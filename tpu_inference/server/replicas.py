@@ -297,13 +297,21 @@ class EngineGroup:
                 out.append(sched)
         return out
 
+    @staticmethod
+    def _route_key(sched: EngineScheduler):
+        """Least-loaded routing, preferring replicas whose KV pool is
+        not under preemption pressure: a request routed to a pressured
+        replica would likely trigger (or suffer) a preemption that a
+        sibling with free pages avoids entirely."""
+        return (sched.engine.under_pressure, sched.load)
+
     def _least_loaded(self) -> EngineScheduler:
         routable = self._routable()
         if not routable:
             raise FleetUnavailable(
                 "all replicas quarantined",
                 self._retry_after())
-        return min(routable, key=lambda s: s.load)
+        return min(routable, key=self._route_key)
 
     def _retry_after(self) -> float:
         return self.server_cfg.retry_after_s
@@ -376,7 +384,7 @@ class EngineGroup:
         routable = self._routable()
         others = [s for s in routable if s is not failed]
         pool = others or routable           # degraded-but-routable self ok
-        return min(pool, key=lambda s: s.load) if pool else None
+        return min(pool, key=self._route_key) if pool else None
 
     def _attempt_finished(self, entry: _Tracked, seq: Sequence,
                           gen: int) -> None:
@@ -471,7 +479,15 @@ class EngineGroup:
     def health_snapshot(self) -> dict:
         """Operator view served by /healthz: per-replica states + fleet
         status + shed/retry counters."""
-        replicas = [h.snapshot() for h in self.health]
+        replicas = []
+        for h, e in zip(self.health, self.engines):
+            d = h.snapshot()
+            # KV-pool pressure view: operators (and load balancers) see
+            # which replicas are burning headroom before they quarantine.
+            d["pool_pressure"] = round(e.pool_pressure, 4)
+            d["under_pressure"] = e.under_pressure
+            d["preemptions"] = e.preemptions_total
+            replicas.append(d)
         routable = sum(1 for h in self.health if h.routable)
         if routable == 0:
             status = "unavailable"
@@ -493,6 +509,10 @@ class EngineGroup:
                 "failovers": self.failovers,
                 "requests_shed": self.requests_shed,
                 "requests_unavailable": self.requests_unavailable,
+                "preemptions": sum(e.preemptions_total
+                                   for e in self.engines),
+                "recompute_resumes": sum(e.resumes_total
+                                         for e in self.engines),
                 "states": [h.state for h in self.health],
             }
 
@@ -519,7 +539,8 @@ class EngineGroup:
     # replicas. KV page counts SUM (total and in_use together, so fleet
     # utilization = in_use/total stays consistent); depth is config.
     _NON_ADDITIVE = ("model_params", "approx_flops_per_token",
-                     "mean_batch_occupancy", "decode_pipeline_depth")
+                     "mean_batch_occupancy", "decode_pipeline_depth",
+                     "pool_pressure")
 
     def stats_snapshot(self) -> dict:
         """Aggregate counters + per-replica breakdown."""

@@ -552,6 +552,17 @@ class EngineTelemetry:
         r.gauge("tpu_inf_kv_page_util",
                 "KV pool utilization (in_use / total)",
                 fn=lambda: (total - alloc.num_free) / max(total, 1))
+        r.gauge("tpu_inf_kv_pool_pressure",
+                "1 - (free+evictable)/total: fraction of the pool "
+                "pinned by running sequences",
+                fn=lambda: engine.pool_pressure)
+        r.counter("tpu_inf_preemptions_total",
+                  "Sequences preempted for KV pool pressure "
+                  "(admission=optimistic watermark safety net)",
+                  fn=lambda: engine.preemptions_total)
+        r.counter("tpu_inf_recompute_resumes_total",
+                  "Preempted sequences re-prefilled (recompute-resume)",
+                  fn=lambda: engine.resumes_total)
         r.gauge("tpu_inf_model_params", "Model parameter count",
                 fn=lambda: engine.n_params)
         r.gauge("tpu_inf_active_sequences", "Bound decode slots",
