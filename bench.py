@@ -336,6 +336,117 @@ def admission_lane_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def hybrid_lane_child() -> None:
+    """serial-vs-hybrid stepping comparison through the REAL
+    continuous-batching scheduler: short requests decode while one long
+    prompt chunk-prefills. The serial path stalls every decode lane a
+    full chunk wall per chunk; hybrid steps fuse each chunk into the
+    decode dispatch. Reports the decode-stall-during-prefill histogram,
+    fused-step count, and the shorts' worst inter-token gap while the
+    long prompt was prefilling, per mode; prints ONE JSON record."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tpu_inference.config import EngineConfig
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bench_cfg(platform)
+    page_size = 16
+    chunk = 128 if on_tpu else 32
+    long_len = 1024 if on_tpu else 200       # 8 / ~7 chunks
+    short_len = 32
+    short_budget = 512 if on_tpu else 256    # outlasts the prefill
+    n_shorts = 6
+    pages_per_seq = -(-(long_len + 8) // page_size) + 1
+    rng = np.random.default_rng(0)
+    out = {"lane": "hybrid", "model": cfg.name, "platform": platform,
+           "chunk_tokens": chunk, "long_prompt_tokens": long_len,
+           "n_decode_lanes": n_shorts}
+    for mode in ("serial", "hybrid"):
+        ecfg = EngineConfig(page_size=page_size,
+                            num_pages=pages_per_seq * (n_shorts + 2),
+                            max_pages_per_seq=pages_per_seq,
+                            max_batch_size=n_shorts + 2,
+                            prefill_buckets=(chunk, 2 * chunk),
+                            chunked_prefill_size=chunk,
+                            decode_steps_per_call=8,
+                            hybrid_prefill=(mode == "hybrid"))
+        engine = InferenceEngine(cfg, ecfg)
+        engine.warmup()
+        sched = EngineScheduler(engine).start()
+        token_times = {i: [] for i in range(n_shorts)}
+        done_events = []
+
+        def on_token(s, t):
+            if s.request_id < n_shorts:
+                token_times[s.request_id].append(time.perf_counter())
+
+        for i in range(n_shorts):
+            ev = threading.Event()
+            done_events.append(ev)
+            sched.submit(
+                Sequence(request_id=i,
+                         prompt_tokens=rng.integers(
+                             1, cfg.vocab_size, short_len).tolist(),
+                         max_new_tokens=short_budget),
+                on_token, lambda s, ev=ev: ev.set())
+        # Let every short produce tokens before the long prompt lands, so
+        # its whole chunked prefill runs against a decoding batch.
+        deadline = time.perf_counter() + 120
+        while (any(not t for t in token_times.values())
+               and time.perf_counter() < deadline):
+            time.sleep(0.005)
+        long_done = threading.Event()
+        long_seq = Sequence(request_id=99,
+                            prompt_tokens=rng.integers(
+                                1, cfg.vocab_size, long_len).tolist(),
+                            max_new_tokens=4)
+        t_submit = time.perf_counter()
+        sched.submit(long_seq, on_token, lambda s: long_done.set())
+        if not long_done.wait(240):
+            raise TimeoutError(f"hybrid lane deadlocked ({mode})")
+        ttft_long = (long_seq.first_token_time or time.perf_counter()) \
+            - t_submit
+        for i in range(n_shorts):
+            sched.cancel(i)
+        for ev in done_events:
+            ev.wait(60)
+        sched.stop(drain=True, timeout=10)
+        # Worst inter-token gap any short lane saw while the long prompt
+        # was prefilling (the user-visible stall the fusion removes).
+        first_tok = long_seq.first_token_time or time.perf_counter()
+        gaps = []
+        for times in token_times.values():
+            win = [t for t in times if t_submit - 1.0 <= t <= first_tok + 1.0]
+            gaps += [b - a for a, b in zip(win, win[1:])]
+        stall = (engine.telemetry.phase_snapshot()
+                 .get("decode_stall_during_prefill_s") or {})
+        out[mode] = {
+            "decode_stall_count": stall.get("count", 0),
+            "decode_stall_p95_s": stall.get("p95") or 0.0,
+            "decode_stall_sum_s": _r(stall.get("sum") or 0.0, 4),
+            "hybrid_steps": engine.hybrid_steps_total,
+            "long_ttft_s": _r(ttft_long, 4),
+            "short_max_gap_s": _r(max(gaps), 4) if gaps else None,
+            "short_tokens_during_run": sum(len(t) for t in
+                                           token_times.values()),
+        }
+        del engine, sched
+        gc.collect()
+    # Only claim the win when the serial arm actually measured a stall
+    # (timing could let its chunks run against an idle batch).
+    out["stall_removed"] = bool(
+        out["serial"]["decode_stall_count"] > 0
+        and out["hybrid"]["decode_stall_count"]
+        < out["serial"]["decode_stall_count"])
+    print(json.dumps(out), flush=True)
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestrator (never imports jax — cannot hang on the tunnel).
 # ---------------------------------------------------------------------------
@@ -569,6 +680,11 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "admission_comparison": (
             lanes["admission"] if lanes.get("admission", {}).get("reserve")
             else None),
+        # serial-vs-hybrid stepping comparison (decode stall during a
+        # long prompt's chunked prefill) when the lane ran.
+        "hybrid_comparison": (
+            lanes["hybrid"] if lanes.get("hybrid", {}).get("serial")
+            else None),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
         "backends_token_equal": heads_equal,
@@ -668,6 +784,17 @@ def orchestrate() -> None:
         rc, rec = _run_child(["--admission-lane"], lane_timeout, env)
         lanes["admission"] = rec or {"lane": "admission",
                                      "skipped": f"lane-failed rc={rc}"}
+        _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
+    # Hybrid-stepping comparison lane (serial vs fused chunked prefill
+    # through the scheduler): measurement-only extra, like admission.
+    if give_up:
+        lanes["hybrid"] = {"lane": "hybrid", "skipped": "tpu-wedged-midrun"}
+    elif budget_left() < lane_timeout:
+        lanes["hybrid"] = {"lane": "hybrid", "skipped": "budget-exhausted"}
+    else:
+        rc, rec = _run_child(["--hybrid-lane"], lane_timeout, env)
+        lanes["hybrid"] = rec or {"lane": "hybrid",
+                                  "skipped": f"lane-failed rc={rc}"}
     _snapshot(probe, lanes, degraded, partial=False, t_start=t_start)
 
 
@@ -676,6 +803,8 @@ if __name__ == "__main__":
         probe_child()
     elif "--admission-lane" in sys.argv:
         admission_lane_child()
+    elif "--hybrid-lane" in sys.argv:
+        hybrid_lane_child()
     elif "--lane" in sys.argv:
         lane_child(sys.argv[sys.argv.index("--lane") + 1])
     else:

@@ -62,7 +62,9 @@ def phase_breakdown(before: dict, after: dict) -> dict:
     bph = before.get("phases") or {}
     out = {}
     for key in ("decode_dispatch_s", "decode_sync_s", "dispatch_bubble_s",
-                "prefill_dispatch_s", "tokens_per_dispatch", "queue_wait_s",
+                "prefill_dispatch_s", "tokens_per_dispatch",
+                "hybrid_dispatch_s", "decode_stall_during_prefill_s",
+                "queue_wait_s",
                 "prefill_phase_s", "decode_phase_s", "ttft_s", "e2e_s"):
         if key in aph:
             d = tm.diff_phase(aph[key], bph.get(key))
@@ -154,6 +156,9 @@ def start_server(args) -> tuple:
         page_size=args.page_size, max_pages_per_seq=args.max_pages_per_seq,
         decode_steps_per_call=args.decode_steps_per_call,
         decode_pipeline_depth=args.decode_pipeline_depth,
+        chunked_prefill_size=getattr(args, "chunked_prefill_size", 0),
+        hybrid_prefill=getattr(args, "hybrid_prefill", False),
+        step_token_budget=getattr(args, "step_token_budget", 0),
         quant=getattr(args, "quant", "none"),
         kv_quant=getattr(args, "kv_quant", "none"),
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
@@ -230,6 +235,18 @@ def main() -> dict:
     p.add_argument("--max-pages-per-seq", type=int, default=64)
     p.add_argument("--decode-steps-per-call", type=int, default=8)
     p.add_argument("--decode-pipeline-depth", type=int, default=1)
+    p.add_argument("--chunked-prefill-size", type=int, default=0,
+                   help="split prompts into chunks of this many tokens "
+                        "(0 = largest prefill bucket governs)")
+    p.add_argument("--hybrid-prefill", action="store_true",
+                   help="fuse each prefill chunk into the decode "
+                        "dispatch (hybrid steps) instead of stalling "
+                        "decode lanes a chunk wall per chunk")
+    p.add_argument("--step-token-budget", type=int, default=0,
+                   help="hybrid steps: per-fused-dispatch token budget "
+                        "(chunk tokens capped at budget minus granted "
+                        "decode tokens; 0 = "
+                        "uncapped)")
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--quant", default="none",
                    choices=("none", "int8", "int4"))
@@ -253,6 +270,12 @@ def main() -> dict:
                    help="run the trace twice — admission=reserve then "
                         "optimistic — and commit an occupancy / "
                         "throughput / shed-rate comparison artifact")
+    p.add_argument("--compare-hybrid", action="store_true",
+                   help="run the workload twice — serial chunked prefill "
+                        "then hybrid fused steps — and commit a decode-"
+                        "stall / throughput / TTFT comparison artifact "
+                        "(with --smoke: a pinned long-prompt-plus-"
+                        "decoding-shorts mix)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -261,6 +284,12 @@ def main() -> dict:
                         "replay + /metrics scrape + phase_breakdown "
                         "artifact path in seconds")
     args = p.parse_args()
+
+    if args.compare_admission and args.compare_hybrid:
+        # Each comparison pins its own workload/sizing; combining them
+        # would silently measure one lane on the other's shape.
+        p.error("--compare-admission and --compare-hybrid are mutually "
+                "exclusive; run them as separate invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -279,8 +308,18 @@ def main() -> dict:
             # packs more lanes and preempts under pressure — the
             # occupancy delta is the artifact's point.
             args.num_pages, args.max_pages_per_seq = 20, 12
+        if args.compare_hybrid:
+            # The comparison needs one LONG (multi-chunk) prompt
+            # prefilling while short requests decode: room for a
+            # 127-token prompt, a 16-token chunk size (8 chunks), and
+            # shorts with enough generation budget to still be decoding
+            # through every chunk. run_replay pins the matching schedule.
+            args.max_pages_per_seq = 16
+            args.chunked_prefill_size = 16
         if args.out is None:
-            args.out = "benchmarks/results/replay_smoke.json"
+            args.out = ("benchmarks/results/replay_hybrid.json"
+                        if args.compare_hybrid
+                        else "benchmarks/results/replay_smoke.json")
 
     if args.platform != "auto":
         # Before any jax computation (env vars are read too early in
@@ -305,6 +344,8 @@ def main() -> dict:
 
     if args.compare_admission:
         return _compare_admission(args)
+    if args.compare_hybrid:
+        return _compare_hybrid(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -331,11 +372,29 @@ def run_replay(args) -> dict:
             # fast CPU model would serialize the run and hide the
             # occupancy difference being measured).
             schedule["Timestamp"] = 0.0
+        if getattr(args, "compare_hybrid", False) and args.smoke:
+            # Pinned decode-stall workload: ONE long prompt (8 chunks of
+            # 16 once truncated to max_context-1=127) submitted first so
+            # it starts its incremental prefill, then three shorts that
+            # batch-admit while it is mid-chunks and keep decoding
+            # through every remaining chunk. Serial chunking stalls
+            # those lanes once per chunk (decode_stall samples); hybrid
+            # steps fuse the chunks into their decode dispatches
+            # (structurally zero samples) — the artifact compares
+            # exactly that histogram.
+            import pandas as pd
+            schedule = pd.DataFrame({
+                "Timestamp": [0.0, 0.0, 0.0, 0.0],
+                "Request tokens": [128, 8, 8, 8],
+                "Response tokens": [8, 64, 64, 64],
+            })
         collector = MetricCollector()
         gen_kw = {}
         if args.smoke:
             gen_kw = ({"max_prompt_len": 24, "max_gen_len": 48}
                       if args.compare_admission else
+                      {"max_prompt_len": 128, "max_gen_len": 64}
+                      if getattr(args, "compare_hybrid", False) else
                       {"max_prompt_len": 48, "max_gen_len": 12})
         gen = TrafficGenerator(
             data, schedule,
@@ -370,6 +429,18 @@ def run_replay(args) -> dict:
             "shed_rate": summary["shed_rate"],
         }
         summary["phase_breakdown"] = phase_breakdown(before, after)
+        # Hybrid-stepping lane: the decode-stall-during-prefill numbers
+        # the serial-vs-hybrid artifact compares (count 0 -> p95 0.0:
+        # nothing ever stalled).
+        stall = summary["phase_breakdown"].get(
+            "decode_stall_during_prefill_s") or {}
+        summary["hybrid"] = {
+            "enabled": bool(after.get("hybrid_prefill")),
+            "hybrid_steps": after.get("hybrid_steps"),
+            "decode_stall_count": stall.get("count", 0),
+            "decode_stall_p95_s": stall.get("p95") or 0.0,
+            "decode_stall_sum_s": stall.get("sum") or 0.0,
+        }
         summary["prometheus_scrape"] = {
             "content_type": prom_ctype,
             "families": prom_text.count("# TYPE "),
@@ -385,6 +456,9 @@ def _compare_admission(args) -> dict:
     """Run the trace under admission=reserve then admission=optimistic
     (fresh server each) and commit the side-by-side artifact: batch
     occupancy, tokens/s, shed rate, preemption counts."""
+    # Snapshot the invocation BEFORE the per-arm mutation below, so the
+    # committed config reproduces this comparison (not the last arm).
+    cfg_snapshot = dict(vars(args))
     summaries = {}
     for mode in ("reserve", "optimistic"):
         args.admission = mode
@@ -412,12 +486,78 @@ def _compare_admission(args) -> dict:
             or (opt["tokens_per_s"] >= res["tokens_per_s"]
                 and opt["shed_rate"] <= res["shed_rate"])),
     }
-    out = {"config": vars(args), "reserve": res, "optimistic": opt,
+    out = {"config": cfg_snapshot, "reserve": res, "optimistic": opt,
            "comparison": comparison}
     print(json.dumps(comparison, indent=1))
     _write_out(args.out, out)
     result = dict(comparison)
     result["reserve"], result["optimistic"] = res, opt
+    return result
+
+
+def _compare_hybrid(args) -> dict:
+    """Run the workload under serial chunked prefill then under hybrid
+    fused steps (fresh server each) and commit the side-by-side
+    artifact: p95 decode stall while a prompt prefills (the server-side
+    inter-token stall hybrid exists to remove), aggregate tokens/s,
+    TTFT, and the client-observed worst inter-chunk gap."""
+    # Snapshot the invocation BEFORE the per-arm mutation below, so the
+    # committed config reproduces this comparison (not the last arm).
+    cfg_snapshot = dict(vars(args))
+    summaries = {}
+    for mode in ("serial", "hybrid"):
+        args.hybrid_prefill = (mode == "hybrid")
+        print(f"[replay] scheduling={mode} lane", file=sys.stderr)
+        summaries[mode] = run_replay(args)
+    ser, hyb = summaries["serial"], summaries["hybrid"]
+
+    comparison = {
+        "decode_stall_count_serial": ser["hybrid"]["decode_stall_count"],
+        "decode_stall_count_hybrid": hyb["hybrid"]["decode_stall_count"],
+        "decode_stall_p95_serial_s": ser["hybrid"]["decode_stall_p95_s"],
+        "decode_stall_p95_hybrid_s": hyb["hybrid"]["decode_stall_p95_s"],
+        "hybrid_steps": hyb["hybrid"]["hybrid_steps"],
+        "tokens_per_s_serial": ser["tokens_per_s"],
+        "tokens_per_s_hybrid": hyb["tokens_per_s"],
+        "tok_s_ratio": round(hyb["tokens_per_s"]
+                             / max(ser["tokens_per_s"], 1e-9), 4),
+        "ttft_p99_serial_s": ser["ttft_s"]["p99"],
+        "ttft_p99_hybrid_s": hyb["ttft_s"]["p99"],
+        "max_interchunk_gap_p99_serial_s":
+            ser["max_interchunk_gap_s"]["p99"],
+        "max_interchunk_gap_p99_hybrid_s":
+            hyb["max_interchunk_gap_s"]["p99"],
+        # Greedy decoding + identical prompts: both arms must emit the
+        # same token counts (the HTTP-level echo of the byte-equality
+        # tests/test_hybrid.py pins at engine level).
+        "output_tokens_serial": ser["output_tokens"],
+        "output_tokens_hybrid": hyb["output_tokens"],
+        # Committed-artifact throughput check (tok/s no more than 5%
+        # below serial). Deliberately NOT folded into hybrid_wins: the
+        # tier-1 smoke asserts hybrid_wins, and wall-clock tok/s on a
+        # loaded CI box swings far more than 5% run to run — the
+        # deterministic stall histogram is the CI-gradable claim, the
+        # ratio is graded on the artifact actually committed.
+        "tok_s_within_5pct": bool(
+            hyb["tokens_per_s"] >= 0.95 * ser["tokens_per_s"]),
+        # The artifact's claim: fusing removes the decode stall (the
+        # chunk-sized inter-token spike) entirely. Guarded on the serial
+        # arm actually MEASURING a stall (same guard as bench.py's
+        # stall_removed) so a run whose chunks never met a busy batch —
+        # or one with telemetry disabled — can't claim a vacuous win.
+        "hybrid_wins": bool(
+            ser["hybrid"]["decode_stall_count"] > 0
+            and hyb["hybrid"]["decode_stall_p95_s"]
+            <= ser["hybrid"]["decode_stall_p95_s"]
+            and hyb["hybrid"]["decode_stall_count"]
+            < ser["hybrid"]["decode_stall_count"]),
+    }
+    out = {"config": cfg_snapshot, "serial": ser, "hybrid": hyb,
+           "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result["serial"], result["hybrid"] = ser, hyb
     return result
 
 

@@ -85,3 +85,44 @@ def test_replay_smoke_compare_admission(tmp_path, monkeypatch):
     assert cmp["recompute_resumes"] == cmp["preemptions"]
     assert art["reserve"]["admission"]["preemptions"] == 0
     assert cmp["optimistic_wins"], cmp
+
+
+def test_replay_smoke_compare_hybrid(tmp_path, monkeypatch):
+    """Tier-1 hybrid-stepping smoke (CPU): the serial-vs-hybrid lane
+    replays a pinned mix — one 8-chunk long prompt plus three shorts
+    that decode through its prefill — through the full HTTP path, twice.
+    The committed artifact must show the serial arm stalling decode
+    lanes behind chunk dispatches and the hybrid arm fusing every chunk
+    (structurally zero stall samples, so its p95 is <= serial's), with
+    identical greedy token counts across arms."""
+    root, replay = _load_replay()
+    out = tmp_path / "replay_hybrid.json"
+    monkeypatch.chdir(root)
+    monkeypatch.setattr(sys, "argv",
+                        ["replay.py", "--smoke", "--compare-hybrid",
+                         "--out", str(out)])
+    cmp = replay.main()
+
+    art = json.loads(out.read_text())
+    for mode in ("serial", "hybrid"):
+        s = art[mode]
+        assert s["succeeded"] == s["requests"] > 0, (mode, s)
+        # Artifact schema: the stall histogram and hybrid counters are
+        # present in both arms' summaries.
+        assert "decode_stall_during_prefill_s" in s["phase_breakdown"]
+        assert set(s["hybrid"]) >= {"enabled", "hybrid_steps",
+                                    "decode_stall_count",
+                                    "decode_stall_p95_s"}
+    assert art["serial"]["hybrid"]["enabled"] is False
+    assert art["hybrid"]["hybrid"]["enabled"] is True
+    # The serial arm demonstrably stalled decode lanes behind chunks...
+    assert cmp["decode_stall_count_serial"] >= 1
+    assert cmp["decode_stall_p95_serial_s"] > 0
+    # ...and the hybrid arm fused them instead.
+    assert cmp["hybrid_steps"] >= 1
+    assert cmp["decode_stall_count_hybrid"] == 0
+    assert (cmp["decode_stall_p95_hybrid_s"]
+            <= cmp["decode_stall_p95_serial_s"])
+    # Greedy + identical prompts: same token counts in both arms.
+    assert cmp["output_tokens_hybrid"] == cmp["output_tokens_serial"]
+    assert cmp["hybrid_wins"], cmp

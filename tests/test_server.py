@@ -256,10 +256,19 @@ def test_aux_routes(server):
         assert info["serving.swa_eviction"] is False
         assert info["serving.prefix_cache"] is True
         # Ollama GET /api/ps: the one loaded model, never unloading.
+        # size/size_vram are ONE model copy (not x dp — ADVICE r5); the
+        # replica count is a separate additive field, and details carry
+        # Ollama-shaped values ("3.2M"/"8.0B" parameter_size, "F32"/
+        # "Q8_0"-style quantization_level).
         ps = await (await client.get("/api/ps")).json()
         (entry,) = ps["models"]
         assert entry["name"] == "tiny-llama"
         assert entry["size"] > 0 and entry["size_vram"] == entry["size"]
+        assert entry["replicas"] == 1
+        det = entry["details"]
+        assert det["parameter_size"].endswith(("B", "M", "K"))
+        assert det["quantization_level"] in ("F32", "F16", "BF16",
+                                             "Q8_0", "Q4_0")
         assert entry["expires_at"].startswith("0001-01-01")
 
     _run(server, go)

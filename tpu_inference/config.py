@@ -364,6 +364,25 @@ class EngineConfig:
     # for lanes that stop mid-flight (their tokens are discarded) and
     # adds (depth-1)*K steps of streaming latency. 1 = fully synchronous.
     decode_pipeline_depth: int = 1
+    # Hybrid prefill-decode steps (Sarathi-Serve-style chunked-prefill
+    # piggybacking): while a multi-chunk prompt prefills, each chunk is
+    # FUSED into the same device dispatch as the batch's K decode steps,
+    # so running lanes keep producing tokens instead of stalling a full
+    # chunk wall per chunk. Safe because the chunk and the decode lanes
+    # touch disjoint KV pages (each sequence reads/writes only its own
+    # block table). Off by default; no effect on single-chunk prompts
+    # (they still batch-admit through prefill_many) or under speculative
+    # decoding (the spec round has its own fused graph).
+    hybrid_prefill: bool = False
+    # Per-hybrid-step token budget: chunk tokens are capped at
+    # step_token_budget minus the decode tokens granted for that
+    # dispatch (floored at page_size so the prefill always advances),
+    # bounding how much prefill compute any one fused step adds on top
+    # of the decode work —
+    # the knob that trades TTFT of the long prompt against inter-token
+    # latency of everyone else. 0 = uncapped (chunked_prefill_size /
+    # largest bucket governs, as in serial chunking).
+    step_token_budget: int = 0
     # Sampling defaults (overridable per request).
     temperature: float = 0.0          # 0 => greedy
     top_k: int = 0                    # 0 => disabled
@@ -415,6 +434,16 @@ class EngineConfig:
     @property
     def max_context(self) -> int:
         return self.page_size * self.max_pages_per_seq
+
+    @property
+    def chunk_tokens_cap(self) -> int:
+        """Effective chunk length for multi-chunk prefills:
+        ``chunked_prefill_size`` clamped to the largest compiled bucket —
+        a larger value would slice chunks no prefill graph can hold
+        (the [1, bucket] token buffer raises on assignment). 0 means the
+        largest bucket governs."""
+        cap = self.chunked_prefill_size or self.prefill_buckets[-1]
+        return min(cap, self.prefill_buckets[-1])
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

@@ -390,6 +390,7 @@ class InferenceEngine:
         self.admission = engine_cfg.admission
         self.preemptions_total = 0        # sequences evicted for pressure
         self.resumes_total = 0            # recompute-resume prefills
+        self.hybrid_steps_total = 0       # fused prefill+decode dispatches
         self._admit_counter = 0           # admission recency for victims
         # Sequences preempted since the caller last collected them; the
         # scheduler requeues these at the head of its wait queue.
@@ -452,6 +453,13 @@ class InferenceEngine:
             partial(self._prefill_fn), donate_argnums=(1,))
         self._decode_multi_jit = jax.jit(
             partial(self._decode_multi_fn), donate_argnums=(1,))
+        # Hybrid prefill-decode steps (EngineConfig.hybrid_prefill): one
+        # fused dispatch advances a [1, S] prefill chunk AND the [B]
+        # K-step decode scan on the shared (page-disjoint) pool. One
+        # graph per prefill bucket; the decode half keeps the fused-K
+        # shape, so compile count matches the serial path's.
+        self._hybrid_jit = jax.jit(
+            partial(self._hybrid_step_fn), donate_argnums=(1,))
         # Single-step decode graph: a 1-iteration scan, so a token leaves
         # the device every step instead of every K — the scheduler's
         # latency mode uses it when the batch is nearly empty (streaming
@@ -652,6 +660,37 @@ class InferenceEngine:
         # throughput).
         return kv, outs, final_tokens, final_window
 
+    def _hybrid_step_fn(self, params, kv: KVPages,
+                        p_tokens, p_prompt_len, p_prefix_len, p_block_table,
+                        p_key, p_temp, p_top_p, p_top_k, p_seed, p_rpen,
+                        p_rlast, p_window,
+                        d_tokens, d_ctx_lens, d_block_tables, d_allowed,
+                        d_eos_ids, d_key, d_temp, d_top_p, d_top_k, d_seed,
+                        d_rpen, d_rlast, d_window):
+        """One hybrid step: a [1, S_bucket] prefill chunk AND the [B]
+        K-step fused decode under a single dispatch.
+
+        The fusion is safe because the two halves are page-disjoint: the
+        chunk writes (then attends over) only the prefilling sequence's
+        block table, and every decode lane reads/writes only its own
+        pages — so the sequential composition below computes exactly
+        what the two serial dispatches compute, while the device sees
+        one launch instead of a decode batch stalling a full chunk wall.
+        Returns (kv, chunk's sampled token [1], decode outs [K, B],
+        final carry tokens [B], final penalty window [B, W]) — the
+        decode tail matches _decode_multi_fn so hybrid calls chain into
+        the same dispatch-ahead pipeline as plain decode calls.
+        """
+        kv, p_tok, _ = self._prefill_fn(
+            params, kv, p_tokens, p_prompt_len, p_prefix_len, p_block_table,
+            p_key, p_temp, p_top_p, p_top_k, p_seed, p_rpen, p_rlast,
+            p_window)
+        kv, outs, final, final_window = self._decode_multi_fn(
+            params, kv, d_tokens, d_ctx_lens, d_block_tables, d_allowed,
+            d_eos_ids, d_key, d_temp, d_top_p, d_top_k, d_seed, d_rpen,
+            d_rlast, d_window)
+        return kv, p_tok, outs, final, final_window
+
     # ------------------------------------------------------------------
     # Host-side orchestration
     # ------------------------------------------------------------------
@@ -694,6 +733,22 @@ class InferenceEngine:
                         self.draft_params, self.draft_kv, toks, one, zero,
                         bt)
         b = ecfg.max_batch_size
+
+        def decode_half_args():
+            """Decode-graph warmup operands (tokens .. penalty window) —
+            shared by the plain decode graphs and the hybrid graphs'
+            decode half so the two call shapes cannot drift apart."""
+            return (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                    jnp.zeros((b, self.max_pages), jnp.int32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.full((b,), -1, jnp.int32), self._next_key(),
+                    jnp.zeros((b,), jnp.float32),
+                    jnp.ones((b,), jnp.float32),
+                    jnp.zeros((b,), jnp.int32),
+                    jnp.full((b,), -1, jnp.int32),
+                    jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
+                    jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
+
         if self.spec_enabled:
             out = self._spec_jit(
                 self.params, self.draft_params, self.kv, self.draft_kv,
@@ -713,18 +768,31 @@ class InferenceEngine:
                 # XLA compile mid-serving (ADVICE r3).
                 decodes.append(self._decode_one_jit)
             for decode in decodes:
-                self.kv, _, _, _ = decode(
-                    self.params, self.kv, jnp.zeros((b,), jnp.int32),
-                    jnp.zeros((b,), jnp.int32),
-                    jnp.zeros((b, self.max_pages), jnp.int32),
-                    jnp.zeros((b,), jnp.int32),
-                    jnp.full((b,), -1, jnp.int32), self._next_key(),
-                    jnp.zeros((b,), jnp.float32),
-                    jnp.ones((b,), jnp.float32),
-                    jnp.zeros((b,), jnp.int32),
-                    jnp.full((b,), -1, jnp.int32),
-                    jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
-                    jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
+                self.kv, _, _, _ = decode(self.params, self.kv,
+                                          *decode_half_args())
+        if ecfg.hybrid_prefill and not self.spec_enabled:
+            # One hybrid graph per REACHABLE prefill bucket (the decode
+            # half's shape is fixed), so the first long prompt under
+            # mixed traffic doesn't pay an XLA compile mid-serving.
+            # Hybrid chunks never exceed the chunk cap (budget pressure
+            # only shrinks them), so buckets above bucket_for(cap) are
+            # unreachable and compiling them would only slow boot.
+            bucket_cap = ecfg.bucket_for(
+                min(ecfg.chunk_tokens_cap, ecfg.max_context))
+            bt1 = jnp.zeros((1, self.max_pages), jnp.int32)
+            one1, zero1 = jnp.ones((1,), jnp.int32), jnp.zeros((1,), jnp.int32)
+            for bucket in ecfg.prefill_buckets:
+                if bucket > ecfg.max_context or bucket > bucket_cap:
+                    continue
+                self.kv, _, _, _, _ = self._hybrid_jit(
+                    self.params, self.kv,
+                    jnp.zeros((1, bucket), jnp.int32), one1, zero1, bt1,
+                    self._next_key(), jnp.zeros((1,), jnp.float32),
+                    jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+                    jnp.full((1,), -1, jnp.int32),
+                    jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+                    jnp.full((1, PENALTY_WINDOW), -1, jnp.int32),
+                    *decode_half_args())
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -1115,51 +1183,95 @@ class InferenceEngine:
         return (self.sp > 1 and offset == 0 and chunk_len == prompt_len
                 and bucket % self.sp == 0)
 
+    def _stage_chunk_arrays(self, seq: Sequence, prompt: List[int],
+                            offset: int, chunk_cap: int) -> dict:
+        """Host arrays for one prefill chunk at ``offset`` — the SINGLE
+        staging point shared by the serial dispatch (_prefill_one_chunk)
+        and hybrid staging (_stage_hybrid_chunk / _stage_chunk_only_call),
+        so the two scheduling modes cannot drift apart and byte-equality
+        holds by construction.
+
+        First sampled token's penalty window = the prompt tail (only the
+        final chunk's sample is kept, so mid-chunk windows don't matter).
+        """
+        chunk = prompt[offset:offset + chunk_cap]
+        bucket = self.engine_cfg.bucket_for(len(chunk))
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(chunk)] = chunk
+        top_k, rseed = self._sampling_arrays(seq)
+        rpen, rlast = self._penalty_arrays(seq)
+        win = np.full((1, PENALTY_WINDOW), -1, np.int32)
+        if rpen != 1.0:
+            win[0] = self._penalty_window_row(seq)
+        return {
+            "seq": seq, "prompt": prompt, "chunk_tokens": len(chunk),
+            "bucket": bucket, "tokens": toks,
+            "prompt_len": np.asarray([len(chunk)], np.int32),
+            "prefix_len": np.asarray([offset], np.int32),
+            "block_table": self._block_table_array(seq.pages)[None],
+            "temp": np.asarray([seq.temperature], np.float32),
+            "top_p": np.asarray([seq.top_p], np.float32),
+            "top_k": np.asarray([top_k], np.int32),
+            "seed": np.asarray([rseed], np.int32),
+            "rpen": np.asarray([rpen], np.float32),
+            "rlast": np.asarray([rlast], np.int32),
+            "window": win,
+        }
+
+    def _chunk_device_args(self, st: dict) -> tuple:
+        """Device operands for a staged chunk, in _prefill_fn order
+        (tokens .. penalty window, with a fresh key) — shared by every
+        dispatch site that consumes _stage_chunk_arrays."""
+        return (jnp.asarray(st["tokens"]), jnp.asarray(st["prompt_len"]),
+                jnp.asarray(st["prefix_len"]),
+                jnp.asarray(st["block_table"]), self._next_key(),
+                jnp.asarray(st["temp"]), jnp.asarray(st["top_p"]),
+                jnp.asarray(st["top_k"]), jnp.asarray(st["seed"]),
+                jnp.asarray(st["rpen"]), jnp.asarray(st["rlast"]),
+                jnp.asarray(st["window"]))
+
     def _prefill_one_chunk(self, seq: Sequence, prompt: List[int],
                            offset: int) -> Tuple[int, Any]:
         """Run one prefill chunk at ``offset``; returns (next_offset,
         sampled-token device array for the chunk)."""
         ecfg = self.engine_cfg
-        bt = self._block_table_array(seq.pages)[None]
-        chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
-        top_k, rseed = self._sampling_arrays(seq)
-        rpen, rlast = self._penalty_arrays(seq)
-        # First sampled token's penalty window = the prompt tail (only the
-        # final chunk's sample is kept, so mid-chunk windows don't matter).
-        win = np.full((1, PENALTY_WINDOW), -1, np.int32)
-        if rpen != 1.0:
-            win[0] = self._penalty_window_row(seq)
-        chunk = prompt[offset:offset + chunk_cap]
-        bucket = ecfg.bucket_for(len(chunk))
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :len(chunk)] = chunk
-        use_sp = self._use_sp(offset, len(chunk), len(prompt), bucket)
+        chunk_cap = ecfg.chunk_tokens_cap
+        st = self._stage_chunk_arrays(seq, prompt, offset, chunk_cap)
+        use_sp = self._use_sp(offset, st["chunk_tokens"], len(prompt),
+                              st["bucket"])
         prefill = self._prefill_sp_jit if use_sp else self._prefill_jit
+        # Decode lanes active right now sit stalled behind this serial
+        # chunk — exactly the stall hybrid steps remove, so the
+        # histogram is scoped to CHUNKED-prefill dispatches (single-
+        # chunk admission stalls are untouched by hybrid stepping and
+        # already visible in prefill_dispatch_s). Mid-prefill sequences
+        # are excluded by active_sequences, so this counts only victims.
+        stalled = bool(self.active_sequences())
         t0 = time.perf_counter()
         self._last_decode_end = None     # prefill breaks the decode streak
-        self.kv, tok, _ = prefill(
-            self.params, self.kv, jnp.asarray(toks),
-            jnp.asarray([len(chunk)], np.int32),
-            jnp.asarray([offset], np.int32), jnp.asarray(bt),
-            self._next_key(),
-            jnp.asarray([seq.temperature], np.float32),
-            jnp.asarray([seq.top_p], np.float32),
-            jnp.asarray([top_k], np.int32),
-            jnp.asarray([rseed], np.int32),
-            jnp.asarray([rpen], np.float32),
-            jnp.asarray([rlast], np.int32), jnp.asarray(win))
+        self.kv, tok, _ = prefill(self.params, self.kv,
+                                  *self._chunk_device_args(st))
         if self.spec_enabled:
             # Mirror the chunk into the draft model's KV (same pages).
             self.draft_kv = self._draft_prefill_jit(
-                self.draft_params, self.draft_kv, jnp.asarray(toks),
-                jnp.asarray([len(chunk)], np.int32),
-                jnp.asarray([offset], np.int32), jnp.asarray(bt))
+                self.draft_params, self.draft_kv,
+                jnp.asarray(st["tokens"]), jnp.asarray(st["prompt_len"]),
+                jnp.asarray(st["prefix_len"]),
+                jnp.asarray(st["block_table"]))
         if self.telemetry.enabled:
             dt = time.perf_counter() - t0
             self.telemetry.prefill_dispatch_s.observe(dt)
             self.telemetry.prefill_dispatches.inc()
+            if stalled:
+                # The stall histogram must record the chunk's DEVICE
+                # wall, not the (async on TPU) enqueue overhead dt —
+                # blocking here costs nothing extra: the stalled lanes
+                # can't advance until this chunk completes anyway.
+                jax.block_until_ready(tok)
+                self.telemetry.decode_stall_during_prefill_s.observe(
+                    time.perf_counter() - t0)
             seq.dispatch_wall_s += dt
-        return offset + len(chunk), tok
+        return offset + st["chunk_tokens"], tok
 
     def _prefill_chunked(self, seq: Sequence, prompt: List[int]) -> None:
         """Serial (one-lane) prefill; chunks prompts that exceed the
@@ -1283,7 +1395,7 @@ class InferenceEngine:
         """
         self._chaos_step_gate()
         ecfg = self.engine_cfg
-        chunk_cap = (ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1])
+        chunk_cap = ecfg.chunk_tokens_cap
         slots = self.free_slots()
         if len(slots) < len(seqs):
             # zip truncation would silently drop (and strand) requests.
@@ -1632,9 +1744,72 @@ class InferenceEngine:
     # Pipelined decode (dispatch-ahead serving loop)
     # ------------------------------------------------------------------
 
-    def _stage_decode_call(self):
+    def _hybrid_chunk_cap(self, decode_tokens: int) -> int:
+        """Chunk-token cap for one hybrid step: the serial chunk cap,
+        further bounded by ``step_token_budget`` minus the decode tokens
+        actually GRANTED for this dispatch (not lanes * K — lanes near
+        their generation budget are granted fewer steps, and deducting
+        their full K share would over-shrink the chunk), floored at
+        page_size so the prefill always advances. Real (unpadded)
+        tokens are what the budget counts; bucket padding is a
+        compile-shape artifact."""
+        ecfg = self.engine_cfg
+        cap = ecfg.chunk_tokens_cap
+        budget = ecfg.step_token_budget
+        if budget > 0:
+            cap = min(cap, max(ecfg.page_size, budget - decode_tokens))
+        return cap
+
+    def _stage_hybrid_chunk(self, seq: Sequence,
+                            decode_tokens: int) -> Optional[dict]:
+        """Host arrays for ``seq``'s next prefill chunk (no dispatch).
+
+        Advances ``seq.prefill_offset`` at STAGE time, so chained hybrid
+        dispatches can stage chunk N+1 while chunk N is still in flight
+        — the device serializes them on the donated pool, and chunk N+1's
+        prefix attention reads pages chunk N has written by then. Only
+        the FINAL chunk's sampled token is read back (at sync). Returns
+        None once the whole prompt is staged."""
+        prompt = seq.prefill_prompt
+        if prompt is None or seq.done or seq.prefill_offset >= len(prompt):
+            return None
+        offset = seq.prefill_offset
+        st = self._stage_chunk_arrays(seq, prompt, offset,
+                                      self._hybrid_chunk_cap(decode_tokens))
+        seq.prefill_offset = offset + st["chunk_tokens"]
+        st["final"] = seq.prefill_offset >= len(prompt)
+        return st
+
+    def _stage_chunk_only_call(self, chunk: dict) -> dict:
+        """Dispatch one staged prefill chunk WITHOUT a decode half (no
+        lane could advance this call) and wrap it as a pipeline call, so
+        chained chunks keep flowing through _sync_oldest/drain exactly
+        like hybrid calls. Counts as a prefill dispatch, not a hybrid
+        step, and observes no decode stall — the lanes it would have
+        stalled are covered by in-flight work."""
+        t0 = time.perf_counter()
+        self._last_decode_end = None   # prefill breaks the decode streak
+        self.kv, p_tok, _ = self._prefill_jit(
+            self.params, self.kv, *self._chunk_device_args(chunk))
+        if self.telemetry.enabled:
+            dt = time.perf_counter() - t0
+            self.telemetry.prefill_dispatch_s.observe(dt)
+            self.telemetry.prefill_dispatches.inc()
+            chunk["seq"].dispatch_wall_s += dt
+        return {"outs": None, "final": None, "final_window": None,
+                "allowed": {}, "seqs": {},
+                "prefill": {"seq": chunk["seq"], "prompt": chunk["prompt"],
+                            "final": chunk["final"], "tok": p_tok}}
+
+    def _stage_decode_call(self, prefill_seq: Optional[Sequence] = None):
         """Stage one fused-decode dispatch from current host state plus
         the ctx deltas of still-in-flight calls (predicted ctx).
+
+        With ``prefill_seq`` (a sequence mid-incremental-prefill), its
+        next chunk rides the same dispatch: the hybrid graph advances
+        the chunk and the decode lanes together (page-disjoint, so the
+        fusion is value-identical to the serial order), and the call
+        chains into the pipeline exactly like a plain decode call.
 
         Returns None when nothing can advance. Page/budget/room logic
         mirrors decode_steps, evaluated at the predicted positions; lanes
@@ -1650,7 +1825,7 @@ class InferenceEngine:
             for slot, steps in call["allowed"].items():
                 ahead[slot] = ahead.get(slot, 0) + steps
         active_seqs = self.active_sequences()
-        if not active_seqs:
+        if not active_seqs and prefill_seq is None:
             return None
         allowed_by_slot: Dict[int, int] = {}
         staged: List[Sequence] = []
@@ -1671,8 +1846,22 @@ class InferenceEngine:
                 continue                      # ahead calls may still emit
             allowed_by_slot[seq.slot] = steps
             staged.append(seq)
-        if not staged:
+        # Stage the chunk AFTER grant filtering: the step token budget
+        # deducts only the lanes actually advancing in THIS dispatch, so
+        # a call whose lanes are all covered by in-flight work doesn't
+        # shrink the chunk for decode tokens it isn't producing.
+        chunk = None
+        if prefill_seq is not None:
+            chunk = self._stage_hybrid_chunk(
+                prefill_seq, sum(allowed_by_slot.values()))
+        if not staged and chunk is None:
             return None
+        if not staged:
+            # No decode lane can advance this call (all grants covered by
+            # in-flight work, or no lanes at all): dispatch the chunk on
+            # the plain prefill graph instead of burning a dead B x K
+            # decode scan inside the hybrid graph.
+            return self._stage_chunk_only_call(chunk)
 
         # A lane _starved() preempted above has no slot anymore — drop
         # it before staging host arrays (seq.slot == -1 would index the
@@ -1696,6 +1885,8 @@ class InferenceEngine:
         # (oldest-to-newest fold: later calls overwrite); lanes in no
         # in-flight call (fresh prefills) keep their host-known state.
         for call in self._inflight:
+            if call["final"] is None:
+                continue    # chunk-only call: no decode half, no carry
             carried = np.zeros((b,), bool)
             for slot in call["allowed"]:
                 carried[slot] = True
@@ -1704,20 +1895,40 @@ class InferenceEngine:
             window_d = jnp.where(carried_d[:, None], call["final_window"],
                                  window_d)
         t0 = self._note_decode_entry(staged)
-        self.kv, outs, final, final_window = self._decode_multi_jit(
-            self.params, self.kv, tokens_d, jnp.asarray(ctx_lens),
-            jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
-            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
-            jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
-            jnp.asarray(rlasts), window_d)
+        if chunk is None:
+            self.kv, outs, final, final_window = self._decode_multi_jit(
+                self.params, self.kv, tokens_d, jnp.asarray(ctx_lens),
+                jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
+                self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
+                jnp.asarray(rlasts), window_d)
+            p_tok = None
+        else:
+            self.kv, p_tok, outs, final, final_window = self._hybrid_jit(
+                self.params, self.kv, *self._chunk_device_args(chunk),
+                tokens_d, jnp.asarray(ctx_lens),
+                jnp.asarray(bts), jnp.asarray(allowed), jnp.asarray(eos_ids),
+                self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
+                jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
+                jnp.asarray(rlasts), window_d)
+            self.hybrid_steps_total += 1
+            self.telemetry.hybrid_steps.inc()
         # Non-blocking dispatch: the wall recorded here is host dispatch
         # overhead; the device wait surfaces in decode_sync_s at
         # _sync_oldest.
         self._note_decode_exit(t0, staged)
-        return {"outs": outs, "final": final,
+        if chunk is not None and self.telemetry.enabled:
+            dt = time.perf_counter() - t0
+            self.telemetry.hybrid_dispatch_s.observe(dt)
+            chunk["seq"].dispatch_wall_s += dt
+        call = {"outs": outs, "final": final,
                 "final_window": final_window,
                 "allowed": allowed_by_slot,
                 "seqs": {s.slot: s for s in staged}}
+        if chunk is not None:
+            call["prefill"] = {"seq": chunk["seq"], "prompt": chunk["prompt"],
+                               "final": chunk["final"], "tok": p_tok}
+        return call
 
     def _sync_oldest(self) -> Dict[int, List[int]]:
         """Block on the oldest in-flight call and fold its tokens into
@@ -1725,10 +1936,27 @@ class InferenceEngine:
         discarded (their compute was speculative)."""
         call = self._inflight.pop(0)
         t0 = time.perf_counter()
-        outs = np.asarray(call["outs"])               # [K, B]
+        pf = call.get("prefill")
+        if call["outs"] is not None:
+            outs = np.asarray(call["outs"])           # [K, B]
+        else:
+            # Chunk-only call (no decode half): the blocking sync is on
+            # the chunk's sampled token instead.
+            outs = None
+            if pf is not None:
+                jax.block_until_ready(pf["tok"])
         if self.telemetry.enabled:
             dt = time.perf_counter() - t0
-            self.telemetry.decode_sync_s.observe(dt)
+            if outs is not None:
+                self.telemetry.decode_sync_s.observe(dt)
+            if pf is not None:
+                # The chunk shared this call, so its request waited on
+                # the same sync (the chunk's prefill compute usually
+                # dominates it) — without this the long prompt's
+                # timeline would show near-zero dispatch wall. Chunk-
+                # only waits stay out of decode_sync_s (pure prefill
+                # device time, not a decode sync).
+                pf["seq"].dispatch_wall_s += dt
             for seq in call["seqs"].values():
                 if not seq.done and self.slots[seq.slot] is seq:
                     seq.dispatch_wall_s += dt
@@ -1750,9 +1978,35 @@ class InferenceEngine:
                 seq, (int(outs[s, slot]) for s in range(outs.shape[0])))
             if got:
                 result[seq.request_id] = got
-        if self.telemetry.enabled:
+        if pf is not None:
+            # Hybrid call: the chunk's offset advanced at stage time; only
+            # the FINAL chunk has host work left — fold its sampled token
+            # and complete the incremental prefill. A cancel that landed
+            # mid-flight skips the fold (the scheduler reaps the sequence;
+            # its pages are released only after the pipeline settles).
+            seq = pf["seq"]
+            if (pf["final"] and not seq.done
+                    and seq.prefill_prompt is not None
+                    and seq.slot >= 0 and self.slots[seq.slot] is seq):
+                self._prefill_finish(seq, pf["prompt"],
+                                     int(np.asarray(pf["tok"])[0]))
+                seq.prefill_prompt = None
+        if self.telemetry.enabled and outs is not None:
             self.telemetry.tokens_per_dispatch.observe(
                 sum(len(t) for t in result.values()))
+        return result
+
+    def _pressure_settle_round(self) -> Dict[int, List[int]]:
+        """Optimistic admission under watermark pressure: settle device
+        state before any preemption decision — in-flight calls hold
+        predicted-ctx page grants — then run one synchronous round,
+        which preempts as needed (and runs the chaos gate itself:
+        gating in the caller too would double the injected failure rate
+        on this branch). Shared by the plain and hybrid pipelined
+        entry points so the pressure semantics cannot drift."""
+        result = self.drain_pipeline()
+        for rid, toks in self.decode_steps().items():
+            result.setdefault(rid, []).extend(toks)
         return result
 
     def decode_steps_pipelined(self) -> Dict[int, List[int]]:
@@ -1766,15 +2020,7 @@ class InferenceEngine:
         if depth <= 1 or self.spec_enabled:
             return self.decode_steps()         # gate runs inside
         if self.admission == "optimistic" and self.under_pressure:
-            # Settle device state before any preemption decision —
-            # in-flight calls hold predicted-ctx page grants — then run
-            # one synchronous round, which preempts as needed (and runs
-            # the chaos gate itself: gating here too would double the
-            # injected failure rate on this branch).
-            result = self.drain_pipeline()
-            for rid, toks in self.decode_steps().items():
-                result.setdefault(rid, []).extend(toks)
-            return result
+            return self._pressure_settle_round()
         self._chaos_step_gate()
         call = self._stage_decode_call()
         if call is not None:
@@ -1782,6 +2028,50 @@ class InferenceEngine:
         if not self._inflight:
             return {}
         if len(self._inflight) >= depth or call is None:
+            return self._sync_oldest()
+        return {}
+
+    def hybrid_step_pipelined(self, seq: Sequence) -> Dict[int, List[int]]:
+        """Serving step while ``seq`` is mid-incremental-prefill: advance
+        its next chunk AND the decode lanes in ONE fused dispatch
+        (EngineConfig.hybrid_prefill), so running lanes keep producing
+        tokens instead of stalling a chunk wall per chunk.
+
+        Chains into the same dispatch-ahead pipeline as plain decode
+        calls: with depth > 1 the call is non-blocking and only the
+        oldest in-flight call is synced; with depth <= 1 it dispatches
+        and syncs immediately (synchronous mode). Once the prompt is
+        fully staged, further calls degrade to plain decode staging and
+        the final chunk's sampled token folds at its sync — the caller
+        observes completion as ``seq.prefill_prompt is None``.
+        Returns decode tokens folded by this call (possibly {}).
+        """
+        assert not self.spec_enabled, \
+            "hybrid steps don't compose with speculative decoding"
+        depth = max(1, self.engine_cfg.decode_pipeline_depth)
+        if (self.admission == "optimistic" and self.under_pressure
+                and self.active_sequences()):
+            # Pressure settles first (drain + one synchronous preempting
+            # round), then the chunk advances SERIALLY: its pages were
+            # all allocated at prefill_begin, so it cannot deepen the
+            # shortage, and skipping it would starve the prefill for as
+            # long as pressure holds — a liveness regression vs serial
+            # mode, which advances one chunk per iteration regardless.
+            # (The active_sequences guard also protects direct
+            # engine-API drivers: with no lanes there is nothing to
+            # settle and the plain staging path below handles the
+            # chunk.)
+            result = self._pressure_settle_round()
+            if seq.prefill_prompt is not None and not seq.done:
+                self.prefill_step(seq)
+            return result
+        self._chaos_step_gate()
+        call = self._stage_decode_call(prefill_seq=seq)
+        if call is not None:
+            self._inflight.append(call)
+        if not self._inflight:
+            return {}
+        if depth <= 1 or len(self._inflight) >= depth or call is None:
             return self._sync_oldest()
         return {}
 

@@ -93,6 +93,11 @@ class SchedulerStats:
             "admission": engine.admission,
             "preemptions": engine.preemptions_total,
             "recompute_resumes": engine.resumes_total,
+            # Hybrid prefill-decode stepping (README "Scheduling"):
+            # whether chunks fuse into decode dispatches, and how many
+            # fused dispatches have run.
+            "hybrid_prefill": engine.engine_cfg.hybrid_prefill,
+            "hybrid_steps": engine.hybrid_steps_total,
             "pool_pressure": round(engine.pool_pressure, 4),
             "mean_batch_occupancy": occ,
             "kv_pages_total": total,
@@ -301,13 +306,26 @@ class EngineScheduler:
         for p in list(self._callbacks.values()):
             self._finish(p.seq)              # engine thread wedged
 
+    def _hybrid_active(self) -> bool:
+        """True when the in-progress incremental prefill should advance
+        through HYBRID steps (fused into the decode dispatch) instead of
+        the serial one-chunk-per-iteration path: hybrid_prefill is on,
+        speculative decoding is off (the spec round has its own fused
+        graph), and there are decode lanes to fuse with — with an empty
+        batch the serial chunk IS the whole step, so fusing buys
+        nothing and the serial path keeps its simpler bookkeeping."""
+        return (self.engine.engine_cfg.hybrid_prefill
+                and not self.engine.spec_enabled
+                and self._prefilling is not None
+                and bool(self.engine.active_sequences()))
+
     def _needs_chunking(self, seq: Sequence) -> bool:
         """True when the prompt spans several prefill chunks (so it goes
         through the incremental path instead of stalling the batch).
         Conservative: a prefix-cache hit could still shrink it to one.
         Resume prefills measure prompt + already-generated tokens."""
         ecfg = self.engine.engine_cfg
-        cap = ecfg.chunked_prefill_size or ecfg.prefill_buckets[-1]
+        cap = ecfg.chunk_tokens_cap
         base = len(self.engine._prefill_tokens(seq))
         return min(base, ecfg.max_context - 1) > cap
 
@@ -366,10 +384,28 @@ class EngineScheduler:
         and short requests can still batch-admit in the same iteration
         (no head-of-line blocking behind the long prompt)."""
         admitted = 0
-        if self._prefilling is not None:
+        if self._prefilling is not None and not self._hybrid_active():
             # Advancing an ALREADY-admitted prefill by one chunk is not a
-            # new admission; only fresh requests count below.
-            self._step_incremental_prefill()
+            # new admission; only fresh requests count below. With hybrid
+            # stepping active, the chunk instead rides the decode
+            # dispatch later this iteration (run()'s hybrid branch).
+            seq = self._prefilling.seq
+            if seq.done and self.engine.pipeline_pending:
+                # Cancelled with chained hybrid chunks still in flight:
+                # settle their writes before the terminal path below
+                # releases the pages they target.
+                self._deliver(self._drain_safely())
+            self._poll_hybrid_prefill()   # completed at an earlier sync?
+            if self._prefilling is not None:
+                if (not seq.done and seq.prefill_prompt is not None
+                        and seq.prefill_offset >= len(seq.prefill_prompt)):
+                    # Every chunk is already staged into in-flight hybrid
+                    # calls; the final chunk's token folds at its sync —
+                    # nothing to advance serially (and re-dispatching
+                    # would run an empty chunk).
+                    pass
+                else:
+                    self._step_incremental_prefill()
         batch: List[_Pending] = []
         start_chunked: Optional[_Pending] = None
         reserved = 0
@@ -416,6 +452,11 @@ class EngineScheduler:
                 self._finish(seq)
                 return admitted
             self._prefilling = start_chunked
+            if self._hybrid_active():
+                # Decode lanes are running: even the FIRST chunk rides
+                # the fused hybrid dispatch this iteration instead of
+                # stalling them here.
+                return admitted + 1
             self._step_incremental_prefill()
             return admitted + 1
         if not batch:
@@ -439,6 +480,47 @@ class EngineScheduler:
         for pending in batch:
             self._prefill_done(pending)
         return admitted + len(batch)
+
+    def _drain_safely(self) -> Dict[int, List[int]]:
+        """drain_pipeline under the engine loop's keep-alive contract:
+        a device error that surfaces only at sync time (async dispatch
+        on real TPU) fails the affected requests with
+        finish_reason="error" instead of propagating out of run() and
+        killing the engine thread with work still queued."""
+        engine = self.engine
+        try:
+            return engine.drain_pipeline()
+        except Exception as exc:  # noqa: BLE001 — keep the loop alive
+            victims = engine.active_sequences()
+            pending = self._prefilling
+            if pending is not None:
+                self._prefilling = None
+                if pending.seq not in victims:
+                    victims = victims + [pending.seq]
+            self._log_step_error("drain", exc, victims)
+            self._note_error(exc)
+            engine.abort_pipeline()
+            engine.take_preempted()
+            for s in victims:
+                if not s.done:     # a cancelled seq keeps its reason
+                    s.done, s.finish_reason = True, "error"
+                    s.finish_time = time.perf_counter()
+                self._finish(s)
+            return {}
+
+    def _poll_hybrid_prefill(self) -> None:
+        """Hybrid prefills complete at SYNC time (possibly inside a
+        drain): the final chunk's sampled token folds in the engine's
+        _sync_oldest and ``prefill_prompt`` clears. Detect that here and
+        run the shared post-prefill bookkeeping (counters, first-token
+        delivery, immediate finish). A cancel that landed mid-chunks
+        keeps ``prefill_prompt`` set and is handled by the run loop's
+        cancel branch instead."""
+        pending = self._prefilling
+        if pending is None or pending.seq.prefill_prompt is not None:
+            return
+        self._prefilling = None
+        self._prefill_done(pending)
 
     def _requeue_preempted(self) -> None:
         """Move sequences the engine preempted this step back to the
@@ -589,7 +671,10 @@ class EngineScheduler:
                 # Flush any dispatch-ahead calls, then reap
                 # cancelled-in-flight sequences even when idle.
                 if engine.pipeline_pending:
-                    self._deliver(engine.drain_pipeline())
+                    self._deliver(self._drain_safely())
+                    # The drain may have synced a hybrid prefill's final
+                    # chunk (e.g. every decode lane finished mid-chunks).
+                    self._poll_hybrid_prefill()
                 for s in self._reapable():
                     self._finish(s)
                 if self._prefilling is not None:
@@ -601,6 +686,16 @@ class EngineScheduler:
                     time.sleep(self.idle_sleep_s)
                 continue
 
+            hybrid_pf = self._prefilling if self._hybrid_active() else None
+            if hybrid_pf is not None and hybrid_pf.seq.done:
+                # Cancelled mid-hybrid-prefill: settle in-flight chunk
+                # writes BEFORE release frees its pages (a chained chunk
+                # may still be writing them), deliver whatever the drain
+                # surfaced, then run the terminal path.
+                self._deliver(self._drain_safely())
+                self._prefilling = None
+                self._finish(hybrid_pf.seq)
+                hybrid_pf = None
             try:
                 # Latency mode: with a near-empty batch and nothing queued
                 # or in flight, run the single-step graph so each token
@@ -609,7 +704,11 @@ class EngineScheduler:
                 thresh = engine.engine_cfg.latency_decode_threshold
                 t_call = time.perf_counter()
                 self.step_inflight_since = time.monotonic()
-                if (0 < len(active) <= thresh and not self._waiting
+                if hybrid_pf is not None:
+                    # Hybrid step: the in-progress prefill's next chunk
+                    # rides the decode dispatch instead of stalling it.
+                    new_tokens = engine.hybrid_step_pipelined(hybrid_pf.seq)
+                elif (0 < len(active) <= thresh and not self._waiting
                         and self._prefilling is None
                         and not engine.pipeline_pending
                         and not engine.spec_enabled):
@@ -618,11 +717,20 @@ class EngineScheduler:
                     new_tokens = engine.decode_steps_pipelined()
                 self.stats.record_decode_call(time.perf_counter() - t_call)
             except Exception as exc:  # noqa: BLE001 — keep the engine loop alive
-                self._log_step_error("decode", exc, active)
+                victims = list(active)
+                if hybrid_pf is not None:
+                    # The failed dispatch may have carried a prefill
+                    # chunk whose writes are now suspect — the prefilling
+                    # request fails with the batch.
+                    self._prefilling = None
+                    victims.append(hybrid_pf.seq)
+                self._log_step_error(
+                    "hybrid" if hybrid_pf is not None else "decode",
+                    exc, victims)
                 self._note_error(exc)
                 engine.abort_pipeline()   # stale in-flight state would
                 engine.take_preempted()   # poison reused slots; drop any
-                for s in active:          # mid-call preemptions too —
+                for s in victims:         # mid-call preemptions too —
                     s.done, s.finish_reason = True, "error"  # they fail
                     s.finish_time = time.perf_counter()      # with the
                     self._finish(s)                          # batch
@@ -637,7 +745,7 @@ class EngineScheduler:
                 # A finish releases pages a newer in-flight call may still
                 # write: drain first so release happens against settled
                 # device state, and deliver the drained tokens too.
-                extra = engine.drain_pipeline()
+                extra = self._drain_safely()
                 for rid, toks in extra.items():
                     new_tokens.setdefault(rid, []).extend(toks)
             self.stats.tokens_generated += sum(
@@ -647,6 +755,10 @@ class EngineScheduler:
                                                in_use)
 
             self._deliver(new_tokens)
+            # A hybrid prefill completes at sync time (inside the hybrid
+            # step or one of the drains above) — run its post-prefill
+            # bookkeeping before reaping.
+            self._poll_hybrid_prefill()
             self._requeue_preempted()
             for s in self._reapable():
                 self._finish(s)

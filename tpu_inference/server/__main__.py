@@ -89,6 +89,23 @@ def main() -> None:
                    help=">1 keeps that many fused-decode dispatches in "
                         "flight (hides dispatch latency; adds (depth-1)*K "
                         "steps of streaming latency)")
+    p.add_argument("--chunked-prefill-size", type=int, default=0,
+                   help="split multi-chunk prompts into chunks of this "
+                        "many tokens (0 = the largest prefill bucket); "
+                        "smaller chunks interleave/fuse with decode at "
+                        "a finer grain")
+    p.add_argument("--hybrid-prefill", action="store_true",
+                   help="fuse each chunk of a multi-chunk prompt's "
+                        "prefill into the decode dispatch (Sarathi-style "
+                        "piggybacking): running lanes keep producing "
+                        "tokens instead of stalling a chunk wall per "
+                        "chunk; greedy outputs stay byte-identical")
+    p.add_argument("--step-token-budget", type=int, default=0,
+                   help="with --hybrid-prefill: per-fused-step token "
+                        "budget — chunk tokens are capped at budget minus "
+                        "the granted decode tokens (floor: page-size), "
+                        "bounding the prefill compute added to any one "
+                        "decode dispatch; 0 = uncapped")
     p.add_argument("--platform", default="auto",
                    choices=("auto", "cpu", "tpu"),
                    help="jax platform: 'cpu' forces the CPU backend "
@@ -225,6 +242,9 @@ def main() -> None:
                           num_pages=num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
                           decode_pipeline_depth=args.decode_pipeline_depth,
+                          chunked_prefill_size=args.chunked_prefill_size,
+                          hybrid_prefill=args.hybrid_prefill,
+                          step_token_budget=args.step_token_budget,
                           num_speculative_tokens=(
                               args.num_speculative_tokens
                               if args.draft_model else 0))

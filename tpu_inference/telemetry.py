@@ -441,6 +441,8 @@ PHASE_HISTOGRAMS = {
     "decode_sync_s": "decode_sync_s",
     "dispatch_bubble_s": "dispatch_bubble_s",
     "tokens_per_dispatch": "tokens_per_dispatch",
+    "hybrid_dispatch_s": "hybrid_dispatch_s",
+    "decode_stall_during_prefill_s": "decode_stall_during_prefill_s",
     "queue_wait_s": "queue_wait_s",
     "prefill_phase_s": "prefill_phase_s",
     "decode_phase_s": "decode_phase_s",
@@ -467,6 +469,12 @@ class EngineTelemetry:
       waiting for the host (hidden when pipeline depth > 1, but still
       measured so the host overhead is visible).
     - ``tokens_per_dispatch``: tokens surfaced per fused decode call.
+    - ``hybrid_dispatch_s``: host wall of one hybrid prefill+decode
+      fused dispatch (EngineConfig.hybrid_prefill).
+    - ``decode_stall_during_prefill_s``: wall of a serial prefill
+      dispatch issued while decode lanes were active — exactly the
+      inter-token stall hybrid steps exist to remove, so the
+      serial-vs-hybrid replay artifact compares its p95.
 
     Request phases (observed by engine/scheduler.py at finish):
     ``queue_wait_s``, ``prefill_phase_s`` (prefill start -> first
@@ -483,6 +491,7 @@ class EngineTelemetry:
                 setattr(self, attr, NULL_METRIC)
             self.decode_dispatches = NULL_METRIC
             self.prefill_dispatches = NULL_METRIC
+            self.hybrid_steps = NULL_METRIC
             self.degraded_mode = NULL_METRIC
             return
         r = self.registry
@@ -503,6 +512,15 @@ class EngineTelemetry:
             "tpu_inf_tokens_per_dispatch",
             "Tokens surfaced per fused decode call",
             buckets=COUNT_BUCKETS)
+        self.hybrid_dispatch_s = r.histogram(
+            "tpu_inf_hybrid_dispatch_seconds",
+            "Host wall time of one hybrid prefill+decode fused dispatch")
+        self.decode_stall_during_prefill_s = r.histogram(
+            "tpu_inf_decode_stall_during_prefill_seconds",
+            "Wall time active decode lanes sat stalled behind a serial "
+            "chunked-prefill dispatch (structurally zero while hybrid "
+            "steps fuse chunks into the decode dispatch; pressure-"
+            "degraded rounds chunk serially and record their real stalls)")
         self.queue_wait_s = r.histogram(
             "tpu_inf_queue_wait_seconds",
             "Request admission queue wait (enqueue -> prefill start)")
@@ -524,6 +542,9 @@ class EngineTelemetry:
         self.prefill_dispatches = r.counter(
             "tpu_inf_prefill_dispatches_total",
             "Prefill dispatches issued")
+        self.hybrid_steps = r.counter(
+            "tpu_inf_hybrid_steps_total",
+            "Hybrid prefill+decode fused dispatches issued")
         self.degraded_mode = r.gauge(
             "tpu_inf_degraded_mode",
             "1 when serving in a known-degraded configuration (e.g. "
