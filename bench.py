@@ -447,6 +447,128 @@ def hybrid_lane_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def routing_lane_child() -> None:
+    """least-loaded vs prefix-affinity routing comparison through the
+    REAL dp=2 EngineGroup: distinct multi-turn conversations whose every
+    turn resends the full history (the BASELINE config-3 shape). Under
+    least-loaded a returning turn lands on a cold replica ~half the
+    time and re-prefills its whole history; prefix affinity routes it
+    back to the replica holding its pages. Reports per-mode cached
+    prompt tokens, returning-turn TTFT percentiles, tok/s, router
+    warm/cold counts, and a greedy byte-identity check across modes;
+    prints ONE JSON record."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tpu_inference.config import EngineConfig, ServerConfig
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+    from tpu_inference.server.replicas import EngineGroup
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bench_cfg(platform)
+
+    def pctl(xs):
+        if not xs:
+            return {"p50": None, "p95": None}
+        return {"p50": _r(float(np.percentile(xs, 50)), 4),
+                "p95": _r(float(np.percentile(xs, 95)), 4)}
+
+    page_size = 16
+    n_convs = 6
+    turns = 4
+    user_tokens = 48 if on_tpu else 24   # new user message per turn
+    reply_tokens = 32 if on_tpu else 12  # assistant budget per turn
+    max_ctx = turns * (user_tokens + reply_tokens) + page_size
+    pages_per_seq = -(-max_ctx // page_size) + 1
+    buckets = (128, 256, 512) if on_tpu else (32, 64, 128, 256)
+    out = {"lane": "routing", "model": cfg.name, "platform": platform,
+           "dp": 2, "conversations": n_convs, "turns": turns,
+           "user_tokens": user_tokens, "reply_tokens": reply_tokens}
+    transcripts = {}
+    for mode in ("least_loaded", "prefix_affinity"):
+        ecfg = EngineConfig(page_size=page_size,
+                            # Affinity can herd every conversation onto
+                            # one replica: each pool holds them all.
+                            num_pages=pages_per_seq * n_convs + 32,
+                            max_pages_per_seq=pages_per_seq,
+                            max_batch_size=n_convs,
+                            prefill_buckets=buckets,
+                            decode_steps_per_call=8)
+        engines = [InferenceEngine(cfg, ecfg, seed=0) for _ in range(2)]
+        for e in engines:
+            e.warmup()
+        group = EngineGroup(engines, ServerConfig(routing=mode)).start()
+        # Same seed per mode: identical conversations, so the greedy
+        # transcripts must match byte-for-byte across routing modes.
+        rng = np.random.default_rng(0)
+        histories = [rng.integers(1, cfg.vocab_size,
+                                  user_tokens).tolist()
+                     for _ in range(n_convs)]
+        convs = {c: [] for c in range(n_convs)}
+        ttft_first, ttft_return = [], []
+        rid = 0
+        t0 = time.perf_counter()
+        total_tokens = 0
+        for t in range(turns):
+            seqs, events = [], []
+            for c in range(n_convs):
+                seq = Sequence(request_id=rid, prompt_tokens=list(
+                    histories[c]), max_new_tokens=reply_tokens)
+                rid += 1
+                ev = threading.Event()
+                group.submit(seq, lambda s, tok: None,
+                             lambda s, ev=ev: ev.set())
+                seqs.append(seq)
+                events.append(ev)
+            for ev in events:
+                if not ev.wait(240):
+                    raise TimeoutError(f"routing lane deadlocked ({mode})")
+            for c, seq in enumerate(seqs):
+                reply = list(seq.generated)
+                convs[c].append(reply)
+                total_tokens += len(reply)
+                ttft = seq.first_token_time - seq.enqueue_time
+                (ttft_return if t else ttft_first).append(ttft)
+                # Next turn: full history + the reply + a new (distinct
+                # per conversation) user block.
+                histories[c] = (histories[c] + reply + rng.integers(
+                    1, cfg.vocab_size, user_tokens).tolist())
+        wall = time.perf_counter() - t0
+        group.stop(drain=True, timeout=10)
+        transcripts[mode] = convs
+        cached_tokens = sum(s.stats.tokens_prefix_cached
+                            for s in group.schedulers)
+        out[mode] = {
+            "wall_s": _r(wall, 3),
+            "tok_s": _r(total_tokens / wall),
+            "tokens_prefix_cached": cached_tokens,
+            "cached_prompt_pages": cached_tokens // page_size,
+            "route_warm_dispatches": group.route_prefix_hits,
+            "route_cold_dispatches": group.route_cold,
+            "route_hit_pages": sum(st["hit_pages"]
+                                   for st in group._route_stats),
+            "ttft_first_turn_s": pctl(ttft_first),
+            "ttft_returning_s": pctl(ttft_return),
+        }
+        del group, engines
+        gc.collect()
+    ll, aff = out["least_loaded"], out["prefix_affinity"]
+    out["outputs_identical"] = (
+        transcripts["least_loaded"] == transcripts["prefix_affinity"])
+    out["cached_pages_gain"] = (aff["cached_prompt_pages"]
+                                - ll["cached_prompt_pages"])
+    out["returning_ttft_p95_ratio"] = _ratio(
+        aff["ttft_returning_s"]["p95"], ll["ttft_returning_s"]["p95"])
+    out["affinity_wins"] = bool(
+        aff["cached_prompt_pages"] > ll["cached_prompt_pages"]
+        and aff["route_hit_pages"] > ll["route_hit_pages"]
+        and out["outputs_identical"])
+    print(json.dumps(out), flush=True)
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestrator (never imports jax — cannot hang on the tunnel).
 # ---------------------------------------------------------------------------
@@ -685,6 +807,11 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "hybrid_comparison": (
             lanes["hybrid"] if lanes.get("hybrid", {}).get("serial")
             else None),
+        # least-loaded vs prefix-affinity dp routing comparison (cached
+        # pages / returning-turn TTFT) when the lane ran.
+        "routing_comparison": (
+            lanes["routing"] if lanes.get("routing", {}).get("least_loaded")
+            else None),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
         "backends_token_equal": heads_equal,
@@ -795,6 +922,18 @@ def orchestrate() -> None:
         rc, rec = _run_child(["--hybrid-lane"], lane_timeout, env)
         lanes["hybrid"] = rec or {"lane": "hybrid",
                                   "skipped": f"lane-failed rc={rc}"}
+        _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
+    # dp routing comparison lane (least-loaded vs prefix-affinity
+    # through the real EngineGroup): measurement-only extra as well.
+    if give_up:
+        lanes["routing"] = {"lane": "routing",
+                            "skipped": "tpu-wedged-midrun"}
+    elif budget_left() < lane_timeout:
+        lanes["routing"] = {"lane": "routing", "skipped": "budget-exhausted"}
+    else:
+        rc, rec = _run_child(["--routing-lane"], lane_timeout, env)
+        lanes["routing"] = rec or {"lane": "routing",
+                                   "skipped": f"lane-failed rc={rc}"}
     _snapshot(probe, lanes, degraded, partial=False, t_start=t_start)
 
 
@@ -805,6 +944,8 @@ if __name__ == "__main__":
         admission_lane_child()
     elif "--hybrid-lane" in sys.argv:
         hybrid_lane_child()
+    elif "--routing-lane" in sys.argv:
+        routing_lane_child()
     elif "--lane" in sys.argv:
         lane_child(sys.argv[sys.argv.index("--lane") + 1])
     else:

@@ -148,7 +148,7 @@ def start_server(args) -> tuple:
 
     srv = build_server(
         model=args.model, tokenizer=args.tokenizer, tp=args.tp,
-        sp=args.sp, sp_attn=args.sp_attn,
+        sp=args.sp, sp_attn=args.sp_attn, dp=getattr(args, "dp", 1),
         draft_model=args.draft_model, checkpoint=args.checkpoint,
         draft_checkpoint=args.draft_checkpoint,
         warmup=not args.no_warmup,
@@ -163,8 +163,11 @@ def start_server(args) -> tuple:
         kv_quant=getattr(args, "kv_quant", "none"),
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
         admission=getattr(args, "admission", "reserve"),
-        server_overrides={"admission_queue_depth":
-                          getattr(args, "admission_queue_depth", 0)},
+        server_overrides={
+            "admission_queue_depth":
+                getattr(args, "admission_queue_depth", 0),
+            "routing": getattr(args, "routing", "prefix_affinity"),
+            "route_hit_weight": getattr(args, "route_hit_weight", 1.0)},
         num_speculative_tokens=(args.num_speculative_tokens
                                 if args.draft_model else 0),
         # Smoke lane: small prefill buckets so the CPU tier-1 run
@@ -213,6 +216,16 @@ def main() -> dict:
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel prefill degree")
     p.add_argument("--sp-attn", default="ring", choices=("ring", "ulysses"))
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel replicas (each its own submesh, "
+                        "KV pool and scheduler; requests route per "
+                        "--routing)")
+    p.add_argument("--routing", default="prefix_affinity",
+                   choices=("prefix_affinity", "least_loaded"),
+                   help="dp replica routing policy")
+    p.add_argument("--route-hit-weight", type=float, default=1.0,
+                   help="prefix-affinity: routing-score pages one peeked "
+                        "cache-hit page is worth")
     p.add_argument("--draft-model", default=None)
     p.add_argument("--draft-checkpoint", default=None)
     p.add_argument("--num-speculative-tokens", type=int, default=4)
@@ -328,15 +341,17 @@ def main() -> dict:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-        if args.platform == "cpu" and args.tp * args.sp > 1:
+        if args.platform == "cpu" and args.dp * args.tp * args.sp > 1:
             # Only force the virtual-device count when the run actually
             # needs a multi-device mesh: the CPU default is 1 device,
             # and shrinking a host that asked for more (the in-process
             # --smoke test runs inside pytest's 8-device session) would
             # pin the whole process to 1 device before backend init.
+            # (After backend init the call is a harmless no-op, so the
+            # pytest session's 8 devices always win.)
             from tpu_inference.compat import set_cpu_device_count
 
-            set_cpu_device_count(args.tp * args.sp)
+            set_cpu_device_count(args.dp * args.tp * args.sp)
 
     from tpu_inference.engine.autosize import resolve_sizing_args
 
@@ -413,7 +428,8 @@ def run_replay(args) -> dict:
         after_json, _ = scrape_metrics(port, fmt="json")
         after = json.loads(after_json)
         prom_text, prom_ctype = scrape_metrics(port)
-        summary = summarize(metrics, n_chips=args.tp * args.sp)
+        summary = summarize(metrics,
+                            n_chips=getattr(args, "dp", 1) * args.tp * args.sp)
         summary["replay_s"] = round(replay_s, 3)
         summary["server_stats"] = after
         # Admission-mode lane: the occupancy / preemption / shed numbers

@@ -249,6 +249,122 @@ def test_streamed_request_fails_cleanly_not_regenerated():
         group.stop(drain=False, timeout=5.0)
 
 
+# ------------------------------------------------- prefix-affinity routing
+
+
+def test_prefix_affinity_routes_conversations_to_warm_replica():
+    """Returning turns land on the replica holding their KV pages, cold
+    conversations spread by the rotating tie-break, and the routing
+    span/counters surface the decisions."""
+    cfg = _cfg(dp=2)
+    group = build_engine_group(cfg).start()
+    try:
+        t1a = list(range(10, 24))            # 14 tokens, distinct prefixes
+        t1b = list(range(100, 114))
+        rep_a, sa = _submit_and_wait(group, 400, t1a, 6)
+        rep_b, sb = _submit_and_wait(group, 401, t1b, 6)
+        # Rotating tie-break: two cold submissions at equal load do NOT
+        # herd onto replica 0.
+        assert {sa.routed_replica, sb.routed_replica} == {0, 1}
+        assert sa.route_hit_pages == 0 and sb.route_hit_pages == 0
+
+        # Turn 2 resends each history: affinity returns each
+        # conversation to ITS warm replica (loads are equal, so
+        # least-loaded would have rotated instead).
+        h2a = t1a + rep_a + [7, 7]
+        rep2a, fa = _submit_and_wait(group, 402, h2a, 4)
+        assert fa.routed_replica == sa.routed_replica
+        assert fa.route_hit_pages >= 2        # 22-token history, 8/page
+        h2b = t1b + rep_b + [7, 7]
+        rep2b, fb = _submit_and_wait(group, 403, h2b, 4)
+        assert fb.routed_replica == sb.routed_replica
+
+        snap = group.health_snapshot()
+        assert snap["routing"] == "prefix_affinity"
+        assert sum(r["routing"]["hits"] for r in snap["replicas"]) >= 2
+        assert sum(r["routing"]["cold"] for r in snap["replicas"]) >= 2
+        assert group.route_prefix_hits >= 2
+        # /debug/requests spans carry the routing decision.
+        spans = group.recent_snapshot(10)
+        assert any(t["route_hit_pages"] >= 2
+                   and t["routed_replica"] in (0, 1) for t in spans)
+    finally:
+        group.stop(drain=False, timeout=5.0)
+
+
+def test_prefix_affinity_failover_mid_conversation():
+    """Acceptance path: the warm replica dies mid-conversation — the
+    turn routed to it for warmth fails over to the cold sibling and
+    completes with byte-identical greedy tokens, and the quarantined
+    replica receives no further traffic."""
+    cfg = _cfg(dp=2, quarantine_after_failures=1, failover_max_retries=1,
+               quarantine_cooldown_s=3600.0)
+    group = build_engine_group(cfg).start()
+    try:
+        t1 = list(range(30, 44))             # 14 tokens
+        rep1, s1 = _submit_and_wait(group, 500, t1, 6)
+        warm = s1.routed_replica
+        h2 = t1 + rep1 + [7, 7]
+        rep2, s2 = _submit_and_wait(group, 501, h2, 4)
+        assert s2.routed_replica == warm     # conversation stuck warm
+
+        # No-fault baseline for turn 3, then replay it with the warm
+        # replica failing every dispatch: cache reuse and failover are
+        # both output-invariant, so the tokens must match exactly.
+        h3 = h2 + rep2 + [7, 7]              # 28 tokens
+        expect3, s3a = _submit_and_wait(group, 502, h3, 2)
+        assert s3a.routed_replica == warm
+        group.engines[warm].chaos_step_failure_rate = 1.0
+        rep3, s3 = _submit_and_wait(group, 503, h3, 2)
+        assert s3.finish_reason in ("stop", "length")
+        assert rep3 == expect3
+        assert s3.attempt >= 1               # failover resubmission
+        assert s3.routed_replica == 1 - warm
+        assert group.health[warm].state == QUARANTINED
+        assert group.supervision_counters()["retries_succeeded"] >= 1
+
+        # Quarantined-warm replica gets no traffic, warm or cold.
+        rep4, s4 = _submit_and_wait(group, 504, h3, 2)
+        assert s4.routed_replica == 1 - warm
+        assert rep4 == expect3
+    finally:
+        group.engines[0].chaos_step_failure_rate = 0.0
+        group.engines[1].chaos_step_failure_rate = 0.0
+        group.stop(drain=False, timeout=5.0)
+
+
+@pytest.mark.parametrize("hit_weight,expect_warm", [(1.0, False),
+                                                    (8.0, True)])
+def test_pressured_warm_replica_vs_cold_idle(hit_weight, expect_warm):
+    """Affinity composes with preemption pressure: at the default hit
+    weight a warm replica under watermark pressure loses to a cold idle
+    sibling (a preemption-likely placement re-prefills anyway); raising
+    --route-hit-weight lets warmth buy the placement back."""
+    cfg = _cfg(dp=2, route_hit_weight=hit_weight)
+    group = build_engine_group(cfg).start()
+    try:
+        t1 = list(range(50, 64))             # 14 tokens
+        rep1, s1 = _submit_and_wait(group, 600, t1, 6)
+        warm = s1.routed_replica
+        eng = group.engines[warm]
+        # Choke the warm pool to exactly 3 reclaimable pages: below the
+        # preempt watermark (4) yet still enough to admit turn 2, so
+        # the weighted arm can actually run where it routed.
+        target_free = max(0, 3 - eng.prefix_cache.evictable)
+        eng.request_page_pressure(eng.allocator.num_free - target_free)
+        deadline = time.monotonic() + 5
+        while (not eng.under_pressure and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert eng.under_pressure
+
+        h2 = t1 + rep1 + [7, 7]              # 22 tokens, 2 pages warm
+        rep2, s2 = _submit_and_wait(group, 601, h2, 2)
+        assert s2.finish_reason in ("stop", "length")
+        assert (s2.routed_replica == warm) is expect_warm
+    finally:
+        group.stop(drain=False, timeout=5.0)
+
+
 # ------------------------------------------------------- HTTP shedding
 
 
@@ -415,6 +531,20 @@ def test_api_ps_ollama_semantics():
                 == details["parameter_size"])
 
     _run(srv, scenario)
+
+    # dp=2 pins the Ollama semantics under replication: size/size_vram
+    # stay ONE model copy (never dp-multiplied); fleet HBM is
+    # size * replicas via the additive field.
+    srv2 = InferenceServer(_cfg(dp=2, warmup=False))
+
+    async def scenario_dp(client):
+        body = await (await client.get("/api/ps")).json()
+        entry = body["models"][0]
+        assert entry["size"] == int(srv2.engine.weight_bytes)
+        assert entry["size_vram"] == entry["size"]
+        assert entry["replicas"] == 2
+
+    _run(srv2, scenario_dp)
 
 
 def test_traffic_generator_resilience_accounting():

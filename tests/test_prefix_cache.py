@@ -56,6 +56,30 @@ def test_chain_hash_full_pages_only():
     assert _chain_hashes(list(range(16)), 8)[:2] == hs[:2]
 
 
+def test_chain_hash_sensitive_to_every_token():
+    """Micro-assert for the packed-int32 encoding: flipping ANY single
+    token — including values that would collide under a sloppier
+    serialization (0 vs 00, adjacent-block bleed) — changes that page's
+    digest and every digest after it."""
+    base = list(range(100, 116))             # 2 full pages of 8
+    ref = _chain_hashes(base, 8)
+    for i in range(len(base)):
+        mutated = list(base)
+        mutated[i] += 1
+        got = _chain_hashes(mutated, 8)
+        page = i // 8
+        assert got[page] != ref[page], f"token {i} did not change page {page}"
+        assert got[page:] != ref[page:]
+        # Chain property: pages BEFORE the mutated one are untouched.
+        assert got[:page] == ref[:page]
+    # Fixed-width packing is injective where str-joins could collide:
+    # [1, 21] vs [12, 1] concatenate identically as digit strings.
+    assert _chain_hashes([1, 21], 2) != _chain_hashes([12, 1], 2)
+    # Large ids (real vocabs are ~128k) survive the int32 packing.
+    big = _chain_hashes([2**30 + 7] * 8, 8)
+    assert big and big != _chain_hashes([2**30 + 8] * 8, 8)
+
+
 def test_prefix_cache_unit():
     alloc = PageAllocator(16)
     cache = PrefixCache(alloc, page_size=4)
@@ -82,6 +106,75 @@ def test_prefix_cache_unit():
     assert alloc.num_free == 15
     got, n = cache.lookup(tokens)
     assert n == 0 and got == []
+
+
+def test_peek_is_side_effect_free():
+    """The router's peek must neither promote (LRU order), pin
+    (refcounts), nor perturb hit/miss accounting — only count."""
+    alloc = PageAllocator(16)
+    cache = PrefixCache(alloc, page_size=4)
+    old = list(range(8))                     # 2 full pages
+    new = list(range(50, 58))
+    p_old, p_new = alloc.allocate(2), alloc.allocate(2)
+    cache.insert(old, p_old)
+    cache.insert(new, p_new)
+    alloc.free(p_old)
+    alloc.free(p_new)                        # cache holds the only refs
+
+    refs_before = [alloc.refcount(p) for p in p_old + p_new]
+    hits, misses = cache.hits, cache.misses
+    assert cache.peek(old) == 2
+    assert cache.peek(old, max_tokens=7) == 1
+    assert cache.peek(list(range(99, 107))) == 0
+    # No refcount share, no stat movement, only the peek counter.
+    assert [alloc.refcount(p) for p in p_old + p_new] == refs_before
+    assert (cache.hits, cache.misses) == (hits, misses)
+    assert cache.stats()["peeks"] == 3
+
+    # No promotion: `old` was peeked last, but eviction still takes it
+    # first (insertion order = LRU order untouched by peeks).
+    cache.evict(2)
+    assert cache.peek(old) == 0
+    assert cache.peek(new) == 2
+
+    # lookup agreement: peek's count matches what a real lookup takes.
+    got, n = cache.lookup(new)
+    assert len(got) == cache.peek(new) == 2 and n == 8
+    alloc.free(got)
+    cache.clear()
+    assert alloc.num_free == 15              # page 0 = trash page
+
+
+def test_stale_peek_tolerated_under_eviction(setup):
+    """A routing decision counts pages that pressure may evict before
+    the request prefills: the prefill must re-check via lookup and
+    recompute the difference — never trust the peek — and generation
+    output stays byte-identical. The pool comes back clean after the
+    churn (tests/_leak.py invariant)."""
+    model_cfg, params, _ = setup
+    engine = InferenceEngine(model_cfg, _ecfg(num_pages=32), params=params)
+    prompt = list(range(30, 62))             # 4 full pages of 8
+    want = engine.generate([prompt], max_new_tokens=6)[0]
+
+    hit, prompt_pages = engine.peek_prefix_pages(prompt)
+    assert prompt_pages == 4
+    assert hit == 3                          # final token always recomputed
+    # Pressure evicts EVERYTHING the router just counted on.
+    assert engine.prefix_cache.evict(32) > 0
+    assert engine.peek_prefix_pages(prompt)[0] == 0
+    # The request routed on the stale peek still admits and matches.
+    assert engine.generate([prompt], max_new_tokens=6)[0] == want
+
+    # Refcount/eviction invariants under churn: interleave peeks with
+    # admissions and evictions, then require a fully reclaimable pool.
+    for i in range(6):
+        mix = [(7 * i + j) % 256 for j in range(24)]
+        engine.peek_prefix_pages(mix)
+        engine.generate([mix], max_new_tokens=4)
+        engine.prefix_cache.evict(i)
+        engine.peek_prefix_pages(prompt)
+    from tests._leak import assert_pool_clean
+    assert_pool_clean(engine)
 
 
 def test_warm_request_matches_cold(warm_engine, cold_engine):

@@ -506,6 +506,29 @@ class ServerConfig:
     admission_queue_depth: int = 0
     # Retry-After hint (seconds) sent with 429/503 shed responses.
     retry_after_s: float = 1.0
+    # --- Replica routing (server/replicas.py EngineGroup) ---
+    # "prefix_affinity" (default): score every routable replica by the
+    # KV prefill work routing there would cost —
+    #   prompt_pages - route_hit_weight * peeked_hit_pages
+    #     + route_load_pages * load  (+ a pressure penalty)
+    # — and route to the cheapest, so a returning conversation lands on
+    # the replica that already holds its history's pages instead of
+    # re-prefilling it cold (dp-1)/dp of the time. Cold prompts (no
+    # replica holds anything) degrade to least-loaded. "least_loaded":
+    # the legacy load-only policy (the benchmark comparison arm).
+    routing: str = "prefix_affinity"
+    # Pages of prefill compute one peeked cache-hit page is worth in the
+    # routing score. 1.0 = at cost (a hit page saves exactly one page of
+    # prefill). Raising it makes warmth beat load/pressure harder: past
+    # ~1 + (prompt_pages+1)/hit_pages a fully-warm replica under
+    # preemption pressure outbids a cold idle one; at the default a
+    # pressured warm replica loses to a cold idle sibling.
+    route_hit_weight: float = 1.0
+    # Page-equivalents of routing cost charged per queued-or-running
+    # request on a replica — blends queue depth into the affinity score
+    # so warmth cannot herd every conversation onto one overloaded
+    # replica. Not a CLI flag; tune in config when page_size is unusual.
+    route_load_pages: float = 1.0
 
 
 @dataclasses.dataclass

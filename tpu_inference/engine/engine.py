@@ -276,6 +276,11 @@ class Sequence:
     # resubmissions (server/replicas.py) so a resubmitted span is marked.
     trace_id: str = ""
     attempt: int = 0
+    # Routing span (server/replicas.py): which dp replica this attempt
+    # was dispatched to and how many cached prefix pages the router
+    # counted on at decision time (-1/0 when submitted scheduler-direct).
+    routed_replica: int = -1
+    route_hit_pages: int = 0
     # Phase accounting accrued by the engine: wall time of device
     # dispatches this request participated in, and its share of the
     # host-side bubble between decode calls. Shared dispatches accrue
@@ -1017,6 +1022,29 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             n += self.prefix_cache.evictable
         return n
+
+    def peek_prefix_pages(self, tokens: Sequence[int]) -> Tuple[int, int]:
+        """(hit_pages, prompt_pages) the dp router scores this replica
+        with: how many full KV pages of ``tokens`` this engine's prefix
+        cache already holds, and how many pages the prompt needs in
+        total. Mirrors _prefill_setup's truncation (keep the most recent
+        max_context-1 tokens) and its max_tokens cap (the final prompt
+        token is always recomputed for logits), so the peek counts
+        exactly the pages a real prefill here could reuse.
+
+        Side-effect-free and safe to call from any thread (PrefixCache.
+        peek contract); the answer may be stale by the time the request
+        prefills — the router tolerates that, the prefill re-checks.
+        """
+        ecfg = self.engine_cfg
+        prompt_len = min(len(tokens), ecfg.max_context - 1)
+        prompt_pages = kvc.pages_needed(prompt_len, ecfg.page_size)
+        if self.prefix_cache is None or prompt_len <= 1:
+            return 0, prompt_pages
+        prompt = (tokens[-prompt_len:] if len(tokens) > prompt_len
+                  else tokens)
+        hit = self.prefix_cache.peek(prompt, max_tokens=prompt_len - 1)
+        return hit, prompt_pages
 
     @property
     def pool_pressure(self) -> float:

@@ -31,18 +31,32 @@ import hashlib
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from tpu_inference.engine.kv_cache import PageAllocator
 
 
 def _chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
-    """One digest per *full* page, each folding in all prior pages."""
+    """One digest per *full* page, each folding in all prior pages.
+
+    Runs on every admit AND every router peek (dp replicas score each
+    incoming prompt), so the block encoding is fixed-width packed int32
+    via numpy — one bulk tobytes() per page instead of a per-token
+    str/encode/join. Fixed width keeps the encoding injective (token
+    ids are non-negative and < 2**31 for any real vocab), so distinct
+    token blocks can never serialize to the same bytes.
+    """
+    n_pages = len(tokens) // page_size
+    if n_pages == 0:
+        return []
+    blocks = np.asarray(tokens[:n_pages * page_size],
+                        dtype=np.int32).reshape(n_pages, page_size)
     out: List[bytes] = []
     h = b""
-    for start in range(0, len(tokens) - len(tokens) % page_size, page_size):
-        block = tokens[start:start + page_size]
+    for i in range(n_pages):
         d = hashlib.blake2b(digest_size=16)
         d.update(h)
-        d.update(b",".join(str(t).encode() for t in block))
+        d.update(blocks[i].tobytes())
         h = d.digest()
         out.append(h)
     return out
@@ -58,6 +72,7 @@ class PrefixCache:
         self._table: "OrderedDict[bytes, int]" = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.peeks = 0
 
     def __len__(self) -> int:
         return len(self._table)
@@ -68,6 +83,38 @@ class PrefixCache:
         O(1): the allocator maintains the counter on the engine thread,
         so metrics scrapes from other threads read a plain int."""
         return self.allocator.evictable_count
+
+    # ------------------------------------------------------------- peek
+
+    def peek(self, tokens: Sequence[int],
+             max_tokens: Optional[int] = None) -> int:
+        """Length (in full pages) of the longest cached prefix of
+        ``tokens`` — **side-effect-free**: no LRU promotion, no refcount
+        share, no hit/miss accounting. The dp router calls this from
+        HTTP threads to score replicas, so it must neither perturb the
+        engine-thread-owned eviction order nor pin pages a routing
+        decision merely *considered*. Plain dict gets are GIL-atomic, so
+        no lock is needed; a concurrent insert/evict can make the answer
+        stale by a page or two, which the router tolerates (the prefill
+        re-checks with ``lookup`` and simply recomputes the difference).
+        """
+        limit = len(tokens) if max_tokens is None else max_tokens
+        digests = _chain_hashes(tokens, self.page_size)
+        return self.peek_digests(digests[:limit // self.page_size])
+
+    def peek_digests(self, digests: Sequence[bytes]) -> int:
+        """peek() over pre-computed chain digests. The dp router hashes
+        each prompt ONCE and probes every replica's table with the same
+        digest list (all replicas share page_size), so scoring costs one
+        hash pass per request, not one per replica. Same side-effect-free
+        contract as peek()."""
+        n = 0
+        for digest in digests:
+            if digest not in self._table:
+                break
+            n += 1
+        self.peeks += 1
+        return n
 
     # ------------------------------------------------------------- lookup
 
@@ -143,4 +190,5 @@ class PrefixCache:
 
     def stats(self) -> Dict[str, int]:
         return {"entries": len(self._table), "evictable": self.evictable,
-                "hits": self.hits, "misses": self.misses}
+                "hits": self.hits, "misses": self.misses,
+                "peeks": self.peeks}

@@ -586,6 +586,8 @@ class EngineScheduler:
             "request_finish", level="info",
             request_id=seq.trace_id or str(seq.request_id),
             reason=seq.finish_reason, attempt=seq.attempt,
+            routed_replica=seq.routed_replica,
+            route_hit_pages=seq.route_hit_pages,
             preemptions=seq.preemptions,
             prompt_tokens=len(seq.prompt_tokens),
             output_tokens=len(seq.generated),
@@ -615,6 +617,11 @@ class EngineScheduler:
             # can tell a replayed request from a first try.
             "trace_id": seq.trace_id,
             "attempt": seq.attempt,
+            # Routing span: the dp replica this attempt ran on and the
+            # cached prefix pages the router counted on (-1/0 when the
+            # request was submitted scheduler-direct, e.g. tests/bench).
+            "routed_replica": seq.routed_replica,
+            "route_hit_pages": seq.route_hit_pages,
             "finished_unix": round(time.time(), 3),
             "prompt_tokens": len(seq.prompt_tokens),
             "cached_tokens": seq.cached_tokens,

@@ -134,6 +134,19 @@ def main() -> None:
                    help="resubmit a request failed/stranded by a sick "
                         "replica (before any token streamed) to a "
                         "healthy one at most this many times")
+    p.add_argument("--routing", default="prefix_affinity",
+                   choices=("prefix_affinity", "least_loaded"),
+                   help="dp replica routing: 'prefix_affinity' scores "
+                        "replicas by expected re-prefill pages (prompt "
+                        "pages minus a prefix-cache peek) blended with "
+                        "load/pressure so returning conversations land "
+                        "on the replica holding their KV pages; "
+                        "'least_loaded' is the legacy load-only policy")
+    p.add_argument("--route-hit-weight", type=float, default=1.0,
+                   help="prefix-affinity: pages of prefill work one "
+                        "peeked cache-hit page is worth in the routing "
+                        "score (1.0 = at cost; larger lets warmth "
+                        "outbid queue depth and preemption pressure)")
     p.add_argument("--admission-queue-depth", type=int, default=0,
                    help="shed load (429 + Retry-After) when every "
                         "routable replica has this many requests queued "
@@ -220,6 +233,8 @@ def main() -> None:
                           draft_checkpoint=args.draft_checkpoint,
                           enable_debug=args.debug,
                           server_overrides=dict(
+                              routing=args.routing,
+                              route_hit_weight=args.route_hit_weight,
                               step_watchdog_s=args.step_watchdog_s,
                               quarantine_after_failures=args.quarantine_after,
                               quarantine_cooldown_s=args.quarantine_cooldown_s,

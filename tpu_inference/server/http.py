@@ -170,6 +170,8 @@ class InferenceServer:
               else "off")
         cap = scfg.admission_queue_depth or "off"
         print(f"supervision: dp={len(self.group.engines)} "
+              f"routing={scfg.routing} "
+              f"hit_weight={scfg.route_hit_weight:g} "
               f"step_watchdog={wd} "
               f"quarantine_after={scfg.quarantine_after_failures} "
               f"cooldown={scfg.quarantine_cooldown_s:g}s "

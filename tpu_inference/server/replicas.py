@@ -29,11 +29,13 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_inference import telemetry
 from tpu_inference.config import ServerConfig
+from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.prefix_cache import _chain_hashes
 from tpu_inference.engine.scheduler import EngineScheduler
 
 
@@ -170,8 +172,18 @@ class _Tracked:
 
 
 class EngineGroup:
-    """dp EngineSchedulers with least-loaded routing, health supervision,
+    """dp EngineSchedulers with cache-aware routing, health supervision,
     failover, and admission control.
+
+    Routing (ServerConfig.routing): "prefix_affinity" scores every
+    routable replica by the prefill work routing there would cost —
+    expected re-prefill pages (prompt pages minus a side-effect-free
+    prefix-cache peek) blended with queue depth and preemption
+    pressure — so a returning conversation lands on the replica that
+    already holds its history's KV pages. Cold prompts, single-replica
+    fleets, and routing="least_loaded" reduce to the legacy
+    (pressure, load) key, now with a deterministic rotating tie-break
+    (equal-key replicas used to all herd onto replica 0).
 
     With one engine this is a transparent pass-through, so the server
     always talks to an EngineGroup.
@@ -196,6 +208,16 @@ class EngineGroup:
         self.failovers = 0              # stranded-by-wedge resubmissions
         self.requests_shed = 0          # 429: queue cap
         self.requests_unavailable = 0   # 503: no routable replica
+        # Routing accounting. The rotation counter advances once per
+        # tie-broken decision; the counters move on every dispatch
+        # (initial or failover). Plain ints mutated from HTTP/engine
+        # threads: GIL-atomic increments, torn reads tolerated (same
+        # stance as telemetry.py).
+        self._rr = 0                    # rotating tie-break cursor
+        self.route_prefix_hits = 0      # dispatches with peeked hit > 0
+        self.route_cold = 0             # dispatches with no cached prefix
+        self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0}
+                             for _ in engines]
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         # Fleet-level Prometheus registry: supervision counters (no
@@ -221,6 +243,18 @@ class EngineGroup:
         r.counter("tpu_inf_requests_unavailable_total",
                   "Requests rejected with no routable replica (HTTP 503)",
                   fn=lambda: self.requests_unavailable)
+        r.counter("tpu_inf_route_prefix_hits_total",
+                  "Dispatches routed with a non-zero prefix-cache peek "
+                  "(the request landed on a warm replica)",
+                  fn=lambda: self.route_prefix_hits)
+        r.counter("tpu_inf_route_cold_total",
+                  "Dispatches routed with no cached prefix on any scored "
+                  "replica (least-loaded fallback)",
+                  fn=lambda: self.route_cold)
+        self._route_hit_pages_hist = r.histogram(
+            "tpu_inf_route_hit_pages",
+            "Peeked prefix-cache hit pages per warm-routed dispatch",
+            buckets=telemetry.COUNT_BUCKETS)
         for i, health in enumerate(self.health):
             r.gauge("tpu_inf_replica_routable",
                     "1 when the replica accepts traffic (not quarantined)",
@@ -305,13 +339,105 @@ class EngineGroup:
         sibling with free pages avoids entirely."""
         return (sched.engine.under_pressure, sched.load)
 
+    def _rotate(self, ties: list):
+        """Deterministic rotating pick among equal-key candidates.
+        min() always returned the first — under a burst of equal-load
+        (or equally cold) replicas everything herded onto replica 0.
+        The cursor is a plain int: racy increments just skew the
+        rotation, never the correctness of the pick."""
+        if len(ties) == 1:
+            return ties[0]
+        idx = self._rr % len(ties)
+        self._rr += 1
+        return ties[idx]
+
+    def _peek_digests(self, tokens: List[int]) -> Tuple[List[bytes], int]:
+        """Chain-hash the prompt ONCE per routing decision and share
+        the digest list across every scored replica (all replicas serve
+        one EngineConfig, so page_size/max_context agree): scoring costs
+        one hash pass per request, not one per candidate. Mirrors
+        engine.peek_prefix_pages — keep the most recent max_context-1
+        tokens, never count the final prompt token (its logits are
+        always recomputed). Returns (digests, prompt_pages)."""
+        ecfg = self.engines[0].engine_cfg
+        prompt_len = min(len(tokens), ecfg.max_context - 1)
+        prompt_pages = kvc.pages_needed(prompt_len, ecfg.page_size)
+        if prompt_len <= 1:
+            return [], prompt_pages
+        prompt = tokens[-prompt_len:] if len(tokens) > prompt_len else tokens
+        digests = _chain_hashes(prompt, ecfg.page_size)
+        return digests[:(prompt_len - 1) // ecfg.page_size], prompt_pages
+
+    def _pick(self, cands: List[EngineScheduler],
+              seq: Optional[Sequence] = None
+              ) -> Tuple[EngineScheduler, int]:
+        """Choose a replica for one request; returns (scheduler,
+        peeked_hit_pages on that scheduler).
+
+        prefix_affinity with a token-bearing request scores each
+        candidate in KV-page units:
+
+            prompt_pages - route_hit_weight * peek_hit_pages
+              + route_load_pages * load
+              + (prompt_pages + 1 if under preemption pressure)
+
+        i.e. the prefill work this replica would actually redo, plus a
+        queue-depth blend, plus a pressure penalty sized so that at the
+        default hit weight a fully-warm pressured replica still loses
+        to a cold idle one (a pressured replica likely preempts — and
+        recompute-prefills — whatever lands on it); a larger
+        --route-hit-weight buys warmth back past that. Ties break by
+        the legacy (pressure, load) key, then rotate. When NO candidate
+        holds any prefix page (or routing="least_loaded"), the score
+        reduces to (pressure, load) + rotation — plain least-loaded.
+        A single warm candidate is still peeked so the routing counters
+        and span report the true hit (e.g. the lone survivor of a
+        quarantined fleet must not read as a cold dispatch).
+        """
+        cfg = self.server_cfg
+        if seq is not None and cfg.routing == "prefix_affinity":
+            digests, prompt_pages = self._peek_digests(seq.prompt_tokens)
+            hits = []
+            for sched in cands:
+                pc = sched.engine.prefix_cache
+                hits.append(pc.peek_digests(digests)
+                            if pc is not None else 0)
+            if any(hits):
+                scored = []
+                for sched, hit in zip(cands, hits):
+                    score = (prompt_pages - cfg.route_hit_weight * hit
+                             + cfg.route_load_pages * sched.load)
+                    pressured = sched.engine.under_pressure
+                    if pressured:
+                        score += prompt_pages + 1
+                    scored.append(((score, pressured, sched.load),
+                                   sched, hit))
+                best = min(key for key, _, _ in scored)
+                return self._rotate([(s, h) for key, s, h in scored
+                                     if key == best])
+            # Cold everywhere: least-loaded fall-through (hit 0 is the
+            # truth, not an accounting shortcut).
+        keyed = [(self._route_key(sched), sched) for sched in cands]
+        best = min(key for key, _ in keyed)
+        return self._rotate([(s, 0) for key, s in keyed if key == best])
+
+    def _peek_replica(self, sched: EngineScheduler, seq: Sequence) -> int:
+        """One replica's peeked hit pages for a request (accounting on
+        paths that chose by load, e.g. the admission-cap fallback)."""
+        if self.server_cfg.routing != "prefix_affinity":
+            return 0
+        pc = sched.engine.prefix_cache
+        if pc is None:
+            return 0
+        return pc.peek_digests(self._peek_digests(seq.prompt_tokens)[0])
+
     def _least_loaded(self) -> EngineScheduler:
         routable = self._routable()
         if not routable:
             raise FleetUnavailable(
                 "all replicas quarantined",
                 self._retry_after())
-        return min(routable, key=self._route_key)
+        return self._pick(routable)[0]
 
     def _retry_after(self) -> float:
         return self.server_cfg.retry_after_s
@@ -334,39 +460,63 @@ class EngineGroup:
 
     def submit(self, seq: Sequence, on_token: Callable,
                on_finish: Callable) -> None:
-        """Route to the least-loaded healthy replica.
+        """Route to the best healthy replica (prefix affinity blended
+        with load/pressure; see _pick).
 
         Raises FleetUnavailable (no routable replica) or FleetSaturated
         (admission queue cap) instead of queueing — the HTTP layer maps
         these to 503/429 with Retry-After. Scheduler-level rejections
         (queue_full, too_large) still arrive via on_finish.
         """
-        try:
-            sched = self._least_loaded()
-        except FleetUnavailable:
+        routable = self._routable()
+        if not routable:
             with self._lock:
                 self.requests_unavailable += 1
-            raise
+            raise FleetUnavailable(
+                "all replicas quarantined", self._retry_after())
+        sched, hit_pages = self._pick(routable, seq)
         cap = self.server_cfg.admission_queue_depth
         if cap > 0 and sched.load >= cap:
-            with self._lock:
-                self.requests_shed += 1
-            raise FleetSaturated(
-                f"admission queue cap reached ({sched.load} >= {cap} "
-                "on the least-loaded replica)", self._retry_after())
+            # The affinity pick can saturate a warm replica while a cold
+            # sibling still has room: fall back to least-loaded before
+            # shedding, so 429s only fire when the whole fleet is full —
+            # then re-peek the fallback so the span/counters report its
+            # real warmth, not a hardcoded cold.
+            sched = self._pick(routable)[0]
+            hit_pages = self._peek_replica(sched, seq)
+            if sched.load >= cap:
+                with self._lock:
+                    self.requests_shed += 1
+                raise FleetSaturated(
+                    f"admission queue cap reached ({sched.load} >= {cap} "
+                    "on the least-loaded replica)", self._retry_after())
         entry = _Tracked(template=_clone_request(seq), on_token=on_token,
                          on_finish=on_finish, sched=sched)
         with self._lock:
             self._tracked[seq.request_id] = entry
-        self._dispatch(entry, seq, sched)
+        self._dispatch(entry, seq, sched, hit_pages)
 
     def _dispatch(self, entry: _Tracked, seq: Sequence,
-                  sched: EngineScheduler) -> None:
+                  sched: EngineScheduler, hit_pages: int = 0) -> None:
         gen = entry.generation
         entry.sched = sched
         # Mark the span: attempt >= 1 means this is a failover
         # resubmission — the timeline/logs distinguish replays.
         seq.attempt = entry.attempts
+        # Routing span + fleet accounting: every dispatch (initial or
+        # failover resubmission) is one routing decision.
+        idx = self.schedulers.index(sched)
+        seq.routed_replica = idx
+        seq.route_hit_pages = hit_pages
+        stats = self._route_stats[idx]
+        if hit_pages > 0:
+            self.route_prefix_hits += 1
+            stats["hits"] += 1
+            stats["hit_pages"] += hit_pages
+            self._route_hit_pages_hist.observe(hit_pages)
+        else:
+            self.route_cold += 1
+            stats["cold"] += 1
 
         def tok(s: Sequence, t: int) -> None:
             if entry.generation != gen:     # stale attempt (failed over)
@@ -379,12 +529,17 @@ class EngineGroup:
 
         sched.submit(seq, tok, fin)
 
-    def _retry_target(self, failed: EngineScheduler
-                      ) -> Optional[EngineScheduler]:
+    def _retry_target(self, failed: EngineScheduler,
+                      template: Optional[Sequence] = None
+                      ) -> Optional[Tuple[EngineScheduler, int]]:
+        """Replica for a failover resubmission (and its peeked hit
+        pages): affinity composes with failover — the replay prefers a
+        sibling already holding the prompt's pages, but never the
+        scheduler that just failed when an alternative exists."""
         routable = self._routable()
         others = [s for s in routable if s is not failed]
         pool = others or routable           # degraded-but-routable self ok
-        return min(pool, key=self._route_key) if pool else None
+        return self._pick(pool, template) if pool else None
 
     def _attempt_finished(self, entry: _Tracked, seq: Sequence,
                           gen: int) -> None:
@@ -403,7 +558,8 @@ class EngineGroup:
                          and entry.delivered == 0
                          and entry.attempts
                          < self.server_cfg.failover_max_retries)
-            target = self._retry_target(entry.sched) if retryable else None
+            target = (self._retry_target(entry.sched, entry.template)
+                      if retryable else None)
             if target is not None:
                 entry.attempts += 1
                 entry.generation += 1
@@ -413,7 +569,7 @@ class EngineGroup:
                 if entry.attempts and seq.finish_reason in ("stop", "length"):
                     self.retries_succeeded += 1
         if target is not None:
-            self._dispatch(entry, _clone_request(entry.template), target)
+            self._dispatch(entry, _clone_request(entry.template), *target)
             return
         entry.on_finish(seq)
 
@@ -434,7 +590,7 @@ class EngineGroup:
                 if entry.sched is not sched:
                     continue
                 entry.generation += 1
-                target = self._retry_target(sched)
+                target = self._retry_target(sched, entry.template)
                 can_retry = (entry.delivered == 0
                              and entry.attempts
                              < self.server_cfg.failover_max_retries
@@ -453,7 +609,7 @@ class EngineGroup:
                 request_id=entry.template.trace_id or str(rid),
                 resubmitted=can_retry, attempts=entry.attempts)
             if can_retry:
-                self._dispatch(entry, _clone_request(entry.template), target)
+                self._dispatch(entry, _clone_request(entry.template), *target)
             else:
                 ghost = _clone_request(entry.template)
                 ghost.done = True
@@ -480,13 +636,17 @@ class EngineGroup:
         """Operator view served by /healthz: per-replica states + fleet
         status + shed/retry counters."""
         replicas = []
-        for h, e in zip(self.health, self.engines):
+        for i, (h, e) in enumerate(zip(self.health, self.engines)):
             d = h.snapshot()
             # KV-pool pressure view: operators (and load balancers) see
             # which replicas are burning headroom before they quarantine.
             d["pool_pressure"] = round(e.pool_pressure, 4)
             d["under_pressure"] = e.under_pressure
             d["preemptions"] = e.preemptions_total
+            # Affinity view: warm/cold dispatches this replica received
+            # and the cached pages the router counted on — the numbers
+            # that say whether conversations are actually sticking.
+            d["routing"] = dict(self._route_stats[i])
             replicas.append(d)
         routable = sum(1 for h in self.health if h.routable)
         if routable == 0:
@@ -497,6 +657,7 @@ class EngineGroup:
             status = "degraded"
         return {
             "status": status,
+            "routing": self.server_cfg.routing,
             "replicas": replicas,
             "supervision": self.supervision_counters(),
         }
@@ -509,6 +670,8 @@ class EngineGroup:
                 "failovers": self.failovers,
                 "requests_shed": self.requests_shed,
                 "requests_unavailable": self.requests_unavailable,
+                "route_prefix_hits": self.route_prefix_hits,
+                "route_cold": self.route_cold,
                 "preemptions": sum(e.preemptions_total
                                    for e in self.engines),
                 "recompute_resumes": sum(e.resumes_total
