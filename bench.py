@@ -569,6 +569,119 @@ def routing_lane_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def tiering_lane_child() -> None:
+    """Host tier off vs on through a REAL scheduler with the HBM pool
+    sized ~4x below the conversations' KV working set (README "Tiered
+    KV cache"): without the tier, every eviction destroys KV and a
+    returning turn re-prefills its whole history; with it, evicted
+    pages demote to host RAM and swap back in. Reports per-mode cached
+    prompt tokens, returning-turn TTFT percentiles, tok/s, swap
+    counters, and a greedy byte-identity check across modes; prints ONE
+    JSON record."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tpu_inference.config import EngineConfig
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bench_cfg(platform)
+
+    def pctl(xs):
+        if not xs:
+            return {"p50": None, "p95": None}
+        return {"p50": _r(float(np.percentile(xs, 50)), 4),
+                "p95": _r(float(np.percentile(xs, 95)), 4)}
+
+    page_size = 16
+    n_convs = 6
+    turns = 4
+    user_tokens = 48 if on_tpu else 24
+    reply_tokens = 32 if on_tpu else 12
+    per_conv = turns * (user_tokens + reply_tokens)
+    pages_per_seq = -(-per_conv // page_size) + 1
+    ws_pages = n_convs * pages_per_seq
+    num_pages = max(pages_per_seq + 6, ws_pages // 4)
+    buckets = (128, 256, 512) if on_tpu else (32, 64, 128, 256)
+    out = {"lane": "tiering", "model": cfg.name, "platform": platform,
+           "conversations": n_convs, "turns": turns,
+           "hbm_pool_pages": num_pages - 1, "working_set_pages": ws_pages,
+           "working_set_over_pool": _r(ws_pages / (num_pages - 1), 2)}
+    transcripts = {}
+    for mode, host_pages in (("hbm_only", 0), ("tiered", 2 * ws_pages)):
+        ecfg = EngineConfig(page_size=page_size, num_pages=num_pages,
+                            max_pages_per_seq=pages_per_seq,
+                            max_batch_size=4, prefill_buckets=buckets,
+                            decode_steps_per_call=8,
+                            host_cache_pages=host_pages)
+        engine = InferenceEngine(cfg, ecfg, seed=0)
+        engine.warmup()
+        sched = EngineScheduler(engine).start()
+        rng = np.random.default_rng(0)
+        histories = [rng.integers(1, cfg.vocab_size, user_tokens).tolist()
+                     for _ in range(n_convs)]
+        convs = {c: [] for c in range(n_convs)}
+        ttft_first, ttft_return = [], []
+        rid = 0
+        t0 = time.perf_counter()
+        total_tokens = 0
+        for t in range(turns):
+            seqs, events = [], []
+            for c in range(n_convs):
+                seq = Sequence(request_id=rid, prompt_tokens=list(
+                    histories[c]), max_new_tokens=reply_tokens)
+                rid += 1
+                ev = threading.Event()
+                sched.submit(seq, lambda s, tok: None,
+                             lambda s, ev=ev: ev.set())
+                seqs.append(seq)
+                events.append(ev)
+            for ev in events:
+                if not ev.wait(240):
+                    raise TimeoutError(f"tiering lane deadlocked ({mode})")
+            for c, seq in enumerate(seqs):
+                reply = list(seq.generated)
+                convs[c].append(reply)
+                total_tokens += len(reply)
+                ttft = seq.first_token_time - seq.enqueue_time
+                (ttft_return if t else ttft_first).append(ttft)
+                histories[c] = (histories[c] + reply + rng.integers(
+                    1, cfg.vocab_size, user_tokens).tolist())
+        wall = time.perf_counter() - t0
+        sched.stop(drain=True, timeout=10)
+        transcripts[mode] = convs
+        pc = engine.prefix_cache.stats()
+        out[mode] = {
+            "wall_s": _r(wall, 3),
+            "tok_s": _r(total_tokens / wall),
+            "tokens_prefix_cached": sched.stats.tokens_prefix_cached,
+            "offloaded_pages": pc.get("offloaded_pages", 0),
+            "restored_pages": pc.get("restored_pages", 0),
+            "host_evictions": pc.get("host_evictions", 0),
+            "swap_in_resumes": engine.swap_in_resumes,
+            "ttft_first_turn_s": pctl(ttft_first),
+            "ttft_returning_s": pctl(ttft_return),
+        }
+        del sched, engine
+        gc.collect()
+    off, on = out["hbm_only"], out["tiered"]
+    out["outputs_identical"] = (
+        transcripts["hbm_only"] == transcripts["tiered"])
+    out["cached_tokens_gain"] = (on["tokens_prefix_cached"]
+                                 - off["tokens_prefix_cached"])
+    out["returning_ttft_p95_ratio"] = _ratio(
+        on["ttft_returning_s"]["p95"], off["ttft_returning_s"]["p95"])
+    out["tiering_wins"] = bool(
+        on["tokens_prefix_cached"] > off["tokens_prefix_cached"]
+        and on["restored_pages"] > 0
+        and out["outputs_identical"])
+    print(json.dumps(out), flush=True)
+
+
 # ---------------------------------------------------------------------------
 # Parent orchestrator (never imports jax — cannot hang on the tunnel).
 # ---------------------------------------------------------------------------
@@ -934,6 +1047,18 @@ def orchestrate() -> None:
         rc, rec = _run_child(["--routing-lane"], lane_timeout, env)
         lanes["routing"] = rec or {"lane": "routing",
                                    "skipped": f"lane-failed rc={rc}"}
+        _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
+    # Tiered-KV-cache comparison lane (host tier off vs on through the
+    # scheduler, pool ~4x oversubscribed): measurement-only extra too.
+    if give_up:
+        lanes["tiering"] = {"lane": "tiering",
+                            "skipped": "tpu-wedged-midrun"}
+    elif budget_left() < lane_timeout:
+        lanes["tiering"] = {"lane": "tiering", "skipped": "budget-exhausted"}
+    else:
+        rc, rec = _run_child(["--tiering-lane"], lane_timeout, env)
+        lanes["tiering"] = rec or {"lane": "tiering",
+                                   "skipped": f"lane-failed rc={rc}"}
     _snapshot(probe, lanes, degraded, partial=False, t_start=t_start)
 
 
@@ -946,6 +1071,8 @@ if __name__ == "__main__":
         hybrid_lane_child()
     elif "--routing-lane" in sys.argv:
         routing_lane_child()
+    elif "--tiering-lane" in sys.argv:
+        tiering_lane_child()
     elif "--lane" in sys.argv:
         lane_child(sys.argv[sys.argv.index("--lane") + 1])
     else:

@@ -135,6 +135,11 @@ async def _drive(port: int, model: str, conversations: int, turns: int,
 def _summarize(records: list[dict], turns: int) -> dict:
     ttfts = [r["ttft_s"] for r in records if r["ttft_s"] is not None]
     tpots = [r["tpot_s"] for r in records if r["tpot_s"] is not None]
+    # Returning turns (>= 1) are the prefix-cache beneficiaries: their
+    # history was served before, so their TTFT is what tiering/routing
+    # exist to cut. First turns are cold by construction.
+    returning = [r["ttft_s"] for r in records
+                 if r["turn"] > 0 and r["ttft_s"] is not None]
     by_turn = []
     for t in range(turns):
         xs = [r["ttft_s"] for r in records
@@ -144,12 +149,28 @@ def _summarize(records: list[dict], turns: int) -> dict:
         "requests": len(records),
         "output_tokens": int(sum(r["output_tokens"] for r in records)),
         "ttft_s": _percentiles(ttfts, ps=(50, 95, 99)),
+        "ttft_returning_s": _percentiles(returning, ps=(50, 95, 99)),
         "tpot_s": _percentiles(tpots),
         "ttft_p50_by_turn": by_turn,
         "final_prompt_chars_p50": round(float(np.median(
             [r["prompt_chars"] for r in records
              if r["turn"] == turns - 1])), 0) if records else None,
     }
+
+
+def _working_set_pages(records: list[dict], turns: int,
+                       page_size: int) -> int:
+    """The run's KV working set in pages: every conversation's FINAL
+    context (prompt + reply; byte tokenizer => chars ~ tokens), summed.
+    This is what the prefix cache would need resident to serve every
+    returning turn warm — the number the HBM pool is deliberately sized
+    ~5x below in the tiering comparison."""
+    total = 0
+    for r in records:
+        if r["turn"] == turns - 1:
+            total += -(-(r["prompt_chars"] + r["output_tokens"])
+                       // page_size)
+    return total
 
 
 def run_once(args, enable_prefix_cache: bool) -> dict:
@@ -164,10 +185,13 @@ def run_once(args, enable_prefix_cache: bool) -> dict:
         summary["wall_s"] = round(wall, 3)
         summary["tok_s"] = round(summary["output_tokens"] / wall, 2)
         summary["outputs_sha256"] = _outputs_sha256(records)
+        summary["working_set_pages"] = _working_set_pages(
+            records, args.turns, args.page_size)
         stats = srv.group.stats_snapshot()
         summary["prefix_cache_enabled"] = enable_prefix_cache
         summary["tokens_prefix_cached"] = stats.get("tokens_prefix_cached", 0)
         summary["prefix_cache"] = stats.get("prefix_cache")
+        summary["swap_in_resumes"] = stats.get("swap_in_resumes", 0)
         summary["steps"] = stats.get("steps")
         summary["prefills"] = stats.get("prefills")
         # Router view (dp>1): warm/cold dispatch counts and the cached
@@ -253,6 +277,103 @@ def _compare_routing(args) -> dict:
     return result
 
 
+def _compare_tiering(args) -> dict:
+    """Tiered-KV-cache comparison (README "Tiered KV cache"): replay the
+    multi-turn mix against an HBM pool deliberately sized ~5x SMALLER
+    than the conversations' KV working set, twice — host tier off
+    (evictions destroy KV; returning turns re-prefill their history)
+    then on (evictions demote to host RAM; returning turns swap back
+    in) — and commit the side-by-side artifact: total cached tokens
+    served, returning-turn TTFT p95, swap counters, and the byte-
+    identity check on greedy outputs (tiering is a memory-placement
+    decision, never a behavior change)."""
+    # Size the pool from the workload so the working set oversubscribes
+    # it ~working_set_factor x: per-conversation final context ~ turns *
+    # (user message + tag + protocol overhead + reply tokens), byte
+    # tokenizer => chars ~ tokens. The per-sequence cap (and reserve
+    # admission's worst case) still fits inside the pool.
+    if not args.smoke:
+        # Enough concurrent conversations that the working set genuinely
+        # dwarfs the pool even after the one-sequence-must-fit floor on
+        # num_pages below.
+        args.conversations = max(args.conversations, 10)
+    per_conv = args.turns * (65 + args.max_tokens)
+    ws_pages_est = args.conversations * -(-per_conv // args.page_size)
+    per_seq = -(-per_conv // args.page_size) + \
+        -(-args.max_tokens // args.page_size) + 2
+    factor = args.working_set_factor
+    args.num_pages = max(per_seq + 4, int(ws_pages_est / factor))
+    args.max_pages_per_seq = min(args.max_pages_per_seq,
+                                 args.num_pages - 2)
+    # Byte-identity across arms requires every prefill chunk to compile
+    # to ONE query shape: a cold re-prefill (one big bucket) and a warm
+    # tail (small bucket) otherwise run different XLA graphs, whose
+    # reduction orders differ in ulps — enough to flip greedy argmax on
+    # near-ties. Chunking at the smallest bucket pins the shape.
+    if not args.chunked_prefill_size:
+        args.chunked_prefill_size = 16 if args.smoke else 64
+    host_pages = args.host_cache_pages or 2 * ws_pages_est
+    cfg_snapshot = dict(vars(args))
+    # The config block must reproduce the TIERED arm (the hbm_only arm
+    # is the same config with host_cache_pages=0 — recorded per arm).
+    cfg_snapshot["host_cache_pages"] = host_pages
+    summaries = {}
+    for mode, pages in (("hbm_only", 0), ("tiered", host_pages)):
+        args.host_cache_pages = pages
+        print(f"[multiturn] tiering={mode} lane "
+              f"(num_pages={args.num_pages}, host_cache_pages={pages})",
+              file=sys.stderr)
+        summaries[mode] = run_once(args, enable_prefix_cache=True)
+    off, on = summaries["hbm_only"], summaries["tiered"]
+    pool = args.num_pages - 1
+    ws = max(off["working_set_pages"], on["working_set_pages"])
+    tiered_pc = on.get("prefix_cache") or {}
+    comparison = {
+        "hbm_pool_pages": pool,
+        "host_cache_pages": host_pages,
+        "working_set_pages": ws,
+        "working_set_over_pool": round(ws / pool, 2),
+        "cached_tokens_hbm_only": off["tokens_prefix_cached"],
+        "cached_tokens_tiered": on["tokens_prefix_cached"],
+        "offloaded_pages": tiered_pc.get("offloaded_pages", 0),
+        "restored_pages": tiered_pc.get("restored_pages", 0),
+        "swap_in_resumes": on.get("swap_in_resumes", 0),
+        "ttft_returning_p95_hbm_only_s": off["ttft_returning_s"]["p95"],
+        "ttft_returning_p95_tiered_s": on["ttft_returning_s"]["p95"],
+        "tok_s_hbm_only": off["tok_s"],
+        "tok_s_tiered": on["tok_s"],
+        # Greedy decoding + identical weights/seed: tiering must be a
+        # pure memory-placement decision.
+        "outputs_identical": bool(
+            off["outputs_sha256"] == on["outputs_sha256"]),
+        # Wall-clock TTFT swings on a loaded CI box, so the claim is
+        # split (same stance as the routing artifact): the
+        # deterministic part — strictly more cached tokens served, with
+        # real demote/restore traffic, byte-identically — is what the
+        # tier-1 smoke asserts; the latency win is graded on the
+        # artifact actually committed.
+        "ttft_returning_p95_improved": bool(
+            on["ttft_returning_s"]["p95"] is not None
+            and off["ttft_returning_s"]["p95"] is not None
+            and on["ttft_returning_s"]["p95"]
+            < off["ttft_returning_s"]["p95"]),
+        "tiering_wins": bool(
+            on["tokens_prefix_cached"] > off["tokens_prefix_cached"]
+            and tiered_pc.get("restored_pages", 0) > 0
+            and off["outputs_sha256"] == on["outputs_sha256"]),
+    }
+    out = {"config": cfg_snapshot, "hbm_only": off, "tiered": on,
+           "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    result = dict(comparison)
+    result["hbm_only"], result["tiered"] = off, on
+    return result
+
+
 def main() -> dict:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--model", default="tiny-llama")
@@ -280,7 +401,26 @@ def main() -> dict:
     p.add_argument("--route-hit-weight", type=float, default=1.0,
                    help="prefix-affinity: routing-score pages one peeked "
                         "cache-hit page is worth")
+    p.add_argument("--route-host-hit-weight", type=float, default=0.5,
+                   help="prefix-affinity: routing-score pages one peeked "
+                        "HOST-tier hit page is worth (HBM-warm > "
+                        "host-warm > cold)")
+    p.add_argument("--host-cache-pages", type=int, default=0,
+                   help="host-RAM KV tier capacity (0 = off; "
+                        "--compare-tiering sizes it from the working "
+                        "set when left at 0)")
+    p.add_argument("--working-set-factor", type=float, default=5.0,
+                   help="--compare-tiering: size the HBM pool so the "
+                        "conversations' KV working set oversubscribes "
+                        "it by about this factor")
     p.add_argument("--max-batch-size", type=int, default=8)
+    p.add_argument("--chunked-prefill-size", type=int, default=0,
+                   help="prefill chunk tokens (0 = largest bucket); the "
+                        "tiering comparison pins it to the smallest "
+                        "bucket so every chunk compiles to ONE query "
+                        "shape and greedy outputs stay byte-identical "
+                        "across arms (XLA reduction order is "
+                        "shape-dependent)")
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--max-pages-per-seq", type=int, default=64)
@@ -303,6 +443,12 @@ def main() -> dict:
                         "then prefix-affinity routing and commit a "
                         "prefix-hit-pages / TTFT / tok_s comparison "
                         "artifact with a byte-identity check")
+    p.add_argument("--compare-tiering", action="store_true",
+                   help="replay the mix with the HBM pool sized ~5x "
+                        "below the KV working set, host tier off vs on, "
+                        "and commit a cached-tokens / returning-TTFT / "
+                        "swap-traffic artifact with a byte-identity "
+                        "check")
     p.add_argument("--smoke", action="store_true",
                    help="CPU smoke lane (tier-1): tiny model, small "
                         "conversation mix, small engine + prefill "
@@ -311,9 +457,9 @@ def main() -> dict:
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
-    if args.compare and args.compare_routing:
-        p.error("--compare and --compare-routing are mutually exclusive; "
-                "run them as separate invocations")
+    if sum((args.compare, args.compare_routing, args.compare_tiering)) > 1:
+        p.error("--compare / --compare-routing / --compare-tiering are "
+                "mutually exclusive; run them as separate invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -322,14 +468,28 @@ def main() -> dict:
         # every turn's history re-lands on page boundaries quickly.
         args.model, args.tokenizer = "tiny-llama", "byte"
         args.platform = "cpu"
-        args.conversations = min(args.conversations, 4)
+        # ODD conversation count: with an even count and a near-idle
+        # fleet, the rotating tie-break cursor's parity can stay
+        # constant per conversation, giving the least-loaded arm
+        # accidental perfect stickiness (both arms fully warm -> the
+        # routing comparison flakes to a tie on fast boxes). An odd
+        # count flips the parity every round, so least-loaded provably
+        # migrates conversations across replicas.
+        args.conversations = min(args.conversations, 5)
         args.turns = min(args.turns, 4)
         args.max_tokens = min(args.max_tokens, 12)
         args.max_batch_size, args.num_pages = 4, 256
         args.page_size, args.max_pages_per_seq = 8, 48
         args.decode_steps_per_call = 4
+        if args.compare_tiering:
+            # The tiering smoke needs real churn in seconds: a ~3x
+            # oversubscribed pool is enough to force demotes/restores
+            # on CPU (_compare_tiering recomputes num_pages from this).
+            args.working_set_factor = min(args.working_set_factor, 3.0)
         if args.out is None and args.compare_routing:
             args.out = "benchmarks/results/multiturn_routing.json"
+        if args.out is None and args.compare_tiering:
+            args.out = "benchmarks/results/multiturn_tiering.json"
 
     if args.platform != "auto":
         # Before any jax computation (env vars are read too early in
@@ -347,6 +507,8 @@ def main() -> dict:
 
     if args.compare_routing:
         return _compare_routing(args)
+    if args.compare_tiering:
+        return _compare_tiering(args)
 
     # Snapshot before run_once mutates args (enable_prefix_cache toggles).
     out = {"config": dict(vars(args))}

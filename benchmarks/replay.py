@@ -162,12 +162,15 @@ def start_server(args) -> tuple:
         quant=getattr(args, "quant", "none"),
         kv_quant=getattr(args, "kv_quant", "none"),
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
+        host_cache_pages=getattr(args, "host_cache_pages", 0),
         admission=getattr(args, "admission", "reserve"),
         server_overrides={
             "admission_queue_depth":
                 getattr(args, "admission_queue_depth", 0),
             "routing": getattr(args, "routing", "prefix_affinity"),
-            "route_hit_weight": getattr(args, "route_hit_weight", 1.0)},
+            "route_hit_weight": getattr(args, "route_hit_weight", 1.0),
+            "route_host_hit_weight":
+                getattr(args, "route_host_hit_weight", 0.5)},
         num_speculative_tokens=(args.num_speculative_tokens
                                 if args.draft_model else 0),
         # Smoke lane: small prefill buckets so the CPU tier-1 run
@@ -226,6 +229,12 @@ def main() -> dict:
     p.add_argument("--route-hit-weight", type=float, default=1.0,
                    help="prefix-affinity: routing-score pages one peeked "
                         "cache-hit page is worth")
+    p.add_argument("--route-host-hit-weight", type=float, default=0.5,
+                   help="prefix-affinity: routing-score pages one peeked "
+                        "HOST-tier hit page is worth")
+    p.add_argument("--host-cache-pages", type=int, default=0,
+                   help="host-RAM KV tier capacity in pages (0 = off; "
+                        "README 'Tiered KV cache')")
     p.add_argument("--draft-model", default=None)
     p.add_argument("--draft-checkpoint", default=None)
     p.add_argument("--num-speculative-tokens", type=int, default=4)

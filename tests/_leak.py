@@ -20,8 +20,30 @@ def assert_pool_clean(engine) -> None:
     assert not engine._preempted_out, \
         "preempted sequences never collected (take_preempted)"
     engine.set_page_pressure(0)
-    if engine.prefix_cache is not None:
-        engine.prefix_cache.clear()
+    cache = engine.prefix_cache
+    if cache is not None and cache.host_pool is not None:
+        # Host-tier accounting invariant BEFORE the clear: the pool's
+        # page/byte counters must agree with the entries actually
+        # resident, and no digest may live in both tiers at once.
+        pool = cache.host_pool
+        assert pool.used == len(cache._host), (
+            f"host-tier page accounting drifted: pool says {pool.used}, "
+            f"table holds {len(cache._host)}")
+        assert pool.bytes_resident == sum(
+            e.nbytes for e in cache._host.values()), \
+            "host-tier byte accounting drifted"
+        assert 0 <= pool.used <= pool.capacity, (
+            f"host pool over capacity: {pool.used}/{pool.capacity}")
+        overlap = set(cache._host) & set(cache._table)
+        assert not overlap, \
+            f"digests resident in BOTH tiers: {len(overlap)}"
+    if cache is not None:
+        cache.clear()
+        if cache.host_pool is not None:
+            assert cache.host_pool.used == 0, \
+                "host pool pages leaked after clear"
+            assert cache.host_pool.bytes_resident == 0, \
+                "host pool bytes leaked after clear"
     alloc = engine.allocator
     expected = alloc.num_pages - 1          # page 0 = trash page
     leaked = [p for p in range(1, alloc.num_pages) if alloc._refs[p] > 0]

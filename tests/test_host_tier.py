@@ -1,0 +1,521 @@
+"""Tiered KV cache: host-RAM offload, async swap-in, and the invariants
+that make it invisible to generation output (README "Tiered KV cache").
+
+The acceptance contract pinned here:
+- demote -> promote round-trips are BIT-identical at the pool level,
+  for bf16, int8 and nibble-packed int4 layouts alike;
+- a digest lives in the HBM table OR the host table, never both (the
+  publish path supersedes stale host copies);
+- host-pool page/byte accounting never leaks (tests/_leak.py grew the
+  host invariant and every churn test here runs it);
+- with zero host capacity, eviction degrades to the classic
+  free-on-evict behavior byte-for-byte;
+- a preempted-then-resumed sequence RESTORES its pages from the host
+  tier instead of re-prefilling when capacity allows (swap-in-resume),
+  with byte-identical greedy output;
+- the queue-wait prefetch promotes host pages into cache-owned device
+  pages before admission, so the prefill sees plain HBM hits;
+- evict() pops victims from the evictable-ordered table (oldest
+  released first) and never touches share-pinned entries — the
+  O(table)-scan fix, pinned under churn;
+- one _chain_hashes pass per routed request (route -> admit -> publish
+  share the digests instead of re-hashing three times).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tests._leak import assert_pool_clean
+from tpu_inference import config as cfgs
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.engine.kv_cache import HostPagePool, PageAllocator
+from tpu_inference.engine.prefix_cache import (PrefixCache, _chain_hashes,
+                                               extend_chain_hashes)
+
+MODEL = cfgs.tiny_llama(vocab_size=256)
+
+
+def _ecfg(**kw):
+    base = dict(page_size=8, num_pages=14, max_pages_per_seq=8,
+                max_batch_size=2, prefill_buckets=(16, 32, 64),
+                decode_steps_per_call=4, host_cache_pages=64)
+    base.update(kw)
+    return cfgs.EngineConfig(**base)
+
+
+# ---------------------------------------------------------- pool round-trip
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8", "int4"])
+def test_offload_restore_roundtrip_bit_identical(kv_quant):
+    """Pool bytes written to pages, offloaded to host, and restored into
+    DIFFERENT page ids must compare bit-equal in the stored layout —
+    including int8 codes + scales and uint8 nibble-packed int4."""
+    ecfg = cfgs.EngineConfig(page_size=4, num_pages=16, max_pages_per_seq=4,
+                             max_batch_size=2, kv_quant=kv_quant)
+    kv = kvc.alloc_kv_pages(MODEL, ecfg)
+    rng = np.random.default_rng(0)
+    # Write two pages of sequence 0 (pages 1, 2) with random K/V.
+    bt = np.zeros((1, 4), np.int32)
+    bt[0, :2] = [1, 2]
+    s = 8                                    # 2 full pages of 4
+    positions = np.arange(s, dtype=np.int32)[None]
+    valid = np.ones((1, s), bool)
+    slots = kvc.slot_mapping(np.asarray(bt), positions, valid, 4)
+    k_new = rng.standard_normal((1, s, MODEL.n_kv_heads, MODEL.head_dim),
+                                np.float32)
+    v_new = rng.standard_normal((1, s, MODEL.n_kv_heads, MODEL.head_dim),
+                                np.float32)
+    for layer in range(MODEL.n_layers):
+        kv = kvc.write_kv(kv, layer, k_new * (layer + 1), v_new, slots)
+
+    host = kvc.offload_pages(kv, [1, 2])
+    assert len(host) == 2
+    # Restore into fresh page ids 5, 6 and compare the stored bytes.
+    kv = kvc.restore_pages(kv, [5, 6], host)
+    for src, dst in ((1, 5), (2, 6)):
+        np.testing.assert_array_equal(np.asarray(kv.k[:, src]),
+                                      np.asarray(kv.k[:, dst]))
+        np.testing.assert_array_equal(np.asarray(kv.v[:, src]),
+                                      np.asarray(kv.v[:, dst]))
+        if kv.quantized:
+            np.testing.assert_array_equal(np.asarray(kv.k_scale[:, src]),
+                                          np.asarray(kv.k_scale[:, dst]))
+            np.testing.assert_array_equal(np.asarray(kv.v_scale[:, src]),
+                                          np.asarray(kv.v_scale[:, dst]))
+
+
+# ---------------------------------------------------------- unit: demote
+
+
+def _fake_offload(pages):
+    """Standalone offload_fn: one tiny distinct array per page so byte
+    accounting is exercised without a device pool."""
+    return [kvc.HostKVPage(k=np.full((1, 2), p, np.int8),
+                           v=np.full((1, 2), -p, np.int8))
+            for p in pages]
+
+
+def test_evict_demotes_and_lookup_restores_ownership():
+    alloc = PageAllocator(16)
+    pool = HostPagePool(8)
+    cache = PrefixCache(alloc, page_size=4, host_pool=pool,
+                        offload_fn=_fake_offload)
+    tokens = list(range(12))                 # 3 full pages
+    pages = alloc.allocate(3)
+    cache.insert(tokens, pages)
+    alloc.free(pages)                        # cache holds the only refs
+    assert cache.evict(3) == 3               # all demote
+    assert alloc.num_free == 15 and len(cache) == 0
+    assert pool.used == 3 and len(cache._host) == 3
+
+    got, host_entries, n = cache.lookup(tokens)
+    assert n == 12 and got == [None, None, None]
+    assert [i for i, _, _ in host_entries] == [0, 1, 2]
+    # Host entries left the tier (ownership passed to the caller).
+    assert pool.used == 0 and len(cache._host) == 0
+    # A failed restore returns them.
+    cache.readmit_host([(d, e) for _, d, e in host_entries])
+    assert pool.used == 3 and len(cache._host) == 3
+    cache.clear()
+    assert pool.used == 0 and pool.bytes_resident == 0
+
+
+def test_readmit_never_exceeds_host_capacity():
+    """A failed restore readmits its taken entries — but an intervening
+    demote may have refilled the freed slots (evict runs inside the very
+    allocation that failed), so readmit drops what no longer fits
+    instead of blowing past the RAM cap."""
+    alloc = PageAllocator(32)
+    pool = HostPagePool(2)
+    cache = PrefixCache(alloc, page_size=4, host_pool=pool,
+                        offload_fn=_fake_offload)
+    a = list(range(8))
+    pa = alloc.allocate(2)
+    cache.insert(a, pa)
+    alloc.free(pa)
+    cache.evict(2)                           # host full: a0, a1
+    _, taken_entries, _ = cache.lookup(a)    # pops both (used = 0)
+    taken = [(d, e) for _, d, e in taken_entries]
+    b = list(range(40, 48))                  # refill host via a demote
+    pb = alloc.allocate(2)
+    cache.insert(b, pb)
+    alloc.free(pb)
+    cache.evict(2)                           # host full again: b0, b1
+    assert pool.used == 2
+    cache.readmit_host(taken)                # nothing fits — dropped
+    assert pool.used == 2 and len(cache._host) == 2
+    assert pool.bytes_resident == sum(e.nbytes
+                                      for e in cache._host.values())
+    cache.clear()
+    assert pool.used == 0
+
+
+def test_zero_host_capacity_degrades_to_free_on_evict():
+    alloc = PageAllocator(16)
+    pool = HostPagePool(0)
+    cache = PrefixCache(alloc, page_size=4, host_pool=pool,
+                        offload_fn=_fake_offload)
+    tokens = list(range(8))
+    pages = alloc.allocate(2)
+    cache.insert(tokens, pages)
+    alloc.free(pages)
+    assert cache.evict(2) == 2
+    assert alloc.num_free == 15
+    assert pool.used == 0 and pool.offloaded_total == 0
+    got, host_entries, n = cache.lookup(tokens)
+    assert n == 0 and got == [] and host_entries == []
+
+
+def test_second_tier_eviction_when_host_runs_dry():
+    alloc = PageAllocator(32)
+    pool = HostPagePool(2)                   # room for two pages only
+    cache = PrefixCache(alloc, page_size=4, host_pool=pool,
+                        offload_fn=_fake_offload)
+    a, b = list(range(8)), list(range(50, 58))
+    pa, pb = alloc.allocate(2), alloc.allocate(2)
+    cache.insert(a, pa)
+    cache.insert(b, pb)
+    alloc.free(pa + pb)
+    assert cache.evict(2) == 2               # a's pages demote (fills host)
+    assert pool.used == 2
+    assert cache.evict(2) == 2               # b demotes; a drops (2nd tier)
+    assert pool.used == 2 and pool.evicted_total == 2
+    assert cache.peek(a) == 0 and cache.peek(b) == 2
+
+
+def test_oversized_victim_batch_never_flushes_host_tier():
+    """A demote batch larger than the whole host capacity keeps the
+    newest capacity-many victims and must not drop unrelated resident
+    entries beyond what it can actually use."""
+    alloc = PageAllocator(32)
+    pool = HostPagePool(2)
+    cache = PrefixCache(alloc, page_size=4, host_pool=pool,
+                        offload_fn=_fake_offload)
+    resident = list(range(900, 908))         # 2 pages already resident
+    pr = alloc.allocate(2)
+    cache.insert(resident, pr)
+    alloc.free(pr)
+    cache.evict(2)
+    assert pool.used == 2 and cache.peek(resident) == 2
+    big = list(range(100, 124))              # 6 pages — 3x host capacity
+    pb = alloc.allocate(6)
+    cache.insert(big, pb)
+    alloc.free(pb)
+    assert cache.evict(6) == 6
+    # Host holds exactly capacity pages: the NEWEST two of the batch.
+    assert pool.used == 2
+    hbm, host = cache.peek_digests_tiered(
+        extend_chain_hashes(big, 4, []))
+    assert (hbm, host) == (0, 0)             # prefix broken: pages 0-3 gone
+    assert len(cache._host) == 2
+    cache.clear()
+
+
+def test_tier_invariant_publish_supersedes_host():
+    """A fresh HBM publish of a digest the host tier still holds must
+    drop the host copy — a digest never lives in both tiers."""
+    alloc = PageAllocator(16)
+    pool = HostPagePool(8)
+    cache = PrefixCache(alloc, page_size=4, host_pool=pool,
+                        offload_fn=_fake_offload)
+    tokens = list(range(8))
+    pages = alloc.allocate(2)
+    cache.insert(tokens, pages)
+    alloc.free(pages)
+    cache.evict(2)                           # both pages now host-tier
+    assert len(cache._host) == 2
+    # A sequence that recomputed the same prefix publishes new pages.
+    fresh = alloc.allocate(2)
+    cache.insert(tokens, fresh)
+    assert not (set(cache._host) & set(cache._table))
+    assert pool.used == 0                    # superseded copies dropped
+    assert pool.evicted_total == 2
+    alloc.free(fresh)
+    cache.clear()
+
+
+def test_evictable_order_skips_pinned_entries():
+    """The O(table)-scan fix: evict() consumes the evictable-ordered
+    table (oldest released first) and never walks share-pinned entries.
+    Pinned behavior: a pinned digest survives any evict; once released
+    it becomes the NEWEST evictable entry."""
+    alloc = PageAllocator(32)
+    cache = PrefixCache(alloc, page_size=4)
+    streams = [list(range(i * 10, i * 10 + 4)) for i in range(4)]
+    pages = {}
+    for i, s in enumerate(streams):
+        pg = alloc.allocate(1)
+        cache.insert(s, pg)
+        pages[i] = pg[0]
+    # Streams 0..3 inserted in order; keep 0 pinned (seq still running),
+    # release 1..3 in the order 2, 3, 1.
+    for i in (2, 3, 1):
+        alloc.free([pages[i]])
+    alloc.free([])                           # no-op
+    assert cache.evictable == 3
+    assert list(cache._evict_order) == [
+        _chain_hashes(streams[i], 4)[0] for i in (2, 3, 1)]
+    # Evict 2: takes 2 then 3 (release order), never pinned 0.
+    assert cache.evict(2) == 2
+    assert cache.peek(streams[0]) == 1       # pinned survivor
+    assert cache.peek(streams[1]) == 1
+    assert cache.peek(streams[2]) == 0 and cache.peek(streams[3]) == 0
+    # Releasing the pin makes stream 0 the newest evictable entry.
+    alloc.free([pages[0]])
+    assert list(cache._evict_order) == [
+        _chain_hashes(streams[i], 4)[0] for i in (1, 0)]
+    assert cache.evict(10) == 2
+    assert len(cache) == 0
+    assert alloc.num_free == 31
+
+
+def test_evictable_order_tracks_churn(setup_engine=None):
+    """Interleaved admit/release/evict churn keeps the evictable-ordered
+    table exactly consistent with the allocator's counter."""
+    eng = InferenceEngine(MODEL, _ecfg(num_pages=20), seed=0)
+    rng = np.random.default_rng(3)
+    for i in range(12):
+        prompt = rng.integers(0, 256, 17 + (i % 5)).tolist()
+        eng.generate([prompt], max_new_tokens=4)
+        assert len(eng.prefix_cache._evict_order) == \
+            eng.allocator.evictable_count
+        for d in eng.prefix_cache._evict_order:
+            page = eng.prefix_cache._table[d]
+            assert eng.allocator.refcount(page) == 1
+        assert not (set(eng.prefix_cache._host)
+                    & set(eng.prefix_cache._table))
+    assert_pool_clean(eng)
+
+
+# ------------------------------------------------------- engine integration
+
+
+def test_generation_byte_identical_under_tier_churn():
+    """Working set far beyond the HBM pool: outputs must match a cold
+    engine exactly while pages demote and restore underneath."""
+    eng = InferenceEngine(MODEL, _ecfg(), seed=0)
+    cold = InferenceEngine(MODEL, _ecfg(num_pages=64, host_cache_pages=0,
+                                        enable_prefix_cache=False), seed=0)
+    prompts = [list(range(i * 7, i * 7 + 30)) for i in range(5)]
+    want = [cold.generate([p], max_new_tokens=6)[0] for p in prompts]
+    for _ in range(3):
+        for i, p in enumerate(prompts):
+            assert eng.generate([p], max_new_tokens=6)[0] == want[i]
+    st = eng.prefix_cache.stats()
+    assert st["offloaded_pages"] > 0, "pool never pressured into demotes"
+    assert st["restored_pages"] > 0, "returning prompts never swapped in"
+    assert_pool_clean(eng)
+
+
+def test_preempt_then_swap_in_resume_byte_identical():
+    """The acceptance pin: a preempted sequence whose published pages
+    demoted to host RESTORES them at resume (swap-in-resume) instead of
+    re-prefilling, with byte-identical greedy output."""
+    prompt = list(range(1, 13))
+    baseline = InferenceEngine(
+        MODEL, _ecfg(num_pages=40, max_pages_per_seq=16, max_batch_size=4,
+                     host_cache_pages=0), seed=0).generate(
+        [prompt], max_new_tokens=16)[0]
+
+    eng = InferenceEngine(
+        MODEL, _ecfg(num_pages=40, max_pages_per_seq=16, max_batch_size=4,
+                     admission="optimistic"), seed=0)
+    seq = Sequence(request_id=0, prompt_tokens=list(prompt),
+                   max_new_tokens=16)
+    eng.prefill(seq)
+    while len(seq.generated) < 6:
+        eng.decode_steps(max_steps=1)
+    eng.preempt(seq)
+    assert eng.take_preempted() == [seq]
+    # The pressure that preempted it now evicts the whole HBM cache —
+    # with the host tier, the published pages survive as host copies.
+    assert eng.prefix_cache.evict(100) > 0
+    assert len(eng.prefix_cache) == 0
+    assert eng.prefix_cache.stats()["host_entries"] > 0
+
+    eng.prefill(seq)                         # resume
+    assert seq.host_restored_pages > 0, \
+        "resume re-prefilled instead of restoring from the host tier"
+    assert seq.cached_tokens > 0
+    assert eng.swap_in_resumes == 1
+    while eng.active_sequences():
+        eng.decode_steps()
+    assert seq.generated == baseline
+    eng.release(seq)
+    assert_pool_clean(eng)
+
+
+def test_queue_wait_prefetch_promotes_host_pages():
+    """prefetch_host_hits restores a WAITING request's host pages into
+    cache-owned device pages, so the later prefill sees HBM hits (no
+    swap inside TTFT) — and the promoted pages stay ordinary evictable
+    entries."""
+    eng = InferenceEngine(MODEL, _ecfg(num_pages=24, max_pages_per_seq=8),
+                          seed=0)
+    prompt = list(range(40, 70))             # 3 full pages of 8
+    want = eng.generate([prompt], max_new_tokens=6)[0]
+    assert eng.prefix_cache.evict(100) > 0   # demote everything
+    assert eng.prefix_cache.stats()["host_entries"] > 0
+
+    seq = Sequence(request_id=1, prompt_tokens=list(prompt),
+                   max_new_tokens=6)
+    promoted = eng.prefetch_host_hits(seq)
+    assert promoted >= 3
+    assert seq.host_prefetched
+    assert eng.prefetch_host_hits(seq) == 0  # idempotent
+    assert eng.allocator.evictable_count >= promoted
+    # The prefill now hits HBM — no further restore needed.
+    eng.prefill(seq)
+    assert seq.cached_tokens >= promoted * 8 - 8
+    assert seq.host_restored_pages == 0
+    while eng.active_sequences():
+        eng.decode_steps()
+    assert seq.generated == want
+    eng.release(seq)
+    assert_pool_clean(eng)
+
+
+def test_prefetch_without_free_pages_retries_later():
+    """Prefetch never evicts to make room: with zero free pages it
+    leaves the request eligible and succeeds on a later pass."""
+    eng = InferenceEngine(MODEL, _ecfg(num_pages=12, max_pages_per_seq=8),
+                          seed=0)
+    prompt = list(range(40, 70))
+    eng.generate([prompt], max_new_tokens=6)
+    eng.prefix_cache.evict(100)
+    assert eng.prefix_cache.stats()["host_entries"] > 0
+    # Exhaust the free list (the cache was fully demoted, so free pages
+    # are plain allocations).
+    hold = eng.allocator.allocate(eng.allocator.num_free)
+    seq = Sequence(request_id=2, prompt_tokens=list(prompt),
+                   max_new_tokens=4)
+    assert eng.prefetch_host_hits(seq) == 0
+    assert not seq.host_prefetched           # still eligible
+    eng.allocator.free(hold)
+    assert eng.prefetch_host_hits(seq) > 0
+    eng.prefix_cache.clear()
+    assert_pool_clean(eng)
+
+
+# -------------------------------------------------- scheduler / routing
+
+
+def test_one_hash_pass_per_routed_request(monkeypatch):
+    """The triple-hash fix: a request routed by the dp group hashes its
+    prompt exactly once — the router's digest list rides the Sequence
+    through admission (lookup) and publish (insert extends the chain
+    instead of re-hashing the prefix)."""
+    from tpu_inference.server import replicas as repl_mod
+    from tpu_inference.engine import prefix_cache as pc_mod
+    from tpu_inference.server.replicas import EngineGroup
+
+    calls = {"n": 0}
+    real = pc_mod._chain_hashes
+
+    def counting(tokens, page_size):
+        calls["n"] += 1
+        return real(tokens, page_size)
+
+    monkeypatch.setattr(pc_mod, "_chain_hashes", counting)
+    monkeypatch.setattr(repl_mod, "_chain_hashes", counting)
+
+    ecfg = _ecfg(num_pages=64, max_pages_per_seq=8, max_batch_size=2)
+    engines = [InferenceEngine(MODEL, ecfg, seed=0) for _ in range(2)]
+    group = EngineGroup(engines, cfgs.ServerConfig()).start()
+    try:
+        for rid in range(3):
+            prompt = list(range(rid, rid + 30))
+            ev = threading.Event()
+            before = calls["n"]
+            seq = Sequence(request_id=rid, prompt_tokens=prompt,
+                           max_new_tokens=4)
+            group.submit(seq, lambda s, t: None,
+                         lambda s, ev=ev: ev.set())
+            assert ev.wait(60)
+            # Exactly one hash pass end to end: route -> admit -> publish.
+            assert calls["n"] == before + 1, \
+                f"request {rid} hashed its prompt {calls['n'] - before}x"
+    finally:
+        group.stop(drain=True, timeout=10)
+
+
+def test_router_scores_three_temperatures():
+    """HBM-warm > host-warm > cold: with equal load, the router prefers
+    the replica holding the prompt in HBM, then the one holding it in
+    the host tier, then a cold one."""
+    from tpu_inference.server.replicas import EngineGroup
+
+    ecfg = _ecfg(num_pages=64, max_pages_per_seq=8, max_batch_size=2)
+    engines = [InferenceEngine(MODEL, ecfg, seed=0) for _ in range(3)]
+    group = EngineGroup(engines, cfgs.ServerConfig())
+    prompt = list(range(100, 130))           # 3 full pages
+
+    def run_on(eng):
+        eng.generate([prompt], max_new_tokens=4)
+
+    # Replica 0: HBM-warm. Replica 1: host-warm (demoted). Replica 2 cold.
+    run_on(engines[0])
+    run_on(engines[1])
+    engines[1].prefix_cache.evict(100)
+    assert engines[1].prefix_cache.stats()["host_entries"] > 0
+
+    seq = Sequence(request_id=9, prompt_tokens=list(prompt),
+                   max_new_tokens=4)
+    sched, (hbm, host) = group._pick(group.schedulers, seq)
+    assert sched is group.schedulers[0] and hbm > 0 and host == 0
+    # Without replica 0, host-warm replica 1 beats cold replica 2.
+    seq2 = Sequence(request_id=10, prompt_tokens=list(prompt),
+                    max_new_tokens=4)
+    sched, (hbm, host) = group._pick(group.schedulers[1:], seq2)
+    assert sched is group.schedulers[1] and host > 0 and hbm == 0
+    # The digests were cached on the sequences (one hash pass).
+    assert seq.prefix_digests is not None
+    # Zero host weight: host warmth is ignored -> ties break by rotation
+    # across (cold) equals, i.e. host replica no longer dominates.
+    group.server_cfg = cfgs.ServerConfig(route_host_hit_weight=0.0)
+    seq3 = Sequence(request_id=11, prompt_tokens=list(prompt),
+                    max_new_tokens=4)
+    _, (hbm3, host3) = group._pick(group.schedulers[1:], seq3)
+    assert hbm3 == 0                         # never misreported as HBM
+
+
+def test_scheduler_prefetches_during_queue_wait():
+    """End to end through the scheduler: a request that must WAIT (slots
+    full) gets its host-tier pages promoted while queued, so its prefill
+    reports zero swap-ins and warm cached tokens."""
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    ecfg = _ecfg(num_pages=40, max_pages_per_seq=8, max_batch_size=1,
+                 host_cache_pages=64)
+    eng = InferenceEngine(MODEL, ecfg, seed=0)
+    warm_prompt = list(range(40, 70))
+    want = eng.generate([warm_prompt], max_new_tokens=6)[0]
+    eng.prefix_cache.evict(100)              # demote the conversation
+    assert eng.prefix_cache.stats()["host_entries"] > 0
+
+    sched = EngineScheduler(eng).start()
+    outs, events = {}, {}
+    try:
+        # Request A occupies the single slot; B (the warm one) waits.
+        for rid, prompt, toks in ((0, list(range(200, 230)), 24),
+                                  (1, warm_prompt, 6)):
+            ev = threading.Event()
+            events[rid] = ev
+            sched.submit(
+                Sequence(request_id=rid, prompt_tokens=list(prompt),
+                         max_new_tokens=toks),
+                lambda s, t: outs.setdefault(s.request_id, []).append(t),
+                lambda s, ev=ev: ev.set())
+        for ev in events.values():
+            assert ev.wait(90)
+    finally:
+        sched.stop(drain=True, timeout=10)
+    assert outs[1] == want
+    # The wait was long enough for the prefetch to land: the restore
+    # happened via prefetch (cache-owned), not inside B's prefill.
+    assert eng.prefix_cache.host_pool.restored_total > 0
+    assert_pool_clean(eng)

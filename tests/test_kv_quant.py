@@ -230,9 +230,9 @@ def test_prefix_cache_reuses_quantized_pages():
     ecfg = EngineConfig(**BASE, kv_quant="int8")
     eng = InferenceEngine(cfg, ecfg, seed=0)
     cold = eng.generate([PROMPTS[1]], max_new_tokens=8)
-    hits_before = eng.prefix_cache.stats()["hits"]
+    hits_before = eng.prefix_cache.hits_hbm.value
     warm = eng.generate([PROMPTS[1]], max_new_tokens=8)
-    assert eng.prefix_cache.stats()["hits"] > hits_before
+    assert eng.prefix_cache.hits_hbm.value > hits_before
     assert cold == warm
 
 

@@ -90,22 +90,23 @@ def test_prefix_cache_unit():
     alloc.free(pages)                        # seq done
     assert cache.evictable == 2
 
-    got, n = cache.lookup(tokens)
-    assert got == pages[:2] and n == 8
+    got, host, n = cache.lookup(tokens)
+    assert got == pages[:2] and n == 8 and host == []
     assert alloc.refcount(pages[0]) == 2     # cache + new lookup ref
     # max_tokens caps the match (engine recomputes the final token).
-    got2, n2 = cache.lookup(tokens, max_tokens=8)
+    got2, _, n2 = cache.lookup(tokens, max_tokens=8)
     assert n2 == 8 and len(got2) == 2
-    got3, n3 = cache.lookup(tokens, max_tokens=7)
+    got3, _, n3 = cache.lookup(tokens, max_tokens=7)
     assert n3 == 4 and len(got3) == 1
     alloc.free(got + got2 + got3)
 
-    # Eviction frees only cache-held pages, LRU first.
+    # Eviction frees only cache-held pages, LRU first (no host tier
+    # attached: classic free-on-evict).
     freed = cache.evict(10)
     assert freed == 2
     assert alloc.num_free == 15
-    got, n = cache.lookup(tokens)
-    assert n == 0 and got == []
+    got, host, n = cache.lookup(tokens)
+    assert n == 0 and got == [] and host == []
 
 
 def test_peek_is_side_effect_free():
@@ -122,14 +123,18 @@ def test_peek_is_side_effect_free():
     alloc.free(p_new)                        # cache holds the only refs
 
     refs_before = [alloc.refcount(p) for p in p_old + p_new]
-    hits, misses = cache.hits, cache.misses
+    hits = (cache.hits_hbm.value, cache.hits_host.value)
+    misses = cache.misses.value
     assert cache.peek(old) == 2
     assert cache.peek(old, max_tokens=7) == 1
     assert cache.peek(list(range(99, 107))) == 0
-    # No refcount share, no stat movement, only the peek counter.
+    # No refcount share, no stat movement, only the peek counter —
+    # which now IS the telemetry Counter /metrics scrapes (one set of
+    # numbers; same torn-update-tolerant stance as telemetry.py).
     assert [alloc.refcount(p) for p in p_old + p_new] == refs_before
-    assert (cache.hits, cache.misses) == (hits, misses)
-    assert cache.stats()["peeks"] == 3
+    assert (cache.hits_hbm.value, cache.hits_host.value) == hits
+    assert cache.misses.value == misses
+    assert cache.peeks.value == 3
 
     # No promotion: `old` was peeked last, but eviction still takes it
     # first (insertion order = LRU order untouched by peeks).
@@ -138,7 +143,7 @@ def test_peek_is_side_effect_free():
     assert cache.peek(new) == 2
 
     # lookup agreement: peek's count matches what a real lookup takes.
-    got, n = cache.lookup(new)
+    got, _, n = cache.lookup(new)
     assert len(got) == cache.peek(new) == 2 and n == 8
     alloc.free(got)
     cache.clear()
@@ -189,7 +194,7 @@ def test_warm_request_matches_cold(warm_engine, cold_engine):
     # Second identical request hits the cache and still matches.
     second = warm.generate([prompt], max_new_tokens=12)[0]
     assert second == want
-    assert warm.prefix_cache.hits >= 1
+    assert warm.prefix_cache.hits_hbm.value >= 1
 
 
 def test_multi_turn_conversation_reuse(warm_engine, cold_engine):

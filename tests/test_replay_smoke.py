@@ -132,6 +132,56 @@ def test_replay_smoke_compare_hybrid(tmp_path, monkeypatch):
     assert cmp["hybrid_wins"], cmp
 
 
+def test_replay_smoke_compare_tiering(tmp_path, monkeypatch):
+    """Tier-1 tiered-KV-cache smoke (CPU, tiny model): the host-tier
+    off-vs-on comparison lane replays the pinned multi-turn mix with the
+    HBM pool sized well below the conversations' KV working set, twice.
+    The tiered arm must serve STRICTLY more cached tokens (evictions
+    demote instead of destroy; returning turns swap back in) with real
+    demote/restore traffic, and greedy outputs must be byte-identical
+    across arms — tiering is a memory-placement decision, never a
+    behavior change. The repo-committed artifact must carry the full
+    win (cached tokens AND returning-turn TTFT p95)."""
+    root, multiturn = _load_bench("multiturn")
+    out = tmp_path / "multiturn_tiering.json"
+    monkeypatch.chdir(root)
+    monkeypatch.setattr(sys, "argv",
+                        ["multiturn.py", "--smoke", "--compare-tiering",
+                         "--out", str(out)])
+    cmp = multiturn.main()
+
+    art = json.loads(out.read_text())
+    assert art["config"]["smoke"] is True
+    for mode in ("hbm_only", "tiered"):
+        s = art[mode]
+        assert s["requests"] > 0 and s["output_tokens"] > 0, (mode, s)
+    # The pool was genuinely oversubscribed — the comparison measured
+    # churn, not an idle cache.
+    assert cmp["working_set_over_pool"] > 1.5
+    # The HBM-only arm demonstrably destroyed KV on eviction...
+    assert art["hbm_only"]["prefix_cache"].get("offloaded_pages", 0) == 0
+    # ...while the tiered arm demoted and swapped back in.
+    assert cmp["offloaded_pages"] > 0
+    assert cmp["restored_pages"] > 0
+    assert cmp["cached_tokens_tiered"] > cmp["cached_tokens_hbm_only"]
+    # Byte-identity across arms (greedy, identical weights/seed).
+    assert cmp["outputs_identical"], cmp
+    assert cmp["tiering_wins"], cmp
+
+    # The committed artifact carries the full acceptance claim,
+    # including the returning-turn latency win (graded on the artifact,
+    # not re-timed on a loaded CI box — the routing artifact's stance).
+    committed = json.loads(open(os.path.join(
+        root, "benchmarks", "results", "multiturn_tiering.json")).read())
+    c = committed["comparison"]
+    assert c["tiering_wins"] and c["outputs_identical"]
+    assert c["ttft_returning_p95_improved"]
+    assert c["cached_tokens_tiered"] > c["cached_tokens_hbm_only"]
+    assert (c["ttft_returning_p95_tiered_s"]
+            < c["ttft_returning_p95_hbm_only_s"])
+    assert c["working_set_over_pool"] >= 3.0
+
+
 def test_replay_smoke_compare_routing(tmp_path, monkeypatch):
     """Tier-1 cache-aware-routing smoke (CPU, dp=2, tiny model): the
     least-loaded vs prefix-affinity comparison lane runs the pinned

@@ -158,9 +158,9 @@ def test_spec_composes_with_prefix_cache():
     prompt = [list(range(3, 20))]
     cold = eng.generate(prompt, max_new_tokens=8)
     cold_acc = (eng.spec_accepted, eng.spec_drafted)
-    hits0 = eng.prefix_cache.stats()["hits"]
+    hits0 = eng.prefix_cache.hits_hbm.value
     warm = eng.generate(prompt, max_new_tokens=8)
-    assert eng.prefix_cache.stats()["hits"] > hits0
+    assert eng.prefix_cache.hits_hbm.value > hits0
     assert cold == warm
     # The real twin property: a cache hit reuses valid DRAFT rows too,
     # so the warm run's greedy acceptance pattern matches the cold run

@@ -393,6 +393,14 @@ class EngineConfig:
     # Prefix caching: finished sequences publish their full KV pages for
     # reuse by later requests sharing the prefix (multi-turn chats).
     enable_prefix_cache: bool = True
+    # Host-RAM KV tier (README "Tiered KV cache"): evicted prefix-cache
+    # pages demote to host memory (up to this many pages) instead of
+    # being dropped, and promote back into freshly allocated device
+    # pages when a returning prompt — or a preempted sequence's
+    # swap-in-resume — needs them. 0 disables the tier (classic
+    # free-on-evict). The CLI accepts ``--host-cache-pages auto`` to
+    # size from the machine's available RAM (engine/autosize.py).
+    host_cache_pages: int = 0
     # --- Admission control (README "Admission & preemption") ---
     # "reserve": a request is admitted only when the pool can hold its
     # prompt plus its FULL max_new_tokens budget — OOM-free by
@@ -524,6 +532,12 @@ class ServerConfig:
     # preemption pressure outbids a cold idle one; at the default a
     # pressured warm replica loses to a cold idle sibling.
     route_hit_weight: float = 1.0
+    # Pages of prefill compute one HOST-tier hit page is worth in the
+    # routing score (three temperatures: HBM-warm > host-warm > cold).
+    # A host hit saves the prefill compute but still pays a host->device
+    # swap-in, so it scores below an HBM hit; 0 makes the router ignore
+    # host warmth entirely.
+    route_host_hit_weight: float = 0.5
     # Page-equivalents of routing cost charged per queued-or-running
     # request on a replica — blends queue depth into the affinity score
     # so warmth cannot herd every conversation onto one overloaded

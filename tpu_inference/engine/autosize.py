@@ -176,6 +176,44 @@ def auto_size(model_cfg, *, hbm_bytes: Optional[float] = None,
         kv_bytes_per_token=kv_tok, target_ctx=ctx)
 
 
+def detect_host_ram_bytes() -> int:
+    """Available host RAM in bytes: /proc/meminfo MemAvailable (the
+    kernel's own estimate of allocatable-without-swapping memory),
+    falling back to half of the sysconf total on platforms without it.
+    The host KV tier's auto-sizing input."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    import os
+
+    try:
+        return (os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")) // 2
+    except (ValueError, OSError, AttributeError):
+        return 8 << 30
+
+
+def auto_host_cache_pages(model_cfg, *, kv_quant: str = "none",
+                          page_size: int = 16,
+                          host_ram_bytes: Optional[int] = None,
+                          fraction: float = 0.5,
+                          reserve_bytes: int = 2 << 30) -> int:
+    """Size ``--host-cache-pages auto`` from the machine's available
+    RAM: ``fraction`` of (available - reserve) divided by the page's
+    byte cost in the serving kv_quant layout. The reserve keeps the OS,
+    the Python heap, and tokenizer/weight staging out of the tier's
+    budget; 0 when the machine has no headroom (the tier then simply
+    stays off rather than inviting the OOM killer)."""
+    avail = (detect_host_ram_bytes() if host_ram_bytes is None
+             else int(host_ram_bytes))
+    budget = max(0, int((avail - reserve_bytes) * fraction))
+    per_page = page_size * kv_bytes_per_token(model_cfg, kv_quant)
+    return budget // max(per_page, 1)
+
+
 def detect_hbm_bytes() -> float:
     """Per-chip HBM of the visible device (table lookup; CPU and unknown
     chips size as a 16 GB v5e so smoke runs exercise the same math)."""

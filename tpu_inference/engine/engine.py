@@ -245,6 +245,20 @@ class Sequence:
     # freed, and zeroed (engine._evict_behind_window).
     evicted_pages: int = 0
     cached_tokens: int = 0                 # prefix-cache hit length
+    # Tiered KV cache (README "Tiered KV cache"): device pages restored
+    # from the host-RAM tier for this request's prefill (swap-in), and
+    # whether the queue-wait prefetch already ran for it. prefix_digests
+    # carries the prompt's chain hashes computed ONCE (by the router's
+    # scoring pass, or lazily at first engine use) so route -> admit ->
+    # publish costs one hash pass per request, not three.
+    host_restored_pages: int = 0
+    host_prefetched: bool = False
+    prefix_digests: Optional[List[bytes]] = None
+    # Resume-stream digests (prompt + pre-preemption generated tokens),
+    # kept SEPARATE from prefix_digests so failover clones and router
+    # reuse never see a resume-polluted list; cleared at each preemption
+    # (the stream and truncation window change there and only there).
+    resume_digests: Optional[List[bytes]] = None
     # Preemption / recompute-resume state (admission="optimistic"):
     # preemptions counts evictions so far (the starvation guard compares
     # it against preempt_max_per_request); resume_base is the number of
@@ -281,6 +295,9 @@ class Sequence:
     # counted on at decision time (-1/0 when submitted scheduler-direct).
     routed_replica: int = -1
     route_hit_pages: int = 0
+    # Of route_hit_pages, how many were host-tier (warm but needing a
+    # swap-in) at decision time — the router's third temperature.
+    route_host_hit_pages: int = 0
     # Phase accounting accrued by the engine: wall time of device
     # dispatches this request participated in, and its share of the
     # host-side bubble between decode calls. Shared dispatches accrue
@@ -395,6 +412,7 @@ class InferenceEngine:
         self.admission = engine_cfg.admission
         self.preemptions_total = 0        # sequences evicted for pressure
         self.resumes_total = 0            # recompute-resume prefills
+        self.swap_in_resumes = 0          # resumes that restored KV pages
         self.hybrid_steps_total = 0       # fused prefill+decode dispatches
         self._admit_counter = 0           # admission recency for victims
         # Sequences preempted since the caller last collected them; the
@@ -429,6 +447,7 @@ class InferenceEngine:
         # stays safe and the SWA exclusions don't apply.
         swa_binds = bool(model_cfg.sliding_window) and (
             engine_cfg.max_context > model_cfg.sliding_window)
+        self.host_pool = None
         if engine_cfg.enable_prefix_cache and not swa_binds:
             # SWA models run WITHOUT the prefix cache (vLLM makes the
             # same exclusion): behind-window pages are evicted while a
@@ -436,8 +455,26 @@ class InferenceEngine:
             # with holes would hand garbage KV to a shorter follow-up
             # request whose own window lands inside the evicted region.
             from tpu_inference.engine.prefix_cache import PrefixCache
+            if engine_cfg.host_cache_pages > 0 and not spec_on:
+                # Host-RAM second tier: evicted pages demote instead of
+                # being dropped (README "Tiered KV cache"). Off under
+                # speculative decoding: only the TARGET pool offloads,
+                # and a restored page with a stale draft twin would
+                # silently tank acceptance — the draft pool's positional
+                # twin invariant (below) only holds for pages both
+                # models wrote in lockstep.
+                self.host_pool = kvc.HostPagePool(
+                    engine_cfg.host_cache_pages)
+                self.telemetry.bind_host_pool(self.host_pool)
+            elif engine_cfg.host_cache_pages > 0:
+                print(f"[engine] {model_cfg.name}: host KV tier disabled "
+                      "— speculative decoding's draft pool has no host "
+                      "twin to restore")
             self.prefix_cache = PrefixCache(self.allocator,
-                                            engine_cfg.page_size)
+                                            engine_cfg.page_size,
+                                            host_pool=self.host_pool,
+                                            offload_fn=self._offload_pages)
+            self.prefix_cache.bind_telemetry(self.telemetry)
         elif engine_cfg.enable_prefix_cache:
             print(f"[engine] {model_cfg.name}: prefix cache disabled — "
                   f"sliding_window={model_cfg.sliding_window} evicts "
@@ -1094,11 +1131,147 @@ class InferenceEngine:
 
     def _allocate_reclaiming(self, n: int) -> List[int]:
         """Allocate n pages, evicting LRU prefix-cache pages on pressure —
-        cached pages are reclaimable capacity, never reserved memory."""
+        cached pages are reclaimable capacity, never reserved memory.
+        With a host tier attached, the eviction DEMOTES pages to host
+        RAM (engine/prefix_cache.py) instead of dropping their KV."""
         short = n - self.allocator.num_free
         if short > 0 and self.prefix_cache is not None:
+            if self.host_pool is not None:
+                # Demotes pay one device-stream sync per offload batch:
+                # evict at least a swap chunk's worth so steady churn
+                # amortizes the sync instead of paying it per page —
+                # capped at the host tier's CAPACITY, so a tiny tier
+                # never has its over-evicted extras destroyed (beyond
+                # capacity they would land in the void, not the tier).
+                short = max(short, min(kvc.SWAP_CHUNK,
+                                       self.host_pool.capacity))
             self.prefix_cache.evict(short)
         return self.allocator.allocate(n)
+
+    # ------------------------------------------------------------------
+    # Tiered KV cache: device<->host page swaps (README "Tiered KV cache")
+    # ------------------------------------------------------------------
+
+    def _offload_pages(self, pages: List[int]) -> List["kvc.HostKVPage"]:
+        """Demote-time device->host copy (the prefix cache's offload_fn):
+        one bundled transfer for the whole victim batch, with swap
+        telemetry. Engine thread only (reads the live pool)."""
+        t0 = time.perf_counter()
+        out = kvc.offload_pages(self.kv, pages)
+        tel = self.telemetry
+        if tel.enabled and out:
+            tel.kv_swap_s.observe(time.perf_counter() - t0)
+            tel.kv_offload_pages.inc(len(out))
+            tel.kv_offload_bytes.inc(sum(hp.nbytes for hp in out))
+        return out
+
+    def _restore_batch(self, fresh: List[int],
+                       entries: List["kvc.HostKVPage"]) -> None:
+        """Scatter host page copies into freshly allocated device pages
+        (async dispatch — a following prefill chains behind it on
+        device) and record swap telemetry."""
+        t0 = time.perf_counter()
+        self.kv = kvc.restore_pages(self.kv, fresh, entries)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.kv_swap_s.observe(time.perf_counter() - t0)
+            tel.kv_restore_pages.inc(len(fresh))
+            tel.kv_restore_bytes.inc(sum(e.nbytes for e in entries))
+
+    def _restore_host_entries(self, pages: List[Optional[int]],
+                              host_entries) -> List[int]:
+        """Fill the host-tier slots of a tiered lookup result: allocate
+        fresh device pages, swap the host copies in, and publish the
+        restored digests back into the HBM tier (promote). On
+        allocation failure every reference taken by the lookup is
+        undone (HBM refs freed, host entries readmitted) and the
+        MemoryError propagates — same contract as a cold allocation
+        shortfall in _prefill_setup."""
+        if not host_entries:
+            return list(pages)
+        try:
+            fresh = self._allocate_reclaiming(len(host_entries))
+        except MemoryError:
+            self.allocator.free([p for p in pages if p is not None])
+            self.prefix_cache.readmit_host(
+                [(d, e) for _, d, e in host_entries])
+            raise
+        self._restore_batch(fresh, [e for _, _, e in host_entries])
+        out = list(pages)
+        for (i, digest, _), page in zip(host_entries, fresh):
+            out[i] = page
+            self.prefix_cache.promote(digest, page)
+        return out
+
+    def _seq_digests(self, seq: Sequence,
+                     prompt: List[int]) -> List[bytes]:
+        """Chain digests of ``prompt`` (the truncated prefill stream),
+        computed ONCE per fresh request and cached on the Sequence (the
+        router's scoring pass may have filled them already — the
+        triple-hash fix). Resume streams include generated tokens and
+        may have shifted the truncation window, so they hash into their
+        OWN cache slot, valid until the next preemption (preempt()
+        clears it) — a queue-waiting resume being prefetched over
+        several partial passes must not rehash a long stream per pass."""
+        from tpu_inference.engine.prefix_cache import _chain_hashes
+        if seq.resume_base:
+            if seq.resume_digests is None:
+                seq.resume_digests = _chain_hashes(
+                    prompt, self.engine_cfg.page_size)
+            return seq.resume_digests
+        if seq.prefix_digests is None:
+            seq.prefix_digests = _chain_hashes(prompt,
+                                               self.engine_cfg.page_size)
+        return seq.prefix_digests
+
+    def prefetch_host_hits(self, seq: Sequence) -> int:
+        """Queue-wait swap-in: restore a WAITING request's host-tier
+        pages into cache-owned device pages, so its eventual admission
+        sees plain HBM hits and prefill starts warm — the swap overlaps
+        the queue wait instead of sitting in TTFT.
+
+        Only genuinely free pages are used (prefetch never evicts
+        someone else's warmth), the restore dispatch is async, and the
+        promoted pages are ordinary evictable cache entries — pressure
+        can re-demote them if the request never admits. Partial
+        restores (free list shorter than the host hits) keep the
+        request eligible for another pass next loop iteration.
+        Returns pages promoted. Engine thread only."""
+        if (self.prefix_cache is None or self.host_pool is None
+                or seq.host_prefetched or seq.done):
+            return 0
+        free = self.allocator.num_free
+        if free <= 0:
+            # Retry when pages free up — checked BEFORE any prompt/hash
+            # work: this runs every scheduler iteration while the head
+            # request waits, and a full pool (the watermark-pressure
+            # steady state) must cost O(1), not a rehash of a multi-
+            # thousand-token resume stream.
+            return 0
+        ecfg = self.engine_cfg
+        prompt = self._prefill_tokens(seq)[-(ecfg.max_context - 1):]
+        if len(prompt) <= 1:
+            seq.host_prefetched = True
+            return 0
+        digests = self._seq_digests(seq, prompt)
+        limit = (len(prompt) - 1) // ecfg.page_size
+        taken = self.prefix_cache.take_host_matches(digests, limit)
+        if not taken:
+            seq.host_prefetched = True
+            return 0
+        complete = len(taken) <= free
+        if not complete:
+            # Keep the FRONT of the run (later pages are unusable
+            # without the earlier ones) and return the rest.
+            self.prefix_cache.readmit_host(taken[free:])
+            taken = taken[:free]
+        fresh = self.allocator.allocate(len(taken))
+        self._restore_batch(fresh, [e for _, e in taken])
+        for (digest, _), page in zip(taken, fresh):
+            self.prefix_cache.adopt(digest, page)
+        if complete:
+            seq.host_prefetched = True
+        return len(taken)
 
     def _grant_decode_steps(self, seq: Sequence, k_steps: int,
                             pred_ctx: Optional[int] = None,
@@ -1176,18 +1349,34 @@ class InferenceEngine:
         if seq.resume_base:
             self.resumes_total += 1
         # Prefix-cache hit: reuse full pages of an identical prior prefix
-        # and skip their prefill compute. Always recompute at least the
-        # final prompt token — its logits seed the first sampled token.
+        # and skip their prefill compute — HBM hits are shared in place;
+        # host-tier hits swap back into freshly allocated device pages
+        # before the prefill resumes past them. Always recompute at
+        # least the final prompt token — its logits seed the first
+        # sampled token.
         shared: List[int] = []
+        n_restored = 0
         if self.prefix_cache is not None:
-            shared, seq.cached_tokens = self.prefix_cache.lookup(
-                prompt, max_tokens=len(prompt) - 1)
+            pages, host_entries, seq.cached_tokens = self.prefix_cache.lookup(
+                prompt, max_tokens=len(prompt) - 1,
+                digests=self._seq_digests(seq, prompt))
+            shared = self._restore_host_entries(pages, host_entries)
+            n_restored = len(host_entries)
         n_new = kvc.pages_needed(len(prompt), ecfg.page_size) - len(shared)
         try:
             seq.pages = shared + self._allocate_reclaiming(n_new)
         except MemoryError:
             self.allocator.free(shared)
             raise
+        # Swap accounting AFTER the allocation can no longer fail: a
+        # MemoryError-and-requeue retry must not double-count one
+        # logical resume/restore in the span and counters.
+        seq.host_restored_pages += n_restored
+        if seq.resume_base and seq.cached_tokens:
+            # The preemption's published pages survived (in HBM or via
+            # the host tier): this resume swaps them in instead of
+            # recomputing the whole prompt+generated stream.
+            self.swap_in_resumes += 1
         seq.slot = slot
         seq.prefill_start = time.perf_counter()
         return prompt
@@ -1500,7 +1689,12 @@ class InferenceEngine:
         # Same truncation the prefill used, so tokens align with pages.
         base = self._prefill_tokens(seq)[-(self.engine_cfg.max_context - 1):]
         in_kv = base + seq.generated[seq.resume_base:-1]
-        self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages)
+        # Reuse the request's one hash pass (router or admission): only
+        # the generated-suffix pages are hashed here. Resume streams may
+        # have shifted the truncation window — they rehash.
+        digests = None if seq.resume_base else seq.prefix_digests
+        self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages,
+                                 digests=digests)
 
     def release(self, seq: Sequence) -> None:
         """Free a finished sequence's pages and slot, publishing its full
@@ -1538,6 +1732,11 @@ class InferenceEngine:
         seq.evicted_pages = 0
         seq.cached_tokens = 0
         seq.prefill_prompt = None
+        # The published pages may demote to host under the very pressure
+        # that preempted this sequence — re-arm the queue-wait prefetch
+        # so the resume swaps them back in while it waits.
+        seq.host_prefetched = False
+        seq.resume_digests = None      # stream/truncation change here
         seq.resume_base = len(seq.generated)
         seq.preemptions += 1
         self.preemptions_total += 1

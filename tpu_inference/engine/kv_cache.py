@@ -22,10 +22,12 @@ inference: pages are append-only within a sequence).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_inference.config import EngineConfig, ModelConfig
 
@@ -230,6 +232,12 @@ class PageAllocator:
         # engine thread so metrics scrapes from other threads read a
         # GIL-atomic int instead of iterating a mutating dict.
         self.evictable_count = 0
+        # Optional observer fired on every evictability flip —
+        # (page, became_evictable) — at exactly the points the counter
+        # moves. The prefix cache uses it to keep an evictable-ordered
+        # structure, so evict() pops victims in O(evicted) instead of
+        # scanning the whole (mostly share-pinned) LRU table.
+        self.on_evictable = None
         # Lifetime alloc/free churn counters, exported by telemetry as
         # tpu_inf_kv_page_{allocs,frees}_total (read-through, so the
         # allocator itself never imports the metrics layer). Plain ints:
@@ -241,18 +249,23 @@ class PageAllocator:
     def num_free(self) -> int:
         return len(self._free)
 
+    def _flip_evictable(self, page: int, up: bool) -> None:
+        self.evictable_count += 1 if up else -1
+        if self.on_evictable is not None:
+            self.on_evictable(page, up)
+
     def mark_cached(self, page: int) -> None:
         """Flag a page as prefix-cache-held (cache owns one of its refs)."""
         assert self._refs[page] > 0 and not self._cached[page]
         self._cached[page] = True
         if self._refs[page] == 1:
-            self.evictable_count += 1
+            self._flip_evictable(page, True)
 
     def unmark_cached(self, page: int) -> None:
         assert self._cached[page]
         self._cached[page] = False
         if self._refs[page] == 1:
-            self.evictable_count -= 1
+            self._flip_evictable(page, False)
 
     def can_allocate(self, n: int) -> bool:
         return len(self._free) >= n
@@ -271,7 +284,7 @@ class PageAllocator:
         assert self._refs[page] > 0
         self._refs[page] += 1
         if self._cached[page] and self._refs[page] == 2:
-            self.evictable_count -= 1       # no longer sole-referenced
+            self._flip_evictable(page, False)  # no longer sole-referenced
         return page
 
     def refcount(self, page: int) -> int:
@@ -287,7 +300,7 @@ class PageAllocator:
                 self._free.append(p)
                 self.pages_freed_total += 1
             elif self._refs[p] == 1 and self._cached[p]:
-                self.evictable_count += 1   # cache is now sole holder
+                self._flip_evictable(p, True)  # cache is now sole holder
 
 
 def pages_needed(n_tokens: int, page_size: int,
@@ -296,3 +309,182 @@ def pages_needed(n_tokens: int, page_size: int,
     total = -(-(already + n_tokens) // page_size)
     have = -(-already // page_size)
     return max(0, total - have)
+
+
+# ---------------------------------------------------------------------------
+# Host tier: device<->host page copies (tiered KV cache, README "Tiered
+# KV cache"). Evicted prefix-cache pages demote to host RAM instead of
+# being dropped, and promote back into freshly allocated device pages
+# when a returning prompt needs them — device<->host copies are cheap
+# relative to re-prefilling the tokens they hold.
+# ---------------------------------------------------------------------------
+
+
+class HostKVPage(NamedTuple):
+    """Host copy of ONE pool page, in the pool's stored layout: k/v are
+    ``[L, page_size, Hkv, d_pool]`` in the pool dtype (bf16, int8 codes,
+    or uint8 nibble-packed int4 — the copy is layout-agnostic, so every
+    quantization mode round-trips bit-exactly), scales ``[L, page_size,
+    Hkv]`` f32 or None for unquantized pools."""
+
+    k: np.ndarray
+    v: np.ndarray
+    k_scale: Optional[np.ndarray] = None
+    v_scale: Optional[np.ndarray] = None
+
+    @property
+    def nbytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+
+# Fixed gather/scatter width: every swap pads its page-index vector to
+# a multiple of this and runs in SWAP_CHUNK-page groups, so XLA compiles
+# exactly ONE gather and ONE scatter graph per pool dtype — a variable
+# width would pay a fresh compile mid-serving the first time each batch
+# size appears (pad slots target page 0, the trash page).
+SWAP_CHUNK = 8
+
+
+def _chunk_indices(pages: List[int]):
+    """Yield SWAP_CHUNK-wide int32 index arrays covering ``pages``,
+    zero-padded (trash page) at the tail."""
+    for at in range(0, len(pages), SWAP_CHUNK):
+        group = pages[at:at + SWAP_CHUNK]
+        idx = np.zeros((SWAP_CHUNK,), np.int32)
+        idx[:len(group)] = group
+        yield len(group), idx
+
+
+def offload_pages(kv: KVPages, pages: List[int]) -> List[HostKVPage]:
+    """Copy ``pages`` out of the device pool into host memory.
+
+    All chunk gathers are dispatched first and fetched with ONE
+    device_get (one stream sync for the whole batch), then split per
+    page so each HostKVPage owns its bytes. Blocks until any in-flight
+    dispatch that last donated the pool has settled — correct by
+    construction, and the eviction path that calls this was about to
+    reuse the pages anyway."""
+    n = len(pages)
+    if n == 0:
+        return []
+    chunks = []
+    for count, idx_np in _chunk_indices(pages):
+        idx = jnp.asarray(idx_np)
+        arrs = [kv.k[:, idx], kv.v[:, idx]]
+        if kv.quantized:
+            arrs += [kv.k_scale[:, idx], kv.v_scale[:, idx]]
+        chunks.append((count, arrs))
+    host = jax.device_get([arrs for _, arrs in chunks])
+    out: List[HostKVPage] = []
+    for (count, _), fetched in zip(chunks, host):
+        k, v = fetched[0], fetched[1]
+        ks, vs = (fetched[2], fetched[3]) if kv.quantized else (None, None)
+        # .copy(): the per-page slices must not pin the padded buffer.
+        out.extend(
+            HostKVPage(k[:, i].copy(), v[:, i].copy(),
+                       ks[:, i].copy() if ks is not None else None,
+                       vs[:, i].copy() if vs is not None else None)
+            for i in range(count))
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_pool(pool: jax.Array, idx: jax.Array,
+                  data: jax.Array) -> jax.Array:
+    """In-place (donated) page scatter: pool[:, idx] = data. Padding rows
+    target page 0 (trash), so duplicate trash indices are harmless."""
+    return pool.at[:, idx].set(data)
+
+
+def restore_pages(kv: KVPages, pages: List[int],
+                  host_pages: List[HostKVPage]) -> KVPages:
+    """Scatter host page copies back into the device pool at freshly
+    allocated page ids. Non-blocking: the scatters are dispatched async
+    (donated pool, same stream), so a following prefill chains behind
+    them on device and decode lanes staged through the dispatch-ahead
+    pipeline never stall on the swap-in."""
+    n = len(pages)
+    if n == 0:
+        return kv
+    assert n == len(host_pages)
+    k, v = kv.k, kv.v
+    k_scale, v_scale = kv.k_scale, kv.v_scale
+    at = 0
+    for count, idx_np in _chunk_indices(pages):
+        group = host_pages[at:at + count]
+        at += count
+        idx = jnp.asarray(idx_np)
+
+        def _bulk(host_attr, pool):
+            first = getattr(group[0], host_attr)
+            data = np.zeros((first.shape[0], SWAP_CHUNK) + first.shape[1:],
+                            first.dtype)
+            for i, hp in enumerate(group):
+                data[:, i] = getattr(hp, host_attr)
+            return _scatter_pool(pool, idx, jnp.asarray(data))
+
+        k = _bulk("k", k)
+        v = _bulk("v", v)
+        if kv.quantized:
+            k_scale = _bulk("k_scale", k_scale)
+            v_scale = _bulk("v_scale", v_scale)
+    return KVPages(k=k, v=v, k_scale=k_scale, v_scale=v_scale)
+
+
+class HostPagePool:
+    """Capacity accounting for the host-RAM KV tier (the actual page
+    bytes live in the prefix cache's host-tier table; this tracks how
+    many pages they may occupy and the lifetime churn counters exported
+    by telemetry). Host side only — no device state."""
+
+    def __init__(self, capacity_pages: int):
+        self.capacity = max(0, int(capacity_pages))
+        self.used = 0
+        self.bytes_resident = 0
+        # Lifetime churn (read-through telemetry counters).
+        self.offloaded_total = 0          # pages demoted device -> host
+        self.restored_total = 0           # pages promoted host -> device
+        self.evicted_total = 0            # second-tier (host LRU) drops
+        self.offload_bytes_total = 0
+        self.restore_bytes_total = 0
+
+    def can_hold(self, n: int = 1) -> bool:
+        return self.used + n <= self.capacity
+
+    @property
+    def free(self) -> int:
+        return self.capacity - self.used
+
+    def note_offload(self, nbytes: int) -> None:
+        self.used += 1
+        self.bytes_resident += nbytes
+        self.offloaded_total += 1
+        self.offload_bytes_total += nbytes
+
+    def note_restore(self, nbytes: int) -> None:
+        self.used -= 1
+        self.bytes_resident -= nbytes
+        self.restored_total += 1
+        self.restore_bytes_total += nbytes
+
+    def note_evict(self, nbytes: int) -> None:
+        self.used -= 1
+        self.bytes_resident -= nbytes
+        self.evicted_total += 1
+
+    def readmit(self, nbytes: int) -> bool:
+        """Undo one note_restore for an entry a failed swap-in returns:
+        reverses the restore counters, then re-admits the entry IF the
+        capacity an intervening demote may have claimed still allows it
+        (False = caller must drop the entry; the RAM cap always wins)."""
+        self.restored_total -= 1
+        self.restore_bytes_total -= nbytes
+        if not self.can_hold(1):
+            self.evicted_total += 1
+            return False
+        self.used += 1
+        self.bytes_resident += nbytes
+        return True

@@ -14,11 +14,32 @@ Design:
 - The cache holds its own allocator reference on every inserted page
   (PageAllocator.share); a sequence releasing its pages never invalidates
   a cached copy, and eviction is just dropping the cache's reference.
-- LRU eviction, triggered by the engine when the free list runs dry —
-  cached-but-unused pages are reclaimable capacity, not reserved memory.
+- **Two tiers** (README "Tiered KV cache"): LRU eviction of the HBM
+  table, triggered by the engine when the free list runs dry, DEMOTES a
+  page to a host-RAM tier (device->host copy, then the device page is
+  freed) when a ``HostPagePool`` is attached — the KV survives pool
+  churn and promotes back into a freshly allocated device page when a
+  returning prompt (or a preempted sequence's swap-in-resume) needs it.
+  The host tier has its own LRU; entries are dropped for good only when
+  host capacity runs dry (second-tier evict) or on ``clear()``. With no
+  host pool attached, eviction degrades to the classic free-on-evict.
+- Victim selection is O(evicted): the cache keeps an evictable-ordered
+  table (digests whose page it alone references, in became-evictable
+  order — maintained via the allocator's ``on_evictable`` hook) instead
+  of scanning the whole, mostly share-pinned, LRU table per evict call.
+- Tier invariant: a digest lives in the HBM table OR the host table,
+  never both (promote and publish both drop the host copy).
 - KV content depends only on absolute positions + token ids (RoPE is
   absolute), so equal prefixes produce bit-identical pages; sharing is
-  exact, not approximate.
+  exact, not approximate — and a demoted page's bytes round-trip the
+  host tier untouched (quantized layouts copy as stored).
+
+Hit/miss/peek accounting goes through telemetry ``Counter`` objects
+(per-tier labels once an engine binds its registry) — the same objects
+/metrics scrapes, so there is ONE set of numbers instead of ad-hoc ints
+shadowing the exported ones. The concurrency stance is telemetry.py's:
+``inc`` is a GIL-serialized read-modify-write whose rare torn update
+under thread races is tolerated, not prevented.
 
 The reference has no KV reuse of any kind (its server is external);
 BASELINE.json config 3 ("multi-turn conversations.json") is the
@@ -33,7 +54,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tpu_inference.engine.kv_cache import PageAllocator
+from tpu_inference import telemetry
+from tpu_inference.engine.kv_cache import (
+    HostKVPage,
+    HostPagePool,
+    PageAllocator,
+)
 
 
 def _chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
@@ -46,14 +72,27 @@ def _chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
     ids are non-negative and < 2**31 for any real vocab), so distinct
     token blocks can never serialize to the same bytes.
     """
+    return extend_chain_hashes(tokens, page_size, [])
+
+
+def extend_chain_hashes(tokens: Sequence[int], page_size: int,
+                        prefix_digests: Sequence[bytes]) -> List[bytes]:
+    """Chain digests for every full page of ``tokens``, reusing
+    ``prefix_digests`` (digests of the leading pages, e.g. the ones the
+    router already computed for this request) and hashing only the
+    remainder — the plumb that keeps a routed request at ONE hash pass
+    over its prompt instead of three (route, admit, publish)."""
     n_pages = len(tokens) // page_size
     if n_pages == 0:
         return []
-    blocks = np.asarray(tokens[:n_pages * page_size],
-                        dtype=np.int32).reshape(n_pages, page_size)
-    out: List[bytes] = []
-    h = b""
-    for i in range(n_pages):
+    start = min(len(prefix_digests), n_pages)
+    out: List[bytes] = list(prefix_digests[:start])
+    if start == n_pages:
+        return out
+    blocks = np.asarray(tokens[start * page_size:n_pages * page_size],
+                        dtype=np.int32).reshape(n_pages - start, page_size)
+    h = out[-1] if out else b""
+    for i in range(n_pages - start):
         d = hashlib.blake2b(digest_size=16)
         d.update(h)
         d.update(blocks[i].tobytes())
@@ -63,16 +102,59 @@ def _chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
 
 
 class PrefixCache:
-    """Maps prefix chain-hashes to physical KV pages."""
+    """Maps prefix chain-hashes to physical KV pages (HBM tier) and
+    host-RAM page copies (host tier)."""
 
-    def __init__(self, allocator: PageAllocator, page_size: int):
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 host_pool: Optional[HostPagePool] = None,
+                 offload_fn=None):
         self.allocator = allocator
         self.page_size = page_size
         # digest -> page id, LRU order (oldest first).
         self._table: "OrderedDict[bytes, int]" = OrderedDict()
-        self.hits = 0
-        self.misses = 0
-        self.peeks = 0
+        # Host tier: digest -> HostKVPage, LRU order (oldest first).
+        # ``host_pool`` does the capacity accounting; ``offload_fn``
+        # (engine-provided: pages -> List[HostKVPage]) performs the
+        # device->host copy at demote time.
+        self._host: "OrderedDict[bytes, HostKVPage]" = OrderedDict()
+        self.host_pool = host_pool
+        self._offload_fn = offload_fn
+        # Evictable-ordered view of _table: digests whose page the cache
+        # alone references, oldest-released first. Maintained through
+        # the allocator's evictability hook so evict() is O(evicted).
+        self._evict_order: "OrderedDict[bytes, None]" = OrderedDict()
+        self._page_digest: Dict[int, bytes] = {}
+        allocator.on_evictable = self._note_evictable
+        # Accounting via telemetry counters (standalone objects until an
+        # engine binds its registry — see bind_telemetry): hit/miss per
+        # lookup, split by the tier that served it; peeks from router
+        # threads. These are exactly what /metrics scrapes, so there is
+        # no second set of ad-hoc ints to race with.
+        self.hits_hbm = telemetry.Counter("tpu_inf_prefix_cache_hits_total")
+        self.hits_host = telemetry.Counter("tpu_inf_prefix_cache_hits_total")
+        self.misses = telemetry.Counter("tpu_inf_prefix_cache_misses_total")
+        self.peeks = telemetry.Counter("tpu_inf_prefix_cache_peeks_total")
+
+    def bind_telemetry(self, tel) -> None:
+        """Swap the standalone counters for registry-backed ones (tier
+        labels included) so /metrics exposes them per replica."""
+        if not getattr(tel, "enabled", False):
+            return
+        r = tel.registry
+        self.hits_hbm = r.counter(
+            "tpu_inf_prefix_cache_hits_total",
+            "Prefix-cache lookups served (by tier that contributed pages)",
+            tier="hbm")
+        self.hits_host = r.counter(
+            "tpu_inf_prefix_cache_hits_total",
+            "Prefix-cache lookups served (by tier that contributed pages)",
+            tier="host")
+        self.misses = r.counter(
+            "tpu_inf_prefix_cache_misses_total",
+            "Prefix-cache lookups with no cached prefix in either tier")
+        self.peeks = r.counter(
+            "tpu_inf_prefix_cache_peeks_total",
+            "Side-effect-free prefix probes (router scoring)")
 
     def __len__(self) -> int:
         return len(self._table)
@@ -84,102 +166,267 @@ class PrefixCache:
         so metrics scrapes from other threads read a plain int."""
         return self.allocator.evictable_count
 
+    def _note_evictable(self, page: int, up: bool) -> None:
+        """Allocator evictability hook (engine thread): mirror the flip
+        into the evictable-ordered digest table."""
+        digest = self._page_digest.get(page)
+        if digest is None:
+            return
+        if up:
+            self._evict_order[digest] = None
+            self._evict_order.move_to_end(digest)
+        else:
+            self._evict_order.pop(digest, None)
+
     # ------------------------------------------------------------- peek
 
     def peek(self, tokens: Sequence[int],
              max_tokens: Optional[int] = None) -> int:
-        """Length (in full pages) of the longest cached prefix of
-        ``tokens`` — **side-effect-free**: no LRU promotion, no refcount
-        share, no hit/miss accounting. The dp router calls this from
-        HTTP threads to score replicas, so it must neither perturb the
-        engine-thread-owned eviction order nor pin pages a routing
-        decision merely *considered*. Plain dict gets are GIL-atomic, so
-        no lock is needed; a concurrent insert/evict can make the answer
-        stale by a page or two, which the router tolerates (the prefill
-        re-checks with ``lookup`` and simply recomputes the difference).
+        """Length (in full pages, across BOTH tiers) of the longest
+        cached prefix of ``tokens`` — **side-effect-free**: no LRU
+        promotion, no refcount share, no hit/miss accounting. The dp
+        router calls this from HTTP threads to score replicas, so it
+        must neither perturb the engine-thread-owned eviction order nor
+        pin pages a routing decision merely *considered*. Plain dict
+        gets are GIL-atomic, so no lock is needed; a concurrent
+        insert/evict can make the answer stale by a page or two, which
+        the router tolerates (the prefill re-checks with ``lookup`` and
+        simply recomputes the difference).
         """
         limit = len(tokens) if max_tokens is None else max_tokens
         digests = _chain_hashes(tokens, self.page_size)
         return self.peek_digests(digests[:limit // self.page_size])
 
     def peek_digests(self, digests: Sequence[bytes]) -> int:
-        """peek() over pre-computed chain digests. The dp router hashes
-        each prompt ONCE and probes every replica's table with the same
-        digest list (all replicas share page_size), so scoring costs one
-        hash pass per request, not one per replica. Same side-effect-free
-        contract as peek()."""
-        n = 0
+        """peek() over pre-computed chain digests (both tiers summed).
+        The dp router hashes each prompt ONCE and probes every replica's
+        table with the same digest list (all replicas share page_size),
+        so scoring costs one hash pass per request, not one per replica.
+        Same side-effect-free contract as peek()."""
+        hbm, host = self.peek_digests_tiered(digests)
+        return hbm + host
+
+    def peek_digests_tiered(self, digests: Sequence[bytes]
+                            ) -> Tuple[int, int]:
+        """Tier-aware peek: (hbm_hit_pages, host_hit_pages) over the
+        longest contiguous cached prefix — the router's three-
+        temperature signal (HBM-warm > host-warm > cold). Side-effect-
+        free; safe from any thread."""
+        hbm = host = 0
         for digest in digests:
-            if digest not in self._table:
+            if digest in self._table:
+                hbm += 1
+            elif digest in self._host:
+                host += 1
+            else:
                 break
-            n += 1
-        self.peeks += 1
-        return n
+        self.peeks.inc()
+        return hbm, host
 
     # ------------------------------------------------------------- lookup
 
     def lookup(self, tokens: Sequence[int],
-               max_tokens: Optional[int] = None) -> Tuple[List[int], int]:
-        """Longest cached prefix of ``tokens``.
+               max_tokens: Optional[int] = None,
+               digests: Optional[Sequence[bytes]] = None
+               ) -> Tuple[List[Optional[int]],
+                          List[Tuple[int, bytes, HostKVPage]], int]:
+        """Longest cached prefix of ``tokens`` across both tiers.
 
-        Returns (shared_pages, n_cached_tokens); every returned page got a
-        fresh allocator reference (caller owns it and must free it).
+        Returns ``(hbm_pages, host_entries, n_cached_tokens)``:
+        ``hbm_pages[i]`` is the device page holding matched page ``i``
+        (fresh allocator reference — the caller owns it and must free
+        it) or ``None`` where the match was served by the host tier;
+        ``host_entries`` lists ``(i, digest, HostKVPage)`` for those
+        ``None`` slots. Host entries leave the host tier here — the
+        caller restores them into freshly allocated device pages and
+        publishes them back via :meth:`promote` (or returns them via
+        :meth:`readmit_host` if the restore cannot allocate).
+
         ``max_tokens`` caps the match (the engine always re-computes at
-        least the prompt's final token to get logits).
+        least the prompt's final token to get logits). ``digests``
+        supplies precomputed chain hashes (router plumb) so the prompt
+        is hashed once per request, not once per call.
         """
         limit = len(tokens) if max_tokens is None else max_tokens
-        pages: List[int] = []
-        for i, digest in enumerate(_chain_hashes(tokens, self.page_size)):
+        if digests is None:
+            digests = _chain_hashes(tokens, self.page_size)
+        pages: List[Optional[int]] = []
+        host_entries: List[Tuple[int, bytes, HostKVPage]] = []
+        for i, digest in enumerate(digests):
             if (i + 1) * self.page_size > limit:
                 break
             page = self._table.get(digest)
-            if page is None:
+            if page is not None:
+                self._table.move_to_end(digest)
+                pages.append(page)
+                continue
+            entry = self._host.pop(digest, None)
+            if entry is None:
                 break
-            self._table.move_to_end(digest)
-            pages.append(page)
+            self.host_pool.note_restore(entry.nbytes)
+            host_entries.append((i, digest, entry))
+            pages.append(None)
         for p in pages:
-            self.allocator.share(p)
+            if p is not None:
+                self.allocator.share(p)
         if pages:
-            self.hits += 1
+            if any(p is not None for p in pages):
+                self.hits_hbm.inc()
+            if host_entries:
+                self.hits_host.inc()
         else:
-            self.misses += 1
-        return pages, len(pages) * self.page_size
+            self.misses.inc()
+        return pages, host_entries, len(pages) * self.page_size
+
+    def promote(self, digest: bytes, page: int) -> None:
+        """Publish a just-restored host-tier page into the HBM table
+        (the caller owns ``page``; the cache takes its own reference).
+        The host copy was already removed by lookup, preserving the
+        one-tier-per-digest invariant."""
+        if digest in self._table:
+            return
+        self._table[digest] = self.allocator.share(page)
+        self._page_digest[page] = digest
+        self.allocator.mark_cached(page)
+
+    def adopt(self, digest: bytes, page: int) -> None:
+        """Queue-wait prefetch: take ownership of a freshly allocated
+        ``page`` (refcount 1, transferred from the caller) holding a
+        just-restored host entry's bytes, and publish it in the HBM
+        tier — the upcoming admission then sees a plain HBM hit."""
+        assert digest not in self._table
+        self._table[digest] = page
+        self._page_digest[page] = digest
+        self.allocator.mark_cached(page)   # refs==1 -> evictable
+
+    def take_host_matches(self, digests: Sequence[bytes], max_pages: int
+                          ) -> List[Tuple[bytes, HostKVPage]]:
+        """Pop the host-tier entries inside the longest contiguous
+        cached prefix of ``digests`` (HBM hits are skipped over, not
+        touched). Used by the queue-wait swap-in: the caller restores
+        the entries and hands the pages back via :meth:`adopt` (or
+        :meth:`readmit_host` on allocation failure)."""
+        out: List[Tuple[bytes, HostKVPage]] = []
+        for i, digest in enumerate(digests):
+            if i >= max_pages:
+                break
+            if digest in self._table:
+                continue
+            entry = self._host.pop(digest, None)
+            if entry is None:
+                break
+            self.host_pool.note_restore(entry.nbytes)
+            out.append((digest, entry))
+        return out
+
+    def readmit_host(self, taken: Sequence[Tuple[bytes, HostKVPage]]
+                     ) -> None:
+        """Return host entries a failed restore could not place (device
+        pool exhausted) to the host tier, newest-first preserved. An
+        intervening demote may have refilled the slots the take freed
+        (evict() runs inside the very allocation that failed) — entries
+        that no longer fit are dropped (they are cache copies; losing
+        them costs recompute, never correctness) so ``used`` can never
+        exceed the configured RAM cap."""
+        for digest, entry in taken:
+            if digest in self._table or digest in self._host:
+                continue
+            if self.host_pool.readmit(entry.nbytes):
+                self._host[digest] = entry
 
     # ------------------------------------------------------------- insert
 
-    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+    def insert(self, tokens: Sequence[int], pages: Sequence[int],
+               digests: Optional[Sequence[bytes]] = None) -> int:
         """Publish a sequence's full pages. ``pages[i]`` must hold tokens
         ``[i*page, (i+1)*page)`` of ``tokens``. Call while the caller still
-        owns the pages (the cache takes its own reference). Returns the
-        number of newly published pages."""
+        owns the pages (the cache takes its own reference). ``digests``
+        may supply precomputed chain hashes for the leading pages (the
+        suffix is hashed here). Returns the number of newly published
+        pages."""
+        digests = extend_chain_hashes(tokens, self.page_size, digests or [])
         added = 0
-        for i, digest in enumerate(_chain_hashes(tokens, self.page_size)):
+        for i, digest in enumerate(digests):
             if i >= len(pages):
                 break
             if digest in self._table:
                 self._table.move_to_end(digest)
                 continue
+            # Tier invariant: publishing a digest in HBM supersedes any
+            # host copy (a sibling sequence may have recomputed pages
+            # the host tier still holds from an earlier demotion).
+            self._drop_host(digest)
             self._table[digest] = self.allocator.share(pages[i])
+            self._page_digest[pages[i]] = digest
             self.allocator.mark_cached(pages[i])
             added += 1
         return added
 
+    def _drop_host(self, digest: bytes) -> None:
+        entry = self._host.pop(digest, None)
+        if entry is not None:
+            self.host_pool.note_evict(entry.nbytes)
+
     # ------------------------------------------------------------- evict
 
+    def _forget(self, digest: bytes) -> int:
+        """Remove one HBM entry (digest must be evictable) and free its
+        device page. Returns the page id."""
+        page = self._table.pop(digest)
+        self._evict_order.pop(digest, None)
+        del self._page_digest[page]
+        self.allocator.unmark_cached(page)
+        self.allocator.free([page])
+        return page
+
     def evict(self, n_pages: int) -> int:
-        """Drop up to ``n_pages`` LRU entries whose page the cache alone
-        still references (releasing shared entries frees no memory, so
-        they are skipped). Returns pages actually freed."""
-        freed = 0
-        for digest in list(self._table):
-            if freed >= n_pages:
+        """Free up to ``n_pages`` device pages from the HBM tier, oldest
+        evictable entries first (entries whose page is share-pinned by a
+        running sequence are never touched — the evictable-ordered table
+        contains only sole-referenced entries, so this is O(evicted)).
+
+        With a host tier attached, victims DEMOTE: their bytes copy to
+        host memory (one bundled device->host transfer for the whole
+        batch) before the device page is freed, making room in the host
+        tier by dropping ITS oldest entries when capacity runs dry.
+        With no host tier (or zero capacity), this degrades to the
+        classic free-on-evict. Either way the device pages are freed.
+        """
+        victims: List[bytes] = []
+        for digest in self._evict_order:
+            if len(victims) >= n_pages:
                 break
-            page = self._table[digest]
-            if self.allocator.refcount(page) == 1:
-                del self._table[digest]
-                self.allocator.unmark_cached(page)
-                self.allocator.free([page])
-                freed += 1
+            victims.append(digest)
+        if not victims:
+            return 0
+        demote = (self.host_pool is not None
+                  and self._offload_fn is not None
+                  and self.host_pool.capacity > 0)
+        copies: List[Optional[HostKVPage]] = [None] * len(victims)
+        if demote:
+            # Second-tier eviction first: make host room for the batch
+            # (never more — a victim batch larger than the whole host
+            # capacity must not flush unrelated entries it can't use).
+            target = min(len(victims), self.host_pool.capacity)
+            while self.host_pool.free < target and self._host:
+                _, old = self._host.popitem(last=False)
+                self.host_pool.note_evict(old.nbytes)
+            fit = min(self.host_pool.free, len(victims))
+            if fit > 0:
+                # Demote the NEWEST victims when not all fit — they are
+                # the most likely to return.
+                pages = [self._table[d] for d in victims[-fit:]]
+                offloaded = self._offload_fn(pages)
+                for j, hp in enumerate(offloaded):
+                    copies[len(victims) - fit + j] = hp
+        freed = 0
+        for digest, hp in zip(victims, copies):
+            self._forget(digest)
+            freed += 1
+            if hp is not None:
+                self._drop_host(digest)     # stale host copy, if any
+                self._host[digest] = hp
+                self.host_pool.note_offload(hp.nbytes)
         return freed
 
     def clear(self) -> None:
@@ -187,8 +434,23 @@ class PrefixCache:
             self.allocator.unmark_cached(page)
             self.allocator.free([page])
         self._table.clear()
+        self._evict_order.clear()
+        self._page_digest.clear()
+        for entry in self._host.values():
+            self.host_pool.note_evict(entry.nbytes)
+        self._host.clear()
 
     def stats(self) -> Dict[str, int]:
-        return {"entries": len(self._table), "evictable": self.evictable,
-                "hits": self.hits, "misses": self.misses,
-                "peeks": self.peeks}
+        out = {"entries": len(self._table), "evictable": self.evictable,
+               "host_entries": len(self._host)}
+        if self.host_pool is not None:
+            hp = self.host_pool
+            out.update({
+                "host_capacity_pages": hp.capacity,
+                "host_pages_used": hp.used,
+                "host_bytes_resident": hp.bytes_resident,
+                "offloaded_pages": hp.offloaded_total,
+                "restored_pages": hp.restored_total,
+                "host_evictions": hp.evicted_total,
+            })
+        return out

@@ -93,6 +93,9 @@ class SchedulerStats:
             "admission": engine.admission,
             "preemptions": engine.preemptions_total,
             "recompute_resumes": engine.resumes_total,
+            # Tiered KV cache: resumes whose published pages survived
+            # (HBM or host tier) and swapped in instead of recomputing.
+            "swap_in_resumes": engine.swap_in_resumes,
             # Hybrid prefill-decode stepping (README "Scheduling"):
             # whether chunks fuse into decode dispatches, and how many
             # fused dispatches have run.
@@ -441,6 +444,20 @@ class EngineScheduler:
                 self._callbacks[pending.seq.request_id] = pending
                 reserved += need
                 batch.append(pending)
+        # Queue-wait swap-in (README "Tiered KV cache"): the head-of-
+        # queue request's host-tier pages start restoring into cache-
+        # owned device pages WHILE it waits, so its eventual prefill
+        # begins warm instead of paying the swap inside TTFT. Engine
+        # thread, bounded to the head request; no-ops without a host
+        # tier (host_prefetched short-circuits repeats).
+        if self.engine.host_pool is not None:
+            with self._lock:
+                head = self._waiting[0] if self._waiting else None
+            if head is not None and not head.seq.done:
+                try:
+                    self.engine.prefetch_host_hits(head.seq)
+                except Exception as exc:  # noqa: BLE001 — keep loop alive
+                    self._log_step_error("host_prefetch", exc, [head.seq])
         if start_chunked is not None:
             seq = start_chunked.seq
             try:
@@ -588,6 +605,8 @@ class EngineScheduler:
             reason=seq.finish_reason, attempt=seq.attempt,
             routed_replica=seq.routed_replica,
             route_hit_pages=seq.route_hit_pages,
+            route_host_hit_pages=seq.route_host_hit_pages,
+            host_restored_pages=seq.host_restored_pages,
             preemptions=seq.preemptions,
             prompt_tokens=len(seq.prompt_tokens),
             output_tokens=len(seq.generated),
@@ -622,9 +641,16 @@ class EngineScheduler:
             # request was submitted scheduler-direct, e.g. tests/bench).
             "routed_replica": seq.routed_replica,
             "route_hit_pages": seq.route_hit_pages,
+            # Of route_hit_pages, the pages that were HOST-tier-warm at
+            # decision time (the router's third temperature).
+            "route_host_hit_pages": seq.route_host_hit_pages,
             "finished_unix": round(time.time(), 3),
             "prompt_tokens": len(seq.prompt_tokens),
             "cached_tokens": seq.cached_tokens,
+            # Tiered KV cache: device pages this request's prefills
+            # swapped in from the host-RAM tier (0 = every cached page
+            # was already HBM-warm).
+            "host_restored_pages": seq.host_restored_pages,
             "output_tokens": n_out,
             # Watermark evictions this request survived (0 = never
             # preempted); recompute-resume makes them invisible in the

@@ -147,6 +147,20 @@ def main() -> None:
                         "peeked cache-hit page is worth in the routing "
                         "score (1.0 = at cost; larger lets warmth "
                         "outbid queue depth and preemption pressure)")
+    p.add_argument("--route-host-hit-weight", type=float, default=0.5,
+                   help="prefix-affinity: pages of prefill work one "
+                        "HOST-tier hit page is worth (three "
+                        "temperatures: HBM-warm > host-warm > cold — a "
+                        "host page saves the compute but still pays a "
+                        "host->device swap-in; 0 ignores host warmth)")
+    p.add_argument("--host-cache-pages", type=int_or_auto, default="auto",
+                   help="host-RAM KV tier capacity in pages: evicted "
+                        "prefix-cache pages demote to host memory and "
+                        "swap back in on reuse instead of re-prefilling "
+                        "(README 'Tiered KV cache'); 0 = off, 'auto' "
+                        "(default) = size from available RAM "
+                        "(/proc/meminfo MemAvailable; capacity is a "
+                        "cap — RAM is consumed only as pages demote)")
     p.add_argument("--admission-queue-depth", type=int, default=0,
                    help="shed load (429 + Retry-After) when every "
                         "routable replica has this many requests queued "
@@ -223,6 +237,24 @@ def main() -> None:
 
     max_batch_size, num_pages = resolve_sizing_args(args)
 
+    host_cache_pages = args.host_cache_pages
+    if host_cache_pages == "auto":
+        from tpu_inference.engine.autosize import (
+            auto_host_cache_pages, resolve_model_config)
+
+        # Every dp replica builds its OWN host pool from this one
+        # EngineConfig — divide the machine budget so the fleet's tiers
+        # together stay inside available RAM.
+        host_cache_pages = auto_host_cache_pages(
+            resolve_model_config(args.model, args.checkpoint),
+            kv_quant=args.kv_quant,
+            page_size=args.page_size) // max(1, args.dp)
+        import sys
+
+        print(f"[autosize] host KV tier: {host_cache_pages} pages/replica "
+              f"(from /proc/meminfo MemAvailable, dp={args.dp})",
+              file=sys.stderr)
+
     from tpu_inference.server.http import build_server
 
     server = build_server(model=args.model, tokenizer=args.tokenizer,
@@ -235,6 +267,8 @@ def main() -> None:
                           server_overrides=dict(
                               routing=args.routing,
                               route_hit_weight=args.route_hit_weight,
+                              route_host_hit_weight=(
+                                  args.route_host_hit_weight),
                               step_watchdog_s=args.step_watchdog_s,
                               quarantine_after_failures=args.quarantine_after,
                               quarantine_cooldown_s=args.quarantine_cooldown_s,
@@ -254,6 +288,7 @@ def main() -> None:
                           sp_attn=args.sp_attn,
                           quant=args.quant, kv_quant=args.kv_quant,
                           max_batch_size=max_batch_size,
+                          host_cache_pages=host_cache_pages,
                           num_pages=num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
                           decode_pipeline_depth=args.decode_pipeline_depth,

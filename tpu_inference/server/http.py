@@ -169,9 +169,12 @@ class InferenceServer:
         wd = (f"{scfg.step_watchdog_s:g}s" if scfg.step_watchdog_s > 0
               else "off")
         cap = scfg.admission_queue_depth or "off"
+        host_pages = self.cfg.engine.host_cache_pages
         print(f"supervision: dp={len(self.group.engines)} "
               f"routing={scfg.routing} "
               f"hit_weight={scfg.route_hit_weight:g} "
+              f"host_hit_weight={scfg.route_host_hit_weight:g} "
+              f"host_cache_pages={host_pages} "
               f"step_watchdog={wd} "
               f"quarantine_after={scfg.quarantine_after_failures} "
               f"cooldown={scfg.quarantine_cooldown_s:g}s "

@@ -151,7 +151,11 @@ def _clone_request(seq: Sequence) -> Sequence:
         temperature=seq.temperature, top_p=seq.top_p, top_k=seq.top_k,
         seed=seq.seed, repeat_penalty=seq.repeat_penalty,
         repeat_last_n=seq.repeat_last_n, eos_token_id=seq.eos_token_id,
-        trace_id=seq.trace_id)
+        trace_id=seq.trace_id,
+        # The prompt's chain hashes are a pure function of the tokens:
+        # the replay reuses the original's single hash pass (bytes are
+        # immutable — sharing the list is safe).
+        prefix_digests=seq.prefix_digests)
 
 
 # Finish reasons a zero-delivery request may be resubmitted after.
@@ -216,7 +220,8 @@ class EngineGroup:
         self._rr = 0                    # rotating tie-break cursor
         self.route_prefix_hits = 0      # dispatches with peeked hit > 0
         self.route_cold = 0             # dispatches with no cached prefix
-        self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0}
+        self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0,
+                              "host_hit_pages": 0}
                              for _ in engines]
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
@@ -351,67 +356,85 @@ class EngineGroup:
         self._rr += 1
         return ties[idx]
 
-    def _peek_digests(self, tokens: List[int]) -> Tuple[List[bytes], int]:
-        """Chain-hash the prompt ONCE per routing decision and share
-        the digest list across every scored replica (all replicas serve
-        one EngineConfig, so page_size/max_context agree): scoring costs
-        one hash pass per request, not one per candidate. Mirrors
-        engine.peek_prefix_pages — keep the most recent max_context-1
-        tokens, never count the final prompt token (its logits are
-        always recomputed). Returns (digests, prompt_pages)."""
+    def _digests_for(self, seq: Sequence) -> Tuple[List[bytes], int]:
+        """THE truncation/trim rule for routing-time prefix digests,
+        shared by every scoring site so router math can never drift
+        from engine lookup: keep the most recent max_context-1 tokens,
+        never count the final prompt token (its logits are always
+        recomputed). Chain-hashes the prompt ONCE per request — the
+        list is cached on the Sequence and reused by admission lookup,
+        publish, failover replays, and the admission-cap fallback (all
+        replicas serve one EngineConfig, so page_size/max_context
+        agree). The cached list may carry one extra final-page digest
+        from an engine-side fill; the cap trims it. Returns
+        (digests, prompt_pages)."""
         ecfg = self.engines[0].engine_cfg
-        prompt_len = min(len(tokens), ecfg.max_context - 1)
+        prompt_len = min(len(seq.prompt_tokens), ecfg.max_context - 1)
         prompt_pages = kvc.pages_needed(prompt_len, ecfg.page_size)
-        if prompt_len <= 1:
+        cap = (prompt_len - 1) // ecfg.page_size
+        if cap <= 0:
             return [], prompt_pages
-        prompt = tokens[-prompt_len:] if len(tokens) > prompt_len else tokens
-        digests = _chain_hashes(prompt, ecfg.page_size)
-        return digests[:(prompt_len - 1) // ecfg.page_size], prompt_pages
+        if seq.prefix_digests is None:
+            tokens = seq.prompt_tokens
+            prompt = (tokens[-prompt_len:] if len(tokens) > prompt_len
+                      else tokens)
+            seq.prefix_digests = _chain_hashes(prompt, ecfg.page_size)
+        return seq.prefix_digests[:cap], prompt_pages
 
     def _pick(self, cands: List[EngineScheduler],
               seq: Optional[Sequence] = None
-              ) -> Tuple[EngineScheduler, int]:
+              ) -> Tuple[EngineScheduler, Tuple[int, int]]:
         """Choose a replica for one request; returns (scheduler,
-        peeked_hit_pages on that scheduler).
+        (hbm_hit_pages, host_hit_pages) peeked on that scheduler).
 
         prefix_affinity with a token-bearing request scores each
-        candidate in KV-page units:
+        candidate in KV-page units across THREE temperatures — HBM-warm
+        > host-warm > cold (README "Tiered KV cache"):
 
-            prompt_pages - route_hit_weight * peek_hit_pages
+            prompt_pages - route_hit_weight * hbm_hit_pages
+              - route_host_hit_weight * host_hit_pages
               + route_load_pages * load
               + (prompt_pages + 1 if under preemption pressure)
 
-        i.e. the prefill work this replica would actually redo, plus a
-        queue-depth blend, plus a pressure penalty sized so that at the
-        default hit weight a fully-warm pressured replica still loses
-        to a cold idle one (a pressured replica likely preempts — and
-        recompute-prefills — whatever lands on it); a larger
-        --route-hit-weight buys warmth back past that. Ties break by
-        the legacy (pressure, load) key, then rotate. When NO candidate
-        holds any prefix page (or routing="least_loaded"), the score
-        reduces to (pressure, load) + rotation — plain least-loaded.
-        A single warm candidate is still peeked so the routing counters
-        and span report the true hit (e.g. the lone survivor of a
-        quarantined fleet must not read as a cold dispatch).
+        i.e. the prefill work this replica would actually redo — a
+        host-tier page saves the prefill compute but still pays a
+        host->device swap-in, so it scores below an HBM page at the
+        default weights — plus a queue-depth blend, plus a pressure
+        penalty sized so that at the default hit weight a fully-warm
+        pressured replica still loses to a cold idle one (a pressured
+        replica likely preempts — and recompute-prefills — whatever
+        lands on it); a larger --route-hit-weight buys warmth back past
+        that. Ties break by the legacy (pressure, load) key, then
+        rotate. When NO candidate holds any prefix page in either tier
+        (or routing="least_loaded"), the score reduces to (pressure,
+        load) + rotation — plain least-loaded. A single warm candidate
+        is still peeked so the routing counters and span report the
+        true hit (e.g. the lone survivor of a quarantined fleet must
+        not read as a cold dispatch).
+
+        The digest list computed here is cached on the Sequence
+        (prefix_digests) so admission and publish reuse the same single
+        hash pass over the prompt.
         """
         cfg = self.server_cfg
         if seq is not None and cfg.routing == "prefix_affinity":
-            digests, prompt_pages = self._peek_digests(seq.prompt_tokens)
+            digests, prompt_pages = self._digests_for(seq)
             hits = []
             for sched in cands:
                 pc = sched.engine.prefix_cache
-                hits.append(pc.peek_digests(digests)
-                            if pc is not None else 0)
-            if any(hits):
+                hits.append(pc.peek_digests_tiered(digests)
+                            if pc is not None else (0, 0))
+            if any(h + w for h, w in hits):
                 scored = []
-                for sched, hit in zip(cands, hits):
-                    score = (prompt_pages - cfg.route_hit_weight * hit
+                for sched, (hbm, host) in zip(cands, hits):
+                    score = (prompt_pages - cfg.route_hit_weight * hbm
+                             - cfg.route_host_hit_weight * host
                              + cfg.route_load_pages * sched.load)
                     pressured = sched.engine.under_pressure
                     if pressured:
                         score += prompt_pages + 1
                     scored.append(((score, pressured, sched.load),
-                                   sched, hit))
+                                   sched, (hbm, host)))
                 best = min(key for key, _, _ in scored)
                 return self._rotate([(s, h) for key, s, h in scored
                                      if key == best])
@@ -419,17 +442,22 @@ class EngineGroup:
             # truth, not an accounting shortcut).
         keyed = [(self._route_key(sched), sched) for sched in cands]
         best = min(key for key, _ in keyed)
-        return self._rotate([(s, 0) for key, s in keyed if key == best])
+        return self._rotate([(s, (0, 0)) for key, s in keyed
+                             if key == best])
 
-    def _peek_replica(self, sched: EngineScheduler, seq: Sequence) -> int:
-        """One replica's peeked hit pages for a request (accounting on
-        paths that chose by load, e.g. the admission-cap fallback)."""
+    def _peek_replica(self, sched: EngineScheduler,
+                      seq: Sequence) -> Tuple[int, int]:
+        """One replica's peeked (hbm, host) hit pages for a request
+        (accounting on paths that chose by load, e.g. the admission-cap
+        fallback). Reuses the digest list _pick just cached on the
+        Sequence — the fallback fires on exactly the overloaded path
+        where a second full hash pass would hurt most."""
         if self.server_cfg.routing != "prefix_affinity":
-            return 0
+            return (0, 0)
         pc = sched.engine.prefix_cache
         if pc is None:
-            return 0
-        return pc.peek_digests(self._peek_digests(seq.prompt_tokens)[0])
+            return (0, 0)
+        return pc.peek_digests_tiered(self._digests_for(seq)[0])
 
     def _least_loaded(self) -> EngineScheduler:
         routable = self._routable()
@@ -497,23 +525,29 @@ class EngineGroup:
         self._dispatch(entry, seq, sched, hit_pages)
 
     def _dispatch(self, entry: _Tracked, seq: Sequence,
-                  sched: EngineScheduler, hit_pages: int = 0) -> None:
+                  sched: EngineScheduler,
+                  hit_pages: Tuple[int, int] = (0, 0)) -> None:
         gen = entry.generation
         entry.sched = sched
         # Mark the span: attempt >= 1 means this is a failover
         # resubmission — the timeline/logs distinguish replays.
         seq.attempt = entry.attempts
         # Routing span + fleet accounting: every dispatch (initial or
-        # failover resubmission) is one routing decision.
+        # failover resubmission) is one routing decision. hit_pages is
+        # the tiered peek (hbm, host) the router counted on.
         idx = self.schedulers.index(sched)
+        hbm_hit, host_hit = hit_pages
+        total_hit = hbm_hit + host_hit
         seq.routed_replica = idx
-        seq.route_hit_pages = hit_pages
+        seq.route_hit_pages = total_hit
+        seq.route_host_hit_pages = host_hit
         stats = self._route_stats[idx]
-        if hit_pages > 0:
+        if total_hit > 0:
             self.route_prefix_hits += 1
             stats["hits"] += 1
-            stats["hit_pages"] += hit_pages
-            self._route_hit_pages_hist.observe(hit_pages)
+            stats["hit_pages"] += total_hit
+            stats["host_hit_pages"] += host_hit
+            self._route_hit_pages_hist.observe(total_hit)
         else:
             self.route_cold += 1
             stats["cold"] += 1
@@ -531,7 +565,8 @@ class EngineGroup:
 
     def _retry_target(self, failed: EngineScheduler,
                       template: Optional[Sequence] = None
-                      ) -> Optional[Tuple[EngineScheduler, int]]:
+                      ) -> Optional[Tuple[EngineScheduler,
+                                          Tuple[int, int]]]:
         """Replica for a failover resubmission (and its peeked hit
         pages): affinity composes with failover — the replay prefers a
         sibling already holding the prompt's pages, but never the
@@ -647,6 +682,17 @@ class EngineGroup:
             # and the cached pages the router counted on — the numbers
             # that say whether conversations are actually sticking.
             d["routing"] = dict(self._route_stats[i])
+            # Tiered KV cache view: host-tier residency + swap churn
+            # (absent when the tier is disabled on this replica).
+            if e.host_pool is not None:
+                d["host_cache"] = {
+                    "capacity_pages": e.host_pool.capacity,
+                    "pages_used": e.host_pool.used,
+                    "offloaded": e.host_pool.offloaded_total,
+                    "restored": e.host_pool.restored_total,
+                    "evicted": e.host_pool.evicted_total,
+                    "swap_in_resumes": e.swap_in_resumes,
+                }
             replicas.append(d)
         routable = sum(1 for h in self.health if h.routable)
         if routable == 0:

@@ -443,6 +443,7 @@ PHASE_HISTOGRAMS = {
     "tokens_per_dispatch": "tokens_per_dispatch",
     "hybrid_dispatch_s": "hybrid_dispatch_s",
     "decode_stall_during_prefill_s": "decode_stall_during_prefill_s",
+    "kv_swap_s": "kv_swap_s",
     "queue_wait_s": "queue_wait_s",
     "prefill_phase_s": "prefill_phase_s",
     "decode_phase_s": "decode_phase_s",
@@ -493,6 +494,10 @@ class EngineTelemetry:
             self.prefill_dispatches = NULL_METRIC
             self.hybrid_steps = NULL_METRIC
             self.degraded_mode = NULL_METRIC
+            self.kv_offload_pages = NULL_METRIC
+            self.kv_restore_pages = NULL_METRIC
+            self.kv_offload_bytes = NULL_METRIC
+            self.kv_restore_bytes = NULL_METRIC
             return
         r = self.registry
         self.prefill_dispatch_s = r.histogram(
@@ -521,6 +526,24 @@ class EngineTelemetry:
             "chunked-prefill dispatch (structurally zero while hybrid "
             "steps fuse chunks into the decode dispatch; pressure-"
             "degraded rounds chunk serially and record their real stalls)")
+        self.kv_swap_s = r.histogram(
+            "tpu_inf_kv_swap_seconds",
+            "Host wall of one device<->host KV page-batch swap "
+            "(offload is a blocking device_get; restore is the host "
+            "side of an async scatter dispatch)")
+        self.kv_offload_pages = r.counter(
+            "tpu_inf_kv_offload_pages_total",
+            "KV pages demoted from the HBM pool to the host-RAM tier")
+        self.kv_restore_pages = r.counter(
+            "tpu_inf_kv_restore_pages_total",
+            "KV pages promoted from the host-RAM tier back into the "
+            "HBM pool")
+        self.kv_offload_bytes = r.counter(
+            "tpu_inf_kv_offload_bytes_total",
+            "Bytes copied device->host by KV page demotion")
+        self.kv_restore_bytes = r.counter(
+            "tpu_inf_kv_restore_bytes_total",
+            "Bytes copied host->device by KV page promotion")
         self.queue_wait_s = r.histogram(
             "tpu_inf_queue_wait_seconds",
             "Request admission queue wait (enqueue -> prefill start)")
@@ -584,10 +607,33 @@ class EngineTelemetry:
         r.counter("tpu_inf_recompute_resumes_total",
                   "Preempted sequences re-prefilled (recompute-resume)",
                   fn=lambda: engine.resumes_total)
+        r.counter("tpu_inf_swap_in_resumes_total",
+                  "Resume prefills that restored KV pages from the "
+                  "cache tiers instead of recomputing them all",
+                  fn=lambda: engine.swap_in_resumes)
         r.gauge("tpu_inf_model_params", "Model parameter count",
                 fn=lambda: engine.n_params)
         r.gauge("tpu_inf_active_sequences", "Bound decode slots",
                 fn=lambda: sum(s is not None for s in engine.slots))
+
+    def bind_host_pool(self, pool) -> None:
+        """Read-through metrics over the host-RAM KV tier's capacity
+        accounting (engine/kv_cache.py HostPagePool). Called by the
+        engine after the pool exists — bind_engine runs before the
+        prefix cache / host tier are constructed."""
+        if not self.enabled:
+            return
+        r = self.registry
+        r.gauge("tpu_inf_kv_host_pages_total",
+                "Host-RAM KV tier capacity (pages)",
+                fn=lambda: pool.capacity)
+        r.gauge("tpu_inf_kv_host_pages_used",
+                "Host-RAM KV tier pages resident",
+                fn=lambda: pool.used)
+        r.counter("tpu_inf_kv_host_evictions_total",
+                  "Host-tier entries dropped for good (second-tier LRU "
+                  "eviction or supersession by a fresh HBM publish)",
+                  fn=lambda: pool.evicted_total)
 
     def bind_scheduler(self, sched) -> None:
         """Read-through metrics over SchedulerStats counters."""
