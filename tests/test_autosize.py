@@ -123,6 +123,35 @@ def test_swa_clamp_off_under_speculative_decoding():
     assert spec_sz.max_batch_size == full_sz.max_batch_size
 
 
+def test_decode_ladder_rungs_shapes():
+    """The compiled-graph ladder: doubling rungs from 8 strictly below
+    the top, plus the top itself; tops at or under the base collapse to
+    the single legacy rung."""
+    assert autosize.decode_ladder_rungs(32) == (8, 16, 32)
+    assert autosize.decode_ladder_rungs(64) == (8, 16, 32, 64)
+    assert autosize.decode_ladder_rungs(24) == (8, 16, 24)
+    assert autosize.decode_ladder_rungs(8) == (8,)
+    assert autosize.decode_ladder_rungs(4) == (4,)
+    with pytest.raises(ValueError, match="positive"):
+        autosize.decode_ladder_rungs(0)
+
+
+def test_ladder_from_auto_sizing_is_engine_valid():
+    """The ladder derived from an auto-sized top must pass the engine's
+    validation shape: strictly increasing, ending at the top."""
+    sz = autosize.auto_size(llama_1b(), hbm_bytes=16e9)
+    rungs = autosize.decode_ladder_rungs(sz.max_batch_size)
+    assert rungs[-1] == sz.max_batch_size
+    assert list(rungs) == sorted(set(rungs))
+    assert len(rungs) >= 2                # a 1B/v5e top is 16+ (above)
+
+
+def test_detect_peak_flops_has_default():
+    """CPU/unknown chips report the v5e peak so the MFU estimate always
+    renders (same stance as DEFAULT_HBM_BYTES)."""
+    assert autosize.detect_peak_flops() > 0
+
+
 def test_int_or_auto_argparse_type():
     import argparse
 

@@ -314,6 +314,16 @@ class EngineConfig:
     max_pages_per_seq: int = 64       # => max context = page_size * this
     # Continuous batching.
     max_batch_size: int = 8           # decode slots in the batched graph
+    # Compiled decode-graph ladder (README "Batch ladder"): batch sizes
+    # the decode graphs are compiled at, strictly increasing and ending
+    # at max_batch_size. The engine dispatches at the smallest rung that
+    # covers the occupied slots and moves between rungs as occupancy
+    # changes, so a near-empty batch never pays the top rung's per-step
+    # latency while a full one uses every HBM-budgeted lane. () = the
+    # single legacy rung (max_batch_size,). The CLI's --max-batch-size
+    # auto derives both the top rung (from the chip's HBM budget,
+    # engine/autosize.py) and the ladder below it.
+    decode_ladder: tuple[int, ...] = ()
     max_queue_len: int = 512
     # Prefill bucketing: prompt is right-padded up to the nearest bucket so
     # XLA compiles a bounded number of prefill graphs.
@@ -438,10 +448,32 @@ class EngineConfig:
     # wedge failure mode (benchmarks/run_tpu_round5.sh guards against it
     # out-of-process; the step watchdog detects it in-process).
     chaos_step_wedge_s: float = 0.0
+    # Reuse the decode-step host staging arrays (block tables, sampling
+    # params) across dispatches, refreshing only the rows whose occupant
+    # or pages changed, instead of rebuilding every array per dispatch —
+    # shrinks the host-side bubble between decode calls. False = legacy
+    # rebuild-per-dispatch (the bubble comparison arm of the ladder
+    # artifact). Output-invariant either way.
+    stage_host_reuse: bool = True
+    # Batch-ladder admission headroom: once the bound lanes would exceed
+    # the ladder's BASE rung, a further admission must leave this many
+    # reclaimable (free + evictable) pages spare — growing the batch
+    # toward the top rung must not drain the pool to the preemption
+    # watermark or force decode grants to churn the whole hot set
+    # (with a host tier the churn demotes instead of destroying; the
+    # headroom keeps it off the steady-state path either way). 0 = off
+    # (legacy admission gate only).
+    ladder_admit_headroom_pages: int = 0
 
     @property
     def max_context(self) -> int:
         return self.page_size * self.max_pages_per_seq
+
+    @property
+    def ladder_rungs(self) -> tuple:
+        """The decode-graph ladder actually in effect: ``decode_ladder``
+        or the single legacy rung. Validated by the engine at boot."""
+        return tuple(self.decode_ladder) or (self.max_batch_size,)
 
     @property
     def chunk_tokens_cap(self) -> int:

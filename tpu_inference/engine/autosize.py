@@ -45,6 +45,18 @@ HBM_BY_DEVICE_KIND = {
 }
 DEFAULT_HBM_BYTES = 16e9  # unknown chip / CPU smoke runs: size as a v5e
 
+# Per-chip bf16 peak FLOP/s (the MFU denominator; bench.py keeps its own
+# copy paired with HBM bandwidth for the roofline extras). Unknown chips
+# / CPU report against a v5e so the /metrics MFU estimate always renders
+# — on CPU it is a sizing exercise, like DEFAULT_HBM_BYTES.
+PEAK_FLOPS_BY_DEVICE_KIND = {
+    "TPU v5 lite": 394e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+DEFAULT_PEAK_FLOPS = 394e12
+
 
 def estimate_param_count(model_cfg) -> int:
     """Parameter count from the architecture config (norms elided)."""
@@ -221,6 +233,77 @@ def detect_hbm_bytes() -> float:
 
     return HBM_BY_DEVICE_KIND.get(jax.devices()[0].device_kind,
                                   DEFAULT_HBM_BYTES)
+
+
+def detect_peak_flops() -> float:
+    """Per-chip bf16 peak FLOP/s of the visible device — the denominator
+    of the /metrics MFU estimate (CPU and unknown chips report against a
+    v5e, same stance as detect_hbm_bytes)."""
+    import jax
+
+    return PEAK_FLOPS_BY_DEVICE_KIND.get(jax.devices()[0].device_kind,
+                                         DEFAULT_PEAK_FLOPS)
+
+
+def decode_ladder_rungs(top: int, base: int = 8) -> tuple:
+    """The compiled-decode-graph ladder for a top batch size: doubling
+    rungs from ``base`` (8/16/32/64...) strictly below ``top``, plus
+    ``top`` itself. The engine compiles every rung at warmup and moves
+    between them as occupancy changes, so a near-empty batch never pays
+    the top rung's per-step latency (README "Batch ladder").
+
+        top=32 -> (8, 16, 32);  top=24 -> (8, 16, 24);  top=8 -> (8,)
+
+    ``top <= base`` collapses to the single legacy rung — small serving
+    configs (tests, CPU smoke) keep exactly one compiled decode graph.
+    """
+    top = int(top)
+    if top <= 0:
+        raise ValueError(f"decode ladder needs a positive top, got {top}")
+    rungs = []
+    r = base
+    while r < top:
+        rungs.append(r)
+        r *= 2
+    rungs.append(top)
+    return tuple(rungs)
+
+
+def validate_ladder(rungs, top: int) -> tuple:
+    """THE ladder invariant — strictly increasing positive rungs ending
+    at ``top`` (the engine's slot-array size) — shared by
+    parse_decode_ladder (CLI, before any model loads) and
+    InferenceEngine.__init__ (boot), so the two sites cannot drift.
+    Returns the rungs as a tuple."""
+    rungs = tuple(rungs)
+    if (not rungs or list(rungs) != sorted(set(rungs)) or rungs[0] < 1
+            or rungs[-1] != top):
+        raise ValueError(
+            f"decode_ladder {list(rungs)} must be strictly increasing, "
+            f"positive, and end at max_batch_size ({top})")
+    return rungs
+
+
+def parse_decode_ladder(spec: str, top: int) -> tuple:
+    """THE --decode-ladder parser, shared by the server CLI and the
+    benchmarks so their accepted grammar cannot drift: 'auto' (doubling
+    rungs up to ``top``), 'off' (one graph at ``top``), or comma rungs
+    like '8,16,32' — which must end at ``top``, the engine's slot-array
+    size. Raises ValueError with a usage-quality message; CLI callers
+    turn that into an argparse error before any model loads."""
+    if spec == "auto":
+        return decode_ladder_rungs(top)
+    if spec == "off":
+        return (top,)
+    try:
+        rungs = tuple(int(r) for r in spec.split(","))
+    except ValueError:
+        raise ValueError(
+            f"--decode-ladder {spec!r}: expected 'auto', 'off', or "
+            "comma-separated rungs like '8,16,32'")
+    # The engine's boot-time invariant, applied HERE so a bad spec is a
+    # usage error before any checkpoint loads, per the contract above.
+    return validate_ladder(rungs, top)
 
 
 def resolve_model_and_checkpoint(model: str,

@@ -240,6 +240,11 @@ class Sequence:
     # Filled by the engine:
     slot: int = -1
     pages: List[int] = dataclasses.field(default_factory=list)
+    # Bumped whenever ``pages`` is wholesale-replaced (each prefill
+    # setup): part of the staging-buffer block-table key, so a preempted
+    # sequence resumed into the same slot with a same-length page list
+    # can never alias a stale cached row (engine._stage_batch).
+    pages_version: int = 0
     ctx_len: int = 0                       # tokens currently in KV
     # SWA eviction cursor: pages[:evicted_pages] are behind the window,
     # freed, and zeroed (engine._evict_behind_window).
@@ -483,6 +488,31 @@ class InferenceEngine:
         self.max_pages = engine_cfg.max_pages_per_seq
         self._base_key = jax.random.PRNGKey(seed)
         self._step_count = 0
+        # Batch ladder (README "Batch ladder"): the decode graphs are
+        # compiled at every rung; dispatch uses the smallest rung that
+        # covers the occupied slots. The slot array is always top-rung
+        # sized — rung moves never relocate KV (block tables are host
+        # state shipped per dispatch), only which compiled graph runs.
+        from tpu_inference.engine.autosize import validate_ladder
+        ladder = validate_ladder(engine_cfg.ladder_rungs,
+                                 engine_cfg.max_batch_size)
+        if spec_on and len(ladder) > 1:
+            # The spec round compiles one fused graph at the full batch;
+            # rung-switching it would multiply draft+verify compiles for
+            # a path the roadmap still calls a slowdown. Single rung.
+            print(f"[engine] {model_cfg.name}: speculative decoding — "
+                  "decode ladder collapsed to the top rung")
+            ladder = (engine_cfg.max_batch_size,)
+        self.ladder = ladder
+        self.decode_rung = ladder[0]      # rung of the latest dispatch
+        self.rung_peak = ladder[0]        # highest rung reached
+        self.rung_switches_total = 0      # dispatches at a changed rung
+        # Host staging reuse (the per-dispatch bubble shrinker): per-rung
+        # persistent arrays, refreshed incrementally. Device hand-off
+        # always copies — jnp.asarray aliases numpy memory on CPU, and
+        # these buffers mutate next step while a dispatch may still read.
+        self._stage_reuse = engine_cfg.stage_host_reuse
+        self._stage_bufs: Dict[int, dict] = {}
         self.slots: List[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
         # Dispatch-ahead decode pipeline (decode_steps_pipelined).
         self._inflight: List[dict] = []
@@ -774,12 +804,11 @@ class InferenceEngine:
                     self.draft_kv = self._draft_prefill_jit(
                         self.draft_params, self.draft_kv, toks, one, zero,
                         bt)
-        b = ecfg.max_batch_size
-
-        def decode_half_args():
-            """Decode-graph warmup operands (tokens .. penalty window) —
-            shared by the plain decode graphs and the hybrid graphs'
-            decode half so the two call shapes cannot drift apart."""
+        def decode_half_args(b):
+            """Decode-graph warmup operands (tokens .. penalty window) at
+            rung ``b`` — shared by the plain decode graphs and the hybrid
+            graphs' decode half so the two call shapes cannot drift
+            apart."""
             return (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
                     jnp.zeros((b, self.max_pages), jnp.int32),
                     jnp.zeros((b,), jnp.int32),
@@ -792,6 +821,7 @@ class InferenceEngine:
                     jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
 
         if self.spec_enabled:
+            b = ecfg.max_batch_size
             out = self._spec_jit(
                 self.params, self.draft_params, self.kv, self.draft_kv,
                 jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
@@ -809,16 +839,32 @@ class InferenceEngine:
                 # distinct graph or a first single-step call pays a full
                 # XLA compile mid-serving (ADVICE r3).
                 decodes.append(self._decode_one_jit)
-            for decode in decodes:
-                self.kv, _, _, _ = decode(self.params, self.kv,
-                                          *decode_half_args())
+            # EVERY ladder rung compiles here: continuous batching moves
+            # between rung graphs as occupancy changes, and a rung first
+            # reached mid-serving must find its executable warm (the
+            # mid-serving-compile failure mode ADVICE r3 flagged).
+            for b in self.ladder:
+                for decode in decodes:
+                    self.kv, _, _, _ = decode(self.params, self.kv,
+                                              *decode_half_args(b))
+                if ecfg.decode_pipeline_depth > 1:
+                    # Dispatch-ahead carry folds run jnp.where at [b] /
+                    # [b, W] outside any jit — warm those tiny graphs
+                    # per rung too.
+                    carried = jnp.zeros((b,), bool)
+                    tok = jnp.zeros((b,), jnp.int32)
+                    win = jnp.full((b, PENALTY_WINDOW), -1, jnp.int32)
+                    jnp.where(carried, tok, tok)
+                    jnp.where(carried[:, None], win, win)
         if ecfg.hybrid_prefill and not self.spec_enabled:
-            # One hybrid graph per REACHABLE prefill bucket (the decode
-            # half's shape is fixed), so the first long prompt under
-            # mixed traffic doesn't pay an XLA compile mid-serving.
-            # Hybrid chunks never exceed the chunk cap (budget pressure
-            # only shrinks them), so buckets above bucket_for(cap) are
-            # unreachable and compiling them would only slow boot.
+            # One hybrid graph per REACHABLE prefill bucket per ladder
+            # rung (the decode half dispatches at the current rung), so
+            # the first long prompt under mixed traffic doesn't pay an
+            # XLA compile mid-serving. Hybrid chunks never exceed the
+            # chunk cap (budget pressure only shrinks them), so buckets
+            # above bucket_for(cap) are unreachable and compiling them
+            # would only slow boot — the compile count stays bounded at
+            # reachable_buckets x rungs.
             bucket_cap = ecfg.bucket_for(
                 min(ecfg.chunk_tokens_cap, ecfg.max_context))
             bt1 = jnp.zeros((1, self.max_pages), jnp.int32)
@@ -826,15 +872,18 @@ class InferenceEngine:
             for bucket in ecfg.prefill_buckets:
                 if bucket > ecfg.max_context or bucket > bucket_cap:
                     continue
-                self.kv, _, _, _, _ = self._hybrid_jit(
-                    self.params, self.kv,
-                    jnp.zeros((1, bucket), jnp.int32), one1, zero1, bt1,
-                    self._next_key(), jnp.zeros((1,), jnp.float32),
-                    jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
-                    jnp.full((1,), -1, jnp.int32),
-                    jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
-                    jnp.full((1, PENALTY_WINDOW), -1, jnp.int32),
-                    *decode_half_args())
+                for b in self.ladder:
+                    self.kv, _, _, _, _ = self._hybrid_jit(
+                        self.params, self.kv,
+                        jnp.zeros((1, bucket), jnp.int32), one1, zero1, bt1,
+                        self._next_key(), jnp.zeros((1,), jnp.float32),
+                        jnp.ones((1,), jnp.float32),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.full((1,), -1, jnp.int32),
+                        jnp.ones((1,), jnp.float32),
+                        jnp.zeros((1,), jnp.int32),
+                        jnp.full((1, PENALTY_WINDOW), -1, jnp.int32),
+                        *decode_half_args(b))
         jax.block_until_ready(self.kv)
         return time.perf_counter() - t0
 
@@ -1368,6 +1417,7 @@ class InferenceEngine:
         except MemoryError:
             self.allocator.free(shared)
             raise
+        seq.pages_version += 1        # staging block-table rows re-key
         # Swap accounting AFTER the allocation can no longer fail: a
         # MemoryError-and-requeue retry must not double-count one
         # logical resume/restore in the span and counters.
@@ -1705,6 +1755,7 @@ class InferenceEngine:
         seq.prefill_prompt = None          # cancel/error mid-prefill
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
+        self._stage_forget(seq)
 
     # ------------------------------------------------------------------
     # Preemption + recompute-resume (admission="optimistic")
@@ -1727,6 +1778,7 @@ class InferenceEngine:
         seq.pages = []
         if seq.slot >= 0 and self.slots[seq.slot] is seq:
             self.slots[seq.slot] = None
+        self._stage_forget(seq)
         seq.slot = -1
         seq.ctx_len = 0
         seq.evicted_pages = 0
@@ -1849,34 +1901,160 @@ class InferenceEngine:
             row[-len(hist):] = hist
         return row
 
-    def _stage_batch(self, active_seqs: List[Sequence]):
+    # -- Batch ladder: rung selection + slot compaction (README
+    # -- "Batch ladder"). The slot array is top-rung sized; dispatch
+    # -- width is the smallest compiled rung covering the occupied
+    # -- slots, so a near-empty batch never pays big-graph latency.
+
+    def _rung_for_slots(self, seqs: List[Sequence]) -> int:
+        """Smallest ladder rung whose graph covers every slot in
+        ``seqs`` (the slots staged into the dispatch arrays)."""
+        hi = max((s.slot for s in seqs), default=-1) + 1
+        for r in self.ladder:
+            if r >= hi:
+                return r
+        return self.ladder[-1]
+
+    def _note_rung(self, rung: int) -> None:
+        """Record the dispatch rung (gauge + graph-switch counter)."""
+        if rung != self.decode_rung:
+            self.rung_switches_total += 1
+            self.decode_rung = rung
+            self.rung_peak = max(self.rung_peak, rung)
+
+    def _compact_slots(self) -> None:
+        """Step-down helper: relocate bound sequences out of high slots
+        into lower free ones so the next dispatch can run a smaller
+        compiled rung once occupancy drops. A slot move is pure host
+        bookkeeping — block tables ship per dispatch, KV pages never
+        move — but it is only legal while NO dispatch-ahead call is in
+        flight (in-flight calls address lanes by the slot they were
+        staged at). Mid-incremental-prefill sequences relocate too:
+        their chunk dispatches address pages, not slots."""
+        if len(self.ladder) == 1 or self._inflight:
+            return
+        bound = [i for i, s in enumerate(self.slots) if s is not None]
+        if not bound:
+            return
+        target = next(r for r in self.ladder if r >= len(bound))
+        if bound[-1] < target:
+            return                        # already fits the target rung
+        free = [i for i in range(target) if self.slots[i] is None]
+        for i in reversed(bound):
+            if i < target or not free:
+                break
+            j = free.pop(0)
+            seq = self.slots[i]
+            self.slots[j], self.slots[i] = seq, None
+            seq.slot = j
+
+    def _stage_buffers(self, rung: int) -> dict:
+        """Persistent per-rung staging arrays (stage_host_reuse). Rows
+        refresh incrementally: per-dispatch fields (token, ctx) always;
+        sampling params only when the slot's occupant changes; the
+        block-table row only when its (len, evicted) key moves."""
+        buf = self._stage_bufs.get(rung)
+        if buf is None:
+            buf = {
+                "tokens": np.zeros((rung,), np.int32),
+                "ctx": np.zeros((rung,), np.int32),
+                "bts": np.zeros((rung, self.max_pages), np.int32),
+                "temps": np.zeros((rung,), np.float32),
+                "top_ps": np.ones((rung,), np.float32),
+                "top_ks": np.zeros((rung,), np.int32),
+                "seeds": np.full((rung,), -1, np.int32),
+                "rpens": np.ones((rung,), np.float32),
+                "rlasts": np.zeros((rung,), np.int32),
+                "windows": np.full((rung, PENALTY_WINDOW), -1, np.int32),
+                "owner": [None] * rung,
+                "bt_key": [None] * rung,
+            }
+            self._stage_bufs[rung] = buf
+        return buf
+
+    def _stage_forget(self, seq: Sequence) -> None:
+        """Drop a departing sequence's staging-buffer rows (every rung;
+        identity scan because compaction may have left it cached under
+        an older slot). Without this the owner lists would pin finished
+        Sequences — and their full token histories — until the same
+        slot happens to restage at the same rung."""
+        for buf in self._stage_bufs.values():
+            owner = buf["owner"]
+            for i, s in enumerate(owner):
+                if s is seq:
+                    owner[i] = None
+                    buf["bt_key"][i] = None
+
+    def _stage_batch(self, active_seqs: List[Sequence], rung: int):
         """Fill the per-slot host arrays shared by both decode entry points:
         (tokens, ctx_lens, block_tables, temps, top_ps, top_ks, seeds,
-        rpens, rlasts, windows) — [B]-shaped ([B, W] for windows)."""
-        b = self.engine_cfg.max_batch_size
-        tokens = np.zeros((b,), np.int32)
-        ctx_lens = np.zeros((b,), np.int32)
-        bts = np.zeros((b, self.max_pages), np.int32)
-        temps = np.zeros((b,), np.float32)
-        top_ps = np.ones((b,), np.float32)
-        top_ks = np.zeros((b,), np.int32)
-        seeds = np.full((b,), -1, np.int32)
-        rpens = np.ones((b,), np.float32)
-        rlasts = np.zeros((b,), np.int32)
-        windows = np.full((b, PENALTY_WINDOW), -1, np.int32)
+        rpens, rlasts, windows) — [rung]-shaped ([rung, W] for windows).
+
+        With ``stage_host_reuse`` (default) the arrays persist across
+        dispatches and only changed rows are rewritten; the device gets
+        COPIES because jnp.asarray aliases numpy memory on CPU and the
+        buffers mutate next step. Rows of freed slots go stale, which is
+        benign: their ``allowed`` is 0, so the graph masks every read
+        and write (writes land on the trash page) and their token is
+        discarded (-1)."""
+        if not self._stage_reuse:
+            # Legacy rebuild-per-dispatch (the bubble comparison arm).
+            tokens = np.zeros((rung,), np.int32)
+            ctx_lens = np.zeros((rung,), np.int32)
+            bts = np.zeros((rung, self.max_pages), np.int32)
+            temps = np.zeros((rung,), np.float32)
+            top_ps = np.ones((rung,), np.float32)
+            top_ks = np.zeros((rung,), np.int32)
+            seeds = np.full((rung,), -1, np.int32)
+            rpens = np.ones((rung,), np.float32)
+            rlasts = np.zeros((rung,), np.int32)
+            windows = np.full((rung, PENALTY_WINDOW), -1, np.int32)
+            for seq in active_seqs:
+                i = seq.slot
+                tokens[i] = seq.last_token
+                ctx_lens[i] = seq.ctx_len
+                bts[i] = self._block_table_array(seq.pages)
+                temps[i] = seq.temperature
+                top_ps[i] = seq.top_p
+                top_ks[i], seeds[i] = self._sampling_arrays(seq)
+                rpens[i], rlasts[i] = self._penalty_arrays(seq)
+                if rpens[i] != 1.0:
+                    windows[i] = self._penalty_window_row(seq)
+            return (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
+                    rpens, rlasts, windows)
+        buf = self._stage_buffers(rung)
+        owner, bt_key = buf["owner"], buf["bt_key"]
         for seq in active_seqs:
             i = seq.slot
-            tokens[i] = seq.last_token
-            ctx_lens[i] = seq.ctx_len
-            bts[i] = self._block_table_array(seq.pages)
-            temps[i] = seq.temperature
-            top_ps[i] = seq.top_p
-            top_ks[i], seeds[i] = self._sampling_arrays(seq)
-            rpens[i], rlasts[i] = self._penalty_arrays(seq)
-            if rpens[i] != 1.0:
-                windows[i] = self._penalty_window_row(seq)
-        return (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
-                rpens, rlasts, windows)
+            buf["tokens"][i] = seq.last_token
+            buf["ctx"][i] = seq.ctx_len
+            if owner[i] is not seq:
+                owner[i] = seq
+                bt_key[i] = None
+                buf["temps"][i] = seq.temperature
+                buf["top_ps"][i] = seq.top_p
+                buf["top_ks"][i], buf["seeds"][i] = \
+                    self._sampling_arrays(seq)
+                buf["rpens"][i], buf["rlasts"][i] = \
+                    self._penalty_arrays(seq)
+            # Pages mutate by growing (decode grants / prefill setup),
+            # by behind-window eviction (entries zeroed, cursor moves),
+            # or by wholesale replacement at a (re)prefill — keyed by
+            # (version, len, evicted) so every one of those invalidates.
+            key = (seq.pages_version, len(seq.pages), seq.evicted_pages)
+            if bt_key[i] != key:
+                bt_key[i] = key
+                row = buf["bts"][i]
+                n = len(seq.pages)
+                row[:n] = seq.pages
+                row[n:] = 0
+            if buf["rpens"][i] != 1.0:
+                buf["windows"][i] = self._penalty_window_row(seq)
+        return (buf["tokens"].copy(), buf["ctx"].copy(), buf["bts"].copy(),
+                buf["temps"].copy(), buf["top_ps"].copy(),
+                buf["top_ks"].copy(), buf["seeds"].copy(),
+                buf["rpens"].copy(), buf["rlasts"].copy(),
+                buf["windows"].copy())
 
     def decode_step(self) -> Dict[int, int]:
         """One batched decode step (single-step view of the fused graph:
@@ -1910,7 +2088,7 @@ class InferenceEngine:
         k_steps = max(1, ecfg.decode_steps_per_call)
         if max_steps is not None:
             k_steps = min(k_steps, max_steps)
-        b = ecfg.max_batch_size
+        self._compact_slots()         # step the ladder down when possible
         active_seqs = self.active_sequences()
         if not active_seqs:
             return {}
@@ -1933,8 +2111,11 @@ class InferenceEngine:
         if not active_seqs:
             return {}
 
+        # Dispatch at the smallest compiled rung covering the batch.
+        b = self._rung_for_slots(active_seqs)
+        self._note_rung(b)
         (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
-         rpens, rlasts, windows) = self._stage_batch(active_seqs)
+         rpens, rlasts, windows) = self._stage_batch(active_seqs, b)
         allowed = np.zeros((b,), np.int32)
         eos_ids = np.full((b,), -1, np.int32)
         for seq in active_seqs:
@@ -2024,7 +2205,7 @@ class InferenceEngine:
             self.telemetry.prefill_dispatches.inc()
             chunk["seq"].dispatch_wall_s += dt
         return {"outs": None, "final": None, "final_window": None,
-                "allowed": {}, "seqs": {},
+                "allowed": {}, "seqs": {}, "rung": 0,
                 "prefill": {"seq": chunk["seq"], "prompt": chunk["prompt"],
                             "final": chunk["final"], "tok": p_tok}}
 
@@ -2046,6 +2227,8 @@ class InferenceEngine:
         """
         ecfg = self.engine_cfg
         k_steps = max(1, ecfg.decode_steps_per_call)
+        if not self._inflight:
+            self._compact_slots()     # rung can step down between bursts
         # Predicted per-slot ctx advance from unsynced calls.
         ahead: Dict[int, int] = {}
         for call in self._inflight:
@@ -2095,9 +2278,18 @@ class InferenceEngine:
         # last batch row).
         active_seqs = [s for s in active_seqs
                        if not s.done and s.slot >= 0]
-        b = ecfg.max_batch_size
+        # Ladder rung for this call: smallest compiled graph covering
+        # the staged slots, never below any in-flight call's rung —
+        # carry folds are element-wise over [rung] arrays, so every
+        # in-flight call must share one width. Growth past the in-flight
+        # rung is handled by the callers (they drain first); shrink lags
+        # the pipeline depth, then steps down here.
+        b = self._rung_for_slots(active_seqs)
+        for call in self._inflight:
+            b = max(b, call["rung"])
+        self._note_rung(b)
         (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
-         rpens, rlasts, windows) = self._stage_batch(active_seqs)
+         rpens, rlasts, windows) = self._stage_batch(active_seqs, b)
         allowed = np.zeros((b,), np.int32)
         eos_ids = np.full((b,), -1, np.int32)
         for seq in staged:
@@ -2150,7 +2342,7 @@ class InferenceEngine:
             chunk["seq"].dispatch_wall_s += dt
         call = {"outs": outs, "final": final,
                 "final_window": final_window,
-                "allowed": allowed_by_slot,
+                "allowed": allowed_by_slot, "rung": b,
                 "seqs": {s.slot: s for s in staged}}
         if chunk is not None:
             call["prefill"] = {"seq": chunk["seq"], "prompt": chunk["prompt"],
@@ -2236,6 +2428,32 @@ class InferenceEngine:
             result.setdefault(rid, []).extend(toks)
         return result
 
+    def _pipeline_rung_blocked(self) -> bool:
+        """True when staging now would need a bigger ladder rung than
+        the in-flight calls were staged at — carry folds are element-
+        wise over [rung] arrays, so the pipeline must settle before the
+        batch grows past its compiled width. Growth is an occupancy-
+        increasing moment (a fresh prefill just took a high slot), so
+        the one-call hiccup is rare and bounded."""
+        if not self._inflight or len(self.ladder) == 1:
+            return False
+        # Chunk-only prefill calls (rung 0) have no decode half — no
+        # carry to fold, so they impose no width constraint and must
+        # not masquerade as a cap (that would drain the pipeline every
+        # chunk and re-serialize exactly the stall hybrid chaining
+        # removes).
+        rungs = [call["rung"] for call in self._inflight
+                 if call["final"] is not None]
+        if not rungs:
+            return False
+        cap = max(rungs)
+        if cap >= self.ladder[-1]:
+            return False
+        active = self.active_sequences()
+        if not active:
+            return False
+        return self._rung_for_slots(active) > cap
+
     def decode_steps_pipelined(self) -> Dict[int, List[int]]:
         """Dispatch-ahead serving step: keep up to
         ``decode_pipeline_depth`` fused-decode calls in flight; sync only
@@ -2249,14 +2467,18 @@ class InferenceEngine:
         if self.admission == "optimistic" and self.under_pressure:
             return self._pressure_settle_round()
         self._chaos_step_gate()
+        result: Dict[int, List[int]] = {}
+        if self._pipeline_rung_blocked():
+            result = self.drain_pipeline()     # settle, then grow rung
         call = self._stage_decode_call()
         if call is not None:
             self._inflight.append(call)
         if not self._inflight:
-            return {}
+            return result
         if len(self._inflight) >= depth or call is None:
-            return self._sync_oldest()
-        return {}
+            for rid, toks in self._sync_oldest().items():
+                result.setdefault(rid, []).extend(toks)
+        return result
 
     def hybrid_step_pipelined(self, seq: Sequence) -> Dict[int, List[int]]:
         """Serving step while ``seq`` is mid-incremental-prefill: advance
@@ -2293,14 +2515,18 @@ class InferenceEngine:
                 self.prefill_step(seq)
             return result
         self._chaos_step_gate()
+        result: Dict[int, List[int]] = {}
+        if self._pipeline_rung_blocked():
+            result = self.drain_pipeline()     # settle, then grow rung
         call = self._stage_decode_call(prefill_seq=seq)
         if call is not None:
             self._inflight.append(call)
         if not self._inflight:
-            return {}
+            return result
         if depth <= 1 or len(self._inflight) >= depth or call is None:
-            return self._sync_oldest()
-        return {}
+            for rid, toks in self._sync_oldest().items():
+                result.setdefault(rid, []).extend(toks)
+        return result
 
     @property
     def pipeline_pending(self) -> bool:
@@ -2357,9 +2583,11 @@ class InferenceEngine:
             if need > 0:
                 seq.pages.extend(self._allocate_reclaiming(need))
 
-        b = ecfg.max_batch_size
+        self._compact_slots()
+        b = self._rung_for_slots(active_seqs)
+        self._note_rung(b)
         (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
-         rpens, rlasts, windows) = self._stage_batch(active_seqs)
+         rpens, rlasts, windows) = self._stage_batch(active_seqs, b)
         allowed = np.zeros((b,), np.int32)
         for seq in active_seqs:
             allowed[seq.slot] = k_steps
@@ -2454,11 +2682,12 @@ class InferenceEngine:
         if not active_seqs:
             return {}
 
-        b = ecfg.max_batch_size
+        b = ecfg.max_batch_size       # spec runs single-rung (the top)
         # Seeds and repetition penalties are not plumbed into spec rounds
         # (rejection sampling needs the unmodified target distribution).
         (tokens, ctx_lens, bts, temps, top_ps, top_ks,
-         _seeds, _rpens, _rlasts, _windows) = self._stage_batch(active_seqs)
+         _seeds, _rpens, _rlasts, _windows) = self._stage_batch(active_seqs,
+                                                               b)
         cap = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         for seq in active_seqs:

@@ -103,6 +103,18 @@ class SchedulerStats:
             "hybrid_steps": engine.hybrid_steps_total,
             "pool_pressure": round(engine.pool_pressure, 4),
             "mean_batch_occupancy": occ,
+            # Batch ladder (README "Batch ladder"): compiled rungs, the
+            # rung the latest dispatch ran, the highest rung reached,
+            # graph switches, current lane occupancy over the top rung,
+            # and the scrape-window MFU estimate.
+            "decode_ladder": list(engine.ladder),
+            "decode_rung": engine.decode_rung,
+            "rung_peak": engine.rung_peak,
+            "rung_switches": engine.rung_switches_total,
+            "lane_occupancy": round(
+                sum(s is not None for s in engine.slots)
+                / max(engine.ladder[-1], 1), 4),
+            "mfu_estimate": engine.telemetry.mfu_estimate(),
             "kv_pages_total": total,
             "kv_pages_in_use": total - engine.allocator.num_free,
             "peak_pages_in_use": self.peak_pages_in_use,
@@ -413,7 +425,11 @@ class EngineScheduler:
         start_chunked: Optional[_Pending] = None
         reserved = 0
         with self._lock:
-            free_slots = len(self.engine.free_slots())
+            engine = self.engine
+            free_slots = len(engine.free_slots())
+            bound = sum(s is not None for s in engine.slots)
+            base_rung = engine.ladder[0]
+            headroom = engine.engine_cfg.ladder_admit_headroom_pages
             while (len(batch) < self.max_prefills_per_step
                    and len(batch) < free_slots and self._waiting):
                 pending = self._waiting[0]
@@ -427,6 +443,20 @@ class EngineScheduler:
                 # prompt footprint + headroom (engine._pages_for_admission).
                 need = self.engine._pages_for_admission(pending.seq)
                 if self.engine._free_plus_evictable() < reserved + need:
+                    break
+                # Batch-ladder pool-vs-lanes guard: growing the batch
+                # past the BASE rung must leave at least
+                # ``ladder_admit_headroom_pages`` of reclaimable slack
+                # behind — extra lanes must not drain the pool to the
+                # preemption watermark or force decode grants to evict
+                # the whole hot set (with a host tier the evictions
+                # demote and survive; the headroom keeps either tier's
+                # churn off the steady-state path). Below the base
+                # rung, admission keeps the legacy gate.
+                if (headroom > 0
+                        and bound + len(batch) + 1 > base_rung
+                        and engine._free_plus_evictable()
+                        < reserved + need + headroom):
                     break
                 if self._needs_chunking(pending.seq):
                     if self._prefilling is not None:

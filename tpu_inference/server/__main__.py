@@ -34,6 +34,22 @@ def main() -> None:
                    help="decode slots in the batched graph, or 'auto': "
                         "size from the chip's HBM after weights "
                         "(engine/autosize.py)")
+    p.add_argument("--decode-ladder", default="auto",
+                   help="compiled decode-graph batch ladder: 'auto' "
+                        "(doubling rungs 8/16/32/... up to max-batch-"
+                        "size — with --max-batch-size auto this is the "
+                        "HBM-derived ladder), 'off' (one graph at "
+                        "max-batch-size, legacy), or explicit comma "
+                        "rungs e.g. '8,16,32'. The engine dispatches "
+                        "at the smallest rung covering the occupied "
+                        "lanes and steps between rungs as occupancy "
+                        "changes (README 'Batch ladder')")
+    p.add_argument("--ladder-admit-headroom-pages", type=int, default=0,
+                   help="batch-ladder admission guard: growing the "
+                        "batch past the base rung must leave this many "
+                        "reclaimable KV pages spare, so more lanes "
+                        "never drain the pool to the preemption "
+                        "watermark or churn the hot cache set; 0 = off")
     p.add_argument("--num-pages", type=int_or_auto, default=512,
                    help="KV pool pages, or 'auto': fill the HBM left "
                         "after weights + activation headroom")
@@ -237,6 +253,19 @@ def main() -> None:
 
     max_batch_size, num_pages = resolve_sizing_args(args)
 
+    from tpu_inference.engine.autosize import parse_decode_ladder
+
+    try:
+        decode_ladder = parse_decode_ladder(args.decode_ladder,
+                                            max_batch_size)
+    except ValueError as e:
+        p.error(str(e))
+    if len(decode_ladder) > 1:
+        import sys
+
+        print(f"[autosize] decode ladder: {list(decode_ladder)} "
+              f"(graph per rung, top = max_batch_size)", file=sys.stderr)
+
     host_cache_pages = args.host_cache_pages
     if host_cache_pages == "auto":
         from tpu_inference.engine.autosize import (
@@ -288,6 +317,9 @@ def main() -> None:
                           sp_attn=args.sp_attn,
                           quant=args.quant, kv_quant=args.kv_quant,
                           max_batch_size=max_batch_size,
+                          decode_ladder=decode_ladder,
+                          ladder_admit_headroom_pages=(
+                              args.ladder_admit_headroom_pages),
                           host_cache_pages=host_cache_pages,
                           num_pages=num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,

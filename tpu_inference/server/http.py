@@ -170,6 +170,10 @@ class InferenceServer:
               else "off")
         cap = scfg.admission_queue_depth or "off"
         host_pages = self.cfg.engine.host_cache_pages
+        ladder = self.engine.ladder
+        if len(ladder) > 1:
+            print(f"batch ladder: rungs={list(ladder)} "
+                  f"(decode graph per rung; dispatch follows occupancy)")
         print(f"supervision: dp={len(self.group.engines)} "
               f"routing={scfg.routing} "
               f"hit_weight={scfg.route_hit_weight:g} "

@@ -749,7 +749,13 @@ class EngineGroup:
     # utilization = in_use/total stays consistent); depth is config.
     _NON_ADDITIVE = ("model_params", "approx_flops_per_token",
                      "mean_batch_occupancy", "decode_pipeline_depth",
-                     "pool_pressure")
+                     "pool_pressure",
+                     # Batch ladder: rung/occupancy are per-replica
+                     # states (summing rungs would fabricate a fleet
+                     # batch size); re-aggregated below. rung_switches
+                     # stays additive (a fleet churn total).
+                     "decode_rung", "rung_peak", "lane_occupancy",
+                     "mfu_estimate")
 
     def stats_snapshot(self) -> dict:
         """Aggregate counters + per-replica breakdown."""
@@ -782,6 +788,19 @@ class EngineGroup:
             for k in phase_keys}
         agg["mean_batch_occupancy"] = (
             sum(d["mean_batch_occupancy"] for d in per) / len(per))
+        # Batch ladder fleet view: active/peak rung = the highest any
+        # replica runs (replica 0's copy must not masquerade as the
+        # fleet's); occupancy/MFU = fleet means; decode_ladder is the
+        # one shared EngineConfig's rungs, identical on every replica.
+        # Replica detail stays under "replicas".
+        agg["decode_rung"] = max(d["decode_rung"] for d in per)
+        agg["rung_peak"] = max(d["rung_peak"] for d in per)
+        agg["lane_occupancy"] = round(
+            sum(d["lane_occupancy"] for d in per) / len(per), 4)
+        mfus = [d["mfu_estimate"] for d in per
+                if d.get("mfu_estimate") is not None]
+        agg["mfu_estimate"] = (round(sum(mfus) / len(mfus), 6)
+                               if mfus else None)
         if "prefix_cache" in per[0]:
             agg["prefix_cache"] = {
                 k: sum(d["prefix_cache"][k] for d in per)

@@ -615,6 +615,26 @@ class EngineTelemetry:
                 fn=lambda: engine.n_params)
         r.gauge("tpu_inf_active_sequences", "Bound decode slots",
                 fn=lambda: sum(s is not None for s in engine.slots))
+        # Batch ladder (README "Batch ladder"): which compiled decode
+        # graph the engine is currently dispatching, how far up it has
+        # ever climbed, how often it switched graphs, and how full the
+        # top rung's lanes are.
+        r.gauge("tpu_inf_decode_rung",
+                "Active batch-ladder rung (batch size of the compiled "
+                "decode graph the latest dispatch ran)",
+                fn=lambda: engine.decode_rung)
+        r.gauge("tpu_inf_decode_ladder_top",
+                "Top batch-ladder rung (HBM-budgeted max concurrent "
+                "decode lanes)",
+                fn=lambda: engine.ladder[-1])
+        r.counter("tpu_inf_rung_switches_total",
+                  "Decode dispatches that changed ladder rung (compiled-"
+                  "graph switches)",
+                  fn=lambda: engine.rung_switches_total)
+        r.gauge("tpu_inf_decode_occupancy",
+                "Decode lane occupancy: bound slots / top ladder rung",
+                fn=lambda: (sum(s is not None for s in engine.slots)
+                            / max(engine.ladder[-1], 1)))
 
     def bind_host_pool(self, pool) -> None:
         """Read-through metrics over the host-RAM KV tier's capacity
@@ -658,6 +678,47 @@ class EngineTelemetry:
                   fn=lambda: stats.step_failures)
         r.gauge("tpu_inf_queue_depth", "Requests waiting for admission",
                 fn=lambda: len(sched._waiting))
+        # Derived MFU estimate: decoded-token rate x ~2 FLOPs/param/
+        # token over the chip's bf16 peak (engine/autosize.py table;
+        # CPU reports against a v5e, like the rest of the sizing math).
+        # The rate is a dt-weighted EWMA (~30 s time constant) updated by
+        # WHOEVER collects — /metrics scrapes, stats snapshots, and
+        # fleet merges all read the same smoothed value, so a fast
+        # poller can't reset a slow scraper's window (a plain
+        # between-scrapes delta would report only the last poll's
+        # sliver).
+        import math
+
+        from tpu_inference.engine import autosize as _autosize
+
+        engine = sched.engine
+        peak = _autosize.detect_peak_flops()
+        tau_s = 30.0
+        state = {"tokens": stats.tokens_generated,
+                 "t": time.perf_counter(), "rate": 0.0}
+
+        def _mfu() -> float:
+            now = time.perf_counter()
+            dt = now - state["t"]
+            if dt >= 1e-3:
+                tok = stats.tokens_generated
+                inst = max(0, tok - state["tokens"]) / dt
+                alpha = 1.0 - math.exp(-dt / tau_s)
+                state["rate"] += alpha * (inst - state["rate"])
+                state["tokens"], state["t"] = tok, now
+            return state["rate"] * 2 * engine.n_params / peak
+
+        self._mfu_gauge = r.gauge(
+            "tpu_inf_mfu_estimate",
+            "Estimated model FLOPs utilization (EWMA decode tokens/s "
+            "x 2 x params / chip bf16 peak, ~30s time constant)",
+            fn=_mfu)
+
+    def mfu_estimate(self) -> Optional[float]:
+        """Latest scrape-window MFU estimate (None when telemetry is
+        off or no scheduler is bound)."""
+        g = getattr(self, "_mfu_gauge", None)
+        return round(g.collect_value(), 6) if g is not None else None
 
     def request_finished(self, reason: str) -> None:
         """Per-finish-reason counter (lazy label children)."""
