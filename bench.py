@@ -689,6 +689,123 @@ def ladder_lane_child() -> None:
     print(json.dumps(out), flush=True)
 
 
+def spec_lane_child() -> None:
+    """Plain decode vs draft-free ngram speculation through the REAL
+    continuous-batching scheduler, two mixes per arm: an echo-heavy
+    greedy multi-turn mix (turn 2 resends turn 1's transcript; the
+    self-drafting win) and an adversarial no-echo sampled mix (the
+    adaptive-γ throttle must keep spec within noise of plain). Reports
+    pooled per-stream decode rate, aggregate tok/s, acceptance/throttle
+    counters, and a greedy byte-identity check on the echo mix; prints
+    ONE JSON record."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tpu_inference.config import EngineConfig
+    from tpu_inference.engine.engine import InferenceEngine, Sequence
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bench_cfg(platform)
+    page_size = 16
+    n_streams = 4
+    turn_tokens = 192 if on_tpu else 160
+    turn2_tokens = 128 if on_tpu else 96
+    adv_tokens = 192 if on_tpu else 160
+    gamma = 5
+    pages_per_seq = -(-(24 + turn_tokens + turn2_tokens + 8) // page_size) + 1
+    # K=1 keeps the per-dispatch round trip — what accepted speculative
+    # tokens amortize — in the measurement (the ladder lane's stance).
+    k_steps = 8 if on_tpu else 1
+    out = {"lane": "spec", "model": cfg.name, "platform": platform,
+           "streams": n_streams, "gamma": gamma,
+           "turn_tokens": [turn_tokens, turn2_tokens],
+           "adversarial_tokens": adv_tokens, "k_steps": k_steps}
+    transcripts = {}
+
+    def run_mix(engine, prompts, max_tokens, temperature):
+        sched = EngineScheduler(engine).start()
+        seqs = [Sequence(request_id=i, prompt_tokens=list(p),
+                         max_new_tokens=max_tokens,
+                         temperature=temperature)
+                for i, p in enumerate(prompts)]
+        done = {s.request_id: threading.Event() for s in seqs}
+        t0 = time.perf_counter()
+        for s in seqs:
+            sched.submit(s, lambda sq, t: None,
+                         lambda sq, d=done: d[sq.request_id].set())
+        for s in seqs:
+            if not done[s.request_id].wait(240):
+                raise TimeoutError("spec lane deadlocked")
+        wall = time.perf_counter() - t0
+        sched.stop(drain=True, timeout=10)
+        toks = sum(len(s.generated) for s in seqs)
+        dec_t = sum(max(len(s.generated) - 1, 0) for s in seqs)
+        dec_s = sum(s.finish_time - s.first_token_time for s in seqs
+                    if len(s.generated) > 1)
+        return seqs, {"tok_s": _r(toks / wall),
+                      "per_stream_tok_s": _r(dec_t / dec_s, 1)
+                      if dec_s else None}
+
+    rng = np.random.default_rng(3)
+    seed_prompts = [rng.integers(1, cfg.vocab_size, 24).tolist()
+                    for _ in range(n_streams)]
+    adv_prompts = [rng.integers(1, cfg.vocab_size, 24).tolist()
+                   for _ in range(n_streams)]
+    for label, ngram in (("plain", False), ("ngram", True)):
+        ecfg = EngineConfig(
+            page_size=page_size,
+            num_pages=pages_per_seq * n_streams + 32,
+            max_pages_per_seq=pages_per_seq, max_batch_size=n_streams,
+            prefill_buckets=(64, 128, 256), decode_steps_per_call=k_steps,
+            **({"spec_mode": "ngram", "num_speculative_tokens": gamma}
+               if ngram else {}))
+        engine = InferenceEngine(cfg, ecfg, seed=0)
+        engine.warmup()
+        # Echo mix: two greedy turns, turn 2 resends turn 1's transcript.
+        t1, echo1 = run_mix(engine, seed_prompts, turn_tokens, 0.0)
+        turn2 = [list(p) + list(s.generated)
+                 for p, s in zip(seed_prompts, t1)]
+        t2, echo2 = run_mix(engine, turn2, turn2_tokens, 0.0)
+        transcripts[label] = ([list(s.generated) for s in t1]
+                              + [list(s.generated) for s in t2])
+        dec = [echo1, echo2]
+        dec_rates = [d["per_stream_tok_s"] for d in dec
+                     if d["per_stream_tok_s"]]
+        # Adversarial mix on a FRESH engine (prefix cache/state clean).
+        engine2 = InferenceEngine(cfg, ecfg, seed=0)
+        engine2.warmup()
+        _, adv = run_mix(engine2, adv_prompts, adv_tokens, 1.0)
+        out[label] = {
+            "echo_per_stream_tok_s": _r(sum(dec_rates) / len(dec_rates), 1)
+            if dec_rates else None,
+            "echo_tok_s": echo1["tok_s"],
+            "adversarial_per_stream_tok_s": adv["per_stream_tok_s"],
+            "spec_drafted": engine.spec_drafted,
+            "spec_accepted": engine.spec_accepted,
+            "acceptance_rate": _r(engine.spec_accepted
+                                  / max(engine.spec_drafted, 1), 4),
+            "adversarial_throttles": engine2.spec_throttles_total,
+            "adversarial_fallback_rounds": engine2.spec_fallback_rounds,
+        }
+        del engine, engine2
+        gc.collect()
+    pl, ng = out["plain"], out["ngram"]
+    out["outputs_identical"] = transcripts["plain"] == transcripts["ngram"]
+    out["echo_per_stream_ratio"] = _ratio(ng["echo_per_stream_tok_s"],
+                                          pl["echo_per_stream_tok_s"])
+    out["adversarial_ratio"] = _ratio(ng["adversarial_per_stream_tok_s"],
+                                      pl["adversarial_per_stream_tok_s"])
+    out["spec_wins"] = bool(
+        out["outputs_identical"] and ng["spec_accepted"] > 0
+        and (out["echo_per_stream_ratio"] or 0) > 1.0)
+    out["spec_never_loses"] = bool((out["adversarial_ratio"] or 0) >= 0.95)
+    print(json.dumps(out), flush=True)
+
+
 def tiering_lane_child() -> None:
     """Host tier off vs on through a REAL scheduler with the HBM pool
     sized ~4x below the conversations' KV working set (README "Tiered
@@ -1051,6 +1168,12 @@ def _snapshot(probe, lanes, degraded, partial, t_start):
         "ladder_comparison": (
             lanes["ladder"] if lanes.get("ladder", {}).get("bs8")
             else None),
+        # plain vs draft-free ngram speculation comparison (echo-mix
+        # per-stream decode ratio + byte-identity, adversarial-mix
+        # never-loses ratio) when the lane ran.
+        "spec_comparison": (
+            lanes["spec"] if lanes.get("spec", {}).get("plain")
+            else None),
         "chip": probe.get("device_kind"),
         "platform": probe.get("platform"),
         "backends_token_equal": heads_equal,
@@ -1185,6 +1308,18 @@ def orchestrate() -> None:
         lanes["ladder"] = rec or {"lane": "ladder",
                                   "skipped": f"lane-failed rc={rc}"}
         _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
+    # Draft-free speculation comparison lane (plain vs ngram spec
+    # through the scheduler, echo + adversarial mixes): measurement-only
+    # extra as well.
+    if give_up:
+        lanes["spec"] = {"lane": "spec", "skipped": "tpu-wedged-midrun"}
+    elif budget_left() < lane_timeout:
+        lanes["spec"] = {"lane": "spec", "skipped": "budget-exhausted"}
+    else:
+        rc, rec = _run_child(["--spec-lane"], lane_timeout, env)
+        lanes["spec"] = rec or {"lane": "spec",
+                                "skipped": f"lane-failed rc={rc}"}
+        _snapshot(probe, lanes, degraded, partial=True, t_start=t_start)
     # Tiered-KV-cache comparison lane (host tier off vs on through the
     # scheduler, pool ~4x oversubscribed): measurement-only extra too.
     if give_up:
@@ -1210,6 +1345,8 @@ if __name__ == "__main__":
         routing_lane_child()
     elif "--ladder-lane" in sys.argv:
         ladder_lane_child()
+    elif "--spec-lane" in sys.argv:
+        spec_lane_child()
     elif "--tiering-lane" in sys.argv:
         tiering_lane_child()
     elif "--lane" in sys.argv:

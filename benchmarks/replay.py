@@ -175,8 +175,13 @@ def start_server(args) -> tuple:
             "route_hit_weight": getattr(args, "route_hit_weight", 1.0),
             "route_host_hit_weight":
                 getattr(args, "route_host_hit_weight", 0.5)},
-        num_speculative_tokens=(args.num_speculative_tokens
-                                if args.draft_model else 0),
+        spec_mode=("ngram" if getattr(args, "spec_mode", None) == "ngram"
+                   else "draft"),
+        ngram_window=getattr(args, "ngram_window", 3),
+        num_speculative_tokens=(
+            args.num_speculative_tokens
+            if (args.draft_model
+                or getattr(args, "spec_mode", None) == "ngram") else 0),
         # Smoke lane: small prefill buckets so the CPU tier-1 run
         # compiles in seconds, not minutes.
         **({"prefill_buckets": (16, 32, 64)}
@@ -242,6 +247,12 @@ def main() -> dict:
     p.add_argument("--draft-model", default=None)
     p.add_argument("--draft-checkpoint", default=None)
     p.add_argument("--num-speculative-tokens", type=int, default=4)
+    p.add_argument("--spec-mode", default=None, choices=("ngram",),
+                   help="'ngram' = draft-free self-drafting speculation "
+                        "(README 'Speculative decoding'); default off")
+    p.add_argument("--ngram-window", type=int, default=3,
+                   help="ngram spec: longest suffix n-gram matched "
+                        "against each sequence's history")
     p.add_argument("--trace", default="data/trace1.csv")
     p.add_argument("--data", default="data/conversations.json")
     p.add_argument("--max-trace", type=int, default=100)
@@ -320,6 +331,18 @@ def main() -> dict:
     p.add_argument("--ladder-top", type=int, default=32,
                    help="compare-ladder: top ladder rung (the bs>=32 "
                         "arm the acceptance gate measures)")
+    p.add_argument("--compare-spec", action="store_true",
+                   help="run two pinned mixes twice each — plain decode "
+                        "vs draft-free ngram speculation — and commit "
+                        "the spec artifact: per-stream decode tok/s and "
+                        "outputs_sha256 byte-identity on an echo-heavy "
+                        "greedy multi-turn mix (where self-drafting "
+                        "wins), plus throughput on an adversarial "
+                        "no-echo sampled mix (where adaptive γ must "
+                        "throttle so spec never loses), with acceptance-"
+                        "rate / throttle telemetry from /metrics")
+    p.add_argument("--spec-streams", type=int, default=4,
+                   help="compare-spec: concurrent streams per mix")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -330,12 +353,12 @@ def main() -> dict:
     args = p.parse_args()
 
     if sum(map(bool, (args.compare_admission, args.compare_hybrid,
-                      args.compare_ladder))) > 1:
+                      args.compare_ladder, args.compare_spec))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
-        p.error("--compare-admission/--compare-hybrid/--compare-ladder "
-                "are mutually exclusive; run them as separate "
-                "invocations")
+        p.error("--compare-admission/--compare-hybrid/--compare-ladder/"
+                "--compare-spec are mutually exclusive; run them as "
+                "separate invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -375,11 +398,30 @@ def main() -> dict:
             args.max_batch_size = 8            # per-arm override below
             args.num_pages, args.max_pages_per_seq = 448, 8
             args.decode_steps_per_call = 1
+        if args.compare_spec:
+            # The comparison needs room for multi-turn transcripts (two
+            # turns of prompt+reply per stream: 256-token contexts),
+            # long enough generations that the tiny greedy model's
+            # repetition cycles form (the echo self-drafting exploits),
+            # and a γ deep enough that an accepted round visibly beats
+            # a plain dispatch. K=1 keeps the per-dispatch host round
+            # trip — the cost every accepted speculative token removes —
+            # in the measurement (the compare-ladder stance: the fused-K
+            # scan is compute-bound on CPU and would bury the dispatch
+            # amortization this lane pins; on TPU decode is HBM-bound
+            # and the verify's extra positions ride the same weight
+            # stream).
+            args.max_pages_per_seq, args.num_pages = 64, 320
+            args.decode_steps_per_call = 1
+            args.num_speculative_tokens = 5
+            args.ngram_window = 3
         if args.out is None:
             args.out = ("benchmarks/results/replay_hybrid.json"
                         if args.compare_hybrid
                         else "benchmarks/results/replay_ladder.json"
                         if args.compare_ladder
+                        else "benchmarks/results/replay_spec.json"
+                        if args.compare_spec
                         else "benchmarks/results/replay_smoke.json")
 
     if args.platform != "auto":
@@ -418,6 +460,8 @@ def main() -> dict:
         return _compare_hybrid(args)
     if args.compare_ladder:
         return _compare_ladder(args)
+    if args.compare_spec:
+        return _compare_spec(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -502,6 +546,11 @@ def run_replay(args) -> dict:
             "shed_rate": summary["shed_rate"],
         }
         summary["phase_breakdown"] = phase_breakdown(before, after)
+        # Speculative-decoding lane (README "Speculative decoding"):
+        # mode/γ/acceptance from the server's own counters when spec is
+        # on (absent otherwise).
+        if after.get("speculative"):
+            summary["speculative"] = after["speculative"]
         # Hybrid-stepping lane: the decode-stall-during-prefill numbers
         # the serial-vs-hybrid artifact compares (count 0 -> p95 0.0:
         # nothing ever stalled).
@@ -857,6 +906,194 @@ def _compare_ladder(args) -> dict:
     _write_out(args.out, out)
     result = dict(comparison)
     result.update(bs8=bs8, ladder=lad, ladder_rebuild=reb)
+    return result
+
+
+async def _spec_burst(port: int, model: str, prompts: list,
+                      max_tokens: int, temperature: float) -> list:
+    """Fire one request per prompt at once (non-streamed) and return
+    [{reply, eval_count, eval_duration_ns}] in prompt order — the spec
+    arms hash replies for byte-identity and read per-stream decode rate
+    from the server's own eval accounting."""
+    import aiohttp
+
+    url = f"http://127.0.0.1:{port}/api/generate"
+    timeout = aiohttp.ClientTimeout(total=1800)
+
+    async def one(session, prompt: str) -> dict:
+        payload = {"model": model, "prompt": prompt,
+                   "temperature": temperature, "stream": False,
+                   "options": {"num_predict": max_tokens}}
+        async with session.post(url, json=payload) as resp:
+            resp.raise_for_status()
+            rec = await resp.json()
+        return {"reply": rec.get("response", ""),
+                "eval_count": rec.get("eval_count", 0),
+                "eval_duration_ns": rec.get("eval_duration", 0)}
+
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        return list(await asyncio.gather(*[one(session, p)
+                                           for p in prompts]))
+
+
+def _spec_arm(args, label: str, mix: str, ngram: bool) -> dict:
+    """Boot one server (plain or ngram-spec), run one pinned mix, and
+    summarize: per-stream decode tok/s (server-side eval accounting, so
+    queue effects don't pollute the per-stream claim), aggregate tok/s,
+    a transcript hash, and the /metrics speculative block.
+
+    Mixes:
+    - "echo": greedy, two turns per stream, turn 2 re-sends turn 1's
+      transcript — the multi-turn/RAG echo shape self-drafting exists
+      for (the tiny model's greedy repetition cycles stand in for
+      real-text echo). Byte-identity across arms is asserted here.
+    - "adversarial": temperature-sampled streams whose proposals almost
+      never verify — the mix adaptive γ must throttle on so spec never
+      loses. No byte-identity (sampled), throughput only.
+    """
+    import hashlib
+
+    print(f"[replay] spec arm: {label}/{mix}", file=sys.stderr)
+    args.spec_mode = "ngram" if ngram else None
+    srv, port, stop = start_server(args)
+    n = args.spec_streams
+    try:
+        t0 = time.perf_counter()
+        if mix == "echo":
+            turn1 = [f"<s{i}> the quick brown fox {i:02d} " for i in range(n)]
+            rec1 = asyncio.run(_spec_burst(port, args.model, turn1,
+                                           max_tokens=200, temperature=0.0))
+            turn2 = [p + r["reply"] for p, r in zip(turn1, rec1)]
+            rec2 = asyncio.run(_spec_burst(port, args.model, turn2,
+                                           max_tokens=120, temperature=0.0))
+            records = rec1 + rec2
+        else:
+            rng = __import__("random").Random(1234)
+            # 2n streams (two admission waves): decode-phase rates are
+            # queue-independent, and the larger sample steadies the
+            # median on a noisy CI box.
+            prompts = ["".join(chr(33 + rng.randrange(90))
+                               for _ in range(24)) for _ in range(2 * n)]
+            # Long streams: the never-lose overhead (initial narrow
+            # rounds + backed-off probes) is front-loaded, so length
+            # amortizes it toward zero — and steadies the rates.
+            records = asyncio.run(_spec_burst(port, args.model, prompts,
+                                              max_tokens=320,
+                                              temperature=1.0))
+        wall = time.perf_counter() - t0
+        after = json.loads(scrape_metrics(port, fmt="json")[0])
+    finally:
+        stop()
+    h = hashlib.sha256()
+    for r in records:
+        h.update(r["reply"].encode())
+        h.update(b"\x00")
+    tokens = sum(r["eval_count"] for r in records)
+    timed = sorted((r for r in records
+                    if r["eval_count"] > 1 and r["eval_duration_ns"] > 0),
+                   key=lambda r: (r["eval_count"] - 1)
+                   / r["eval_duration_ns"])
+    if len(timed) > 4:
+        # Trim each arm's fastest and slowest record before pooling: one
+        # GC pause or OS-scheduler stall hitting one stream otherwise
+        # dominates the pooled rate on a shared CI box.
+        timed = timed[1:-1]
+    eval_toks = sum(r["eval_count"] - 1 for r in timed)
+    eval_s = sum(r["eval_duration_ns"] / 1e9 for r in timed)
+    spec = after.get("speculative") or {}
+    return {
+        "label": label, "mix": mix, "streams": n,
+        "requests": len(records),
+        "output_tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(tokens / wall, 2),
+        # Pooled per-stream decode rate from the server's own
+        # eval_duration (total decode tokens / total decode wall across
+        # streams) — the "per-stream tok/s" the acceptance gate names.
+        # Decode phase only, so queue wait / prefill / HTTP noise are
+        # excluded by design, and pooling beats a median of few noisy
+        # per-request rates on a loaded CI box.
+        "per_stream_tok_s": round(eval_toks / eval_s, 2) if eval_s
+        else None,
+        "outputs_sha256": h.hexdigest(),
+        "speculative": {k: spec.get(k) for k in
+                        ("mode", "gamma", "drafted", "accepted",
+                         "acceptance_rate", "rounds", "fallback_rounds",
+                         "throttles")} if spec else None,
+    }
+
+
+def _compare_spec(args) -> dict:
+    """The draft-free speculation artifact (README "Speculative
+    decoding"): the same pinned echo-heavy greedy multi-turn mix served
+    plain and with ngram self-drafting (byte-identical outputs required
+    — speculation is a scheduling decision, never a behavior change),
+    plus an adversarial no-echo sampled mix where the adaptive-γ
+    throttle must keep the spec arm within noise of plain (spec never
+    loses)."""
+    cfg_snapshot = dict(vars(args))
+    arms = {}
+    for mix in ("echo", "adversarial"):
+        for label, ngram in (("plain", False), ("ngram", True)):
+            arms[f"{mix}_{label}"] = _spec_arm(args, label, mix, ngram)
+    args.spec_mode = None
+
+    def _ratio(a, b):
+        return round(a / b, 4) if a and b else None
+
+    ep, en = arms["echo_plain"], arms["echo_ngram"]
+    ap, an = arms["adversarial_plain"], arms["adversarial_ngram"]
+    espec = en["speculative"] or {}
+    aspec = an["speculative"] or {}
+    comparison = {
+        "gamma": espec.get("gamma"),
+        # Echo mix: the win. Byte-identity is the deterministic claim;
+        # the per-stream decode ratio is the headline magnitude.
+        "per_stream_tok_s_plain": ep["per_stream_tok_s"],
+        "per_stream_tok_s_ngram": en["per_stream_tok_s"],
+        "per_stream_ratio": _ratio(en["per_stream_tok_s"],
+                                   ep["per_stream_tok_s"]),
+        "tokens_per_s_plain": ep["tokens_per_s"],
+        "tokens_per_s_ngram": en["tokens_per_s"],
+        "tok_s_ratio": _ratio(en["tokens_per_s"], ep["tokens_per_s"]),
+        "outputs_identical": (ep["outputs_sha256"]
+                              == en["outputs_sha256"]),
+        "acceptance_rate": espec.get("acceptance_rate"),
+        "spec_drafted": espec.get("drafted"),
+        "spec_accepted": espec.get("accepted"),
+        # Adversarial mix: the insurance. The throttle must engage (or
+        # matchless rounds fall back outright) and the per-stream decode
+        # rate must stay within noise of plain. Per-stream (server-side
+        # eval accounting) is the graded number for both mixes — the
+        # wall-clock aggregates also carry prefill/HTTP/queue noise and
+        # are reported transparently, not graded.
+        "adversarial_per_stream_plain": ap["per_stream_tok_s"],
+        "adversarial_per_stream_ngram": an["per_stream_tok_s"],
+        "adversarial_ratio": _ratio(an["per_stream_tok_s"],
+                                    ap["per_stream_tok_s"]),
+        "adversarial_tok_s_plain": ap["tokens_per_s"],
+        "adversarial_tok_s_ngram": an["tokens_per_s"],
+        "adversarial_acceptance_rate": aspec.get("acceptance_rate"),
+        "adversarial_throttles": aspec.get("throttles"),
+        "adversarial_fallback_rounds": aspec.get("fallback_rounds"),
+        # The artifact's claims. spec_wins carries the deterministic
+        # parts (graded live by the tier-1 smoke); the >=1.3x /
+        # >=0.95x magnitudes are graded on the committed artifact (the
+        # ladder/tiering lanes' stance — CI wall clocks swing).
+        "spec_wins": bool(
+            ep["outputs_sha256"] == en["outputs_sha256"]
+            and (espec.get("accepted") or 0) > 0
+            and (en["per_stream_tok_s"] or 0)
+            > (ep["per_stream_tok_s"] or 0)),
+        "spec_never_loses": bool(
+            (an["per_stream_tok_s"] or 0)
+            >= 0.95 * (ap["per_stream_tok_s"] or 1e9)),
+    }
+    out = {"config": cfg_snapshot, **arms, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(arms)
     return result
 
 

@@ -41,6 +41,7 @@ from tpu_inference.engine.sampling import (
     roll_window,
     sample,
 )
+from tpu_inference.engine.speculative import NGRAM_SCAN_CAP, ngram_propose
 from tpu_inference.models.registry import build_model, get_model_fns
 
 
@@ -310,6 +311,22 @@ class Sequence:
     # are per-request *exposure*, not an additive fleet total.
     dispatch_wall_s: float = 0.0
     bubble_s: float = 0.0
+    # Adaptive-γ state for draft-free n-gram speculation (README
+    # "Speculative decoding"): current per-sequence γ (-1 = engine
+    # default, 0 = throttled), EWMA acceptance rate, and the countdown
+    # until a throttled sequence re-probes. Survives preemption /
+    # recompute-resume — the stream's echo statistics don't change when
+    # its KV pages do.
+    # The EWMA starts mildly optimistic (not 1.0): a fresh echo-free
+    # stream throttles after ~3 rejected rounds instead of ~5, and an
+    # echoic one pulls toward 1 just as fast.
+    spec_gamma: int = -1
+    spec_accept_ewma: float = 0.5
+    spec_probe_countdown: int = 0
+    # Consecutive failed probes back the probe interval off (doubling,
+    # capped at 8x spec_probe_every), so a stream that never echoes
+    # pays a vanishing fraction of its rounds re-checking.
+    spec_probe_interval: int = 0
 
     @property
     def last_token(self) -> int:
@@ -433,8 +450,28 @@ class InferenceEngine:
         self._pressure_target: Optional[int] = None
         if engine_cfg.chaos_page_pressure > 0:
             self.set_page_pressure(engine_cfg.chaos_page_pressure)
-        spec_on = (draft_cfg is not None
-                   and engine_cfg.num_speculative_tokens > 0)
+        # Speculative decoding modes (README "Speculative decoding"):
+        # "draft" = a separate draft model proposes (needs its own KV
+        # pool, so several compositions below are gated off); "ngram" =
+        # draft-free host-side self-drafting (prompt lookup) — no draft
+        # pool, no extra HBM, so the ladder, host tier, SWA eviction and
+        # the repetition penalty all stay active.
+        if engine_cfg.spec_mode not in ("draft", "ngram"):
+            raise ValueError(f"unknown spec_mode {engine_cfg.spec_mode!r}; "
+                             "one of ('draft', 'ngram')")
+        if engine_cfg.spec_mode == "ngram":
+            from tpu_inference.config import validate_spec_config
+            validate_spec_config("ngram", engine_cfg.num_speculative_tokens,
+                                 engine_cfg.ngram_window,
+                                 draft_cfg is not None)
+        spec_draft = (engine_cfg.spec_mode == "draft"
+                      and draft_cfg is not None
+                      and engine_cfg.num_speculative_tokens > 0)
+        spec_ngram = engine_cfg.spec_mode == "ngram"
+        spec_on = spec_draft or spec_ngram
+        self.spec_draft = spec_draft
+        self.spec_ngram = spec_ngram
+        self.spec_mode = "ngram" if spec_ngram else "draft"
         self.prefix_cache = None
         # Prefix caching composes with speculative decoding because the
         # draft pool is a strict positional twin of the target pool: both
@@ -460,14 +497,15 @@ class InferenceEngine:
             # with holes would hand garbage KV to a shorter follow-up
             # request whose own window lands inside the evicted region.
             from tpu_inference.engine.prefix_cache import PrefixCache
-            if engine_cfg.host_cache_pages > 0 and not spec_on:
+            if engine_cfg.host_cache_pages > 0 and not spec_draft:
                 # Host-RAM second tier: evicted pages demote instead of
                 # being dropped (README "Tiered KV cache"). Off under
-                # speculative decoding: only the TARGET pool offloads,
-                # and a restored page with a stale draft twin would
-                # silently tank acceptance — the draft pool's positional
-                # twin invariant (below) only holds for pages both
-                # models wrote in lockstep.
+                # DRAFT-model speculative decoding: only the TARGET pool
+                # offloads, and a restored page with a stale draft twin
+                # would silently tank acceptance — the draft pool's
+                # positional twin invariant (below) only holds for pages
+                # both models wrote in lockstep. Draft-free ngram spec
+                # has no draft pool, so the tier stays live.
                 self.host_pool = kvc.HostPagePool(
                     engine_cfg.host_cache_pages)
                 self.telemetry.bind_host_pool(self.host_pool)
@@ -496,12 +534,15 @@ class InferenceEngine:
         from tpu_inference.engine.autosize import validate_ladder
         ladder = validate_ladder(engine_cfg.ladder_rungs,
                                  engine_cfg.max_batch_size)
-        if spec_on and len(ladder) > 1:
-            # The spec round compiles one fused graph at the full batch;
-            # rung-switching it would multiply draft+verify compiles for
-            # a path the roadmap still calls a slowdown. Single rung.
-            print(f"[engine] {model_cfg.name}: speculative decoding — "
-                  "decode ladder collapsed to the top rung")
+        if spec_draft and len(ladder) > 1:
+            # The draft-model spec round compiles one fused draft+verify
+            # graph at the full batch; rung-switching it would multiply
+            # compiles for a path the roadmap still calls a slowdown.
+            # Single rung. (ngram spec keeps the full ladder: its
+            # verify-only graph compiles per rung in warmup, like the
+            # plain decode graphs.)
+            print(f"[engine] {model_cfg.name}: draft-model speculative "
+                  "decoding — decode ladder collapsed to the top rung")
             ladder = (engine_cfg.max_batch_size,)
         self.ladder = ladder
         self.decode_rung = ladder[0]      # rung of the latest dispatch
@@ -575,20 +616,42 @@ class InferenceEngine:
         self.spec_enabled = spec_on
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # ngram-mode round accounting: verify rounds dispatched, rounds
+        # that degraded to the plain fused-K graph (no slot proposed),
+        # and per-sequence γ=0 throttle events (the adaptive-γ "spec
+        # never loses" lever).
+        self.spec_rounds_total = 0
+        self.spec_fallback_rounds = 0
+        self.spec_throttles_total = 0
+        if spec_on:
+            self.telemetry.bind_spec(self)
         # Behind-window page eviction (SWA): a running sequence holds
-        # O(window) KV pages instead of O(context). Off under spec
-        # decode — a window-less DRAFT model still attends to the full
-        # context, so the target's behind-window pages stay live. Off
-        # when the window can't bind (swa_binds above): there would
-        # never be a behind-window page to free.
+        # O(window) KV pages instead of O(context). Off under DRAFT-model
+        # spec decode — a window-less DRAFT model still attends to the
+        # full context, so the target's behind-window pages stay live
+        # (ngram spec has no draft; its verify queries sit at positions
+        # >= ctx, whose windows start at or after plain decode's, so
+        # eviction composes). Off when the window can't bind (swa_binds
+        # above): there would never be a behind-window page to free.
         self.swa_evict = (swa_binds and self.prefix_cache is None
-                          and not spec_on)
-        if swa_binds and spec_on:
+                          and not spec_draft)
+        if swa_binds and spec_draft:
             print(f"[engine] {model_cfg.name}: SWA + speculative decoding"
                   " — behind-window eviction OFF (the window-less draft"
                   " attends full context), so sequences hold O(context)"
                   " KV pages, not O(window)")
-        if self.spec_enabled:
+        if self.spec_ngram:
+            from tpu_inference.engine.speculative import verify_round
+            self._verify_jit = jax.jit(partial(verify_round, self),
+                                       donate_argnums=(1,))
+            # Compiled verify widths (tokens per round = width): the
+            # full γ+1 round plus a narrow 2-wide probe round, so a
+            # γ=0-throttled lane re-checks its echo at near-plain cost.
+            # XLA keys on the drafts shape, so each (rung, width) pair
+            # is its own executable — all warmed in warmup().
+            gamma = engine_cfg.num_speculative_tokens
+            self._spec_widths = sorted({2, gamma + 1})
+        if self.spec_draft:
             assert draft_cfg.vocab_size == model_cfg.vocab_size, \
                 "draft and target must share a tokenizer/vocab"
             self.draft_cfg = draft_cfg
@@ -800,7 +863,7 @@ class InferenceEngine:
                     self.kv, _, _ = self._prefill_sp_jit(
                         self.params, self.kv, toks, one, zero, bt,
                         self._next_key(), tz, tp, tk, sd, rp, rl, win)
-                if self.spec_enabled:
+                if self.spec_draft:
                     self.draft_kv = self._draft_prefill_jit(
                         self.draft_params, self.draft_kv, toks, one, zero,
                         bt)
@@ -820,7 +883,7 @@ class InferenceEngine:
                     jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
                     jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
 
-        if self.spec_enabled:
+        if self.spec_draft:
             b = ecfg.max_batch_size
             out = self._spec_jit(
                 self.params, self.draft_params, self.kv, self.draft_kv,
@@ -856,6 +919,31 @@ class InferenceEngine:
                     win = jnp.full((b, PENALTY_WINDOW), -1, jnp.int32)
                     jnp.where(carried, tok, tok)
                     jnp.where(carried[:, None], win, win)
+        if self.spec_ngram:
+            # The verify-only graph compiles at EVERY ladder rung x
+            # EVERY active verify width (the full γ+1 round AND the
+            # narrow probe round; per-sequence adaptive γ below the
+            # width lives in n_prop masking, never a new shape). The
+            # γ=0 fallback rounds run the plain decode graphs warmed in
+            # the else-branch above — between the three, no ngram-spec
+            # dispatch can meet a cold executable mid-serving (the
+            # test_ladder.py zero-compile pin, extended).
+            for b in self.ladder:
+                for width in self._spec_widths:
+                    out = self._verify_jit(
+                        self.params, self.kv, jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.zeros((b, self.max_pages), jnp.int32),
+                        jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+                        jnp.zeros((b, width - 1), jnp.int32),
+                        jnp.zeros((b,), jnp.int32), self._next_key(),
+                        jnp.zeros((b,), jnp.float32),
+                        jnp.ones((b,), jnp.float32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.ones((b,), jnp.float32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
+                    self.kv = out.kv
         if ecfg.hybrid_prefill and not self.spec_enabled:
             # One hybrid graph per REACHABLE prefill bucket per ladder
             # rung (the decode half dispatches at the current rung), so
@@ -1518,7 +1606,7 @@ class InferenceEngine:
         self._last_decode_end = None     # prefill breaks the decode streak
         self.kv, tok, _ = prefill(self.params, self.kv,
                                   *self._chunk_device_args(st))
-        if self.spec_enabled:
+        if self.spec_draft:
             # Mirror the chunk into the draft model's KV (same pages).
             self.draft_kv = self._draft_prefill_jit(
                 self.draft_params, self.draft_kv,
@@ -1639,7 +1727,7 @@ class InferenceEngine:
             jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
             jnp.asarray(seeds), jnp.asarray(rpens), jnp.asarray(rlasts),
             jnp.asarray(wins))
-        if self.spec_enabled:
+        if self.spec_draft:
             self.draft_kv = self._draft_prefill_jit(
                 self.draft_params, self.draft_kv, jnp.asarray(toks),
                 jnp.asarray(plen), jnp.asarray(pref), jnp.asarray(bts))
@@ -1880,11 +1968,15 @@ class InferenceEngine:
     def _penalty_arrays(self, seq: Sequence):
         """(repeat_penalty, repeat_last_n) with Ollama conventions:
         last_n < 0 means 'whole context' (clamped to the static window),
-        0 disables. Under speculative decoding the penalty is ignored
-        ENTIRELY (prefill included) — rejection sampling needs the
-        unmodified target distribution, and a first-token-only penalty
-        would be a silent half-application."""
-        if self.spec_enabled:
+        0 disables. Under DRAFT-model speculative decoding the penalty is
+        ignored ENTIRELY (prefill included) — the q/p acceptance ratio
+        needs the draft and target distributions unmodified, and a
+        first-token-only penalty would be a silent half-application.
+        ngram spec composes: proposals are one-hot (no p to corrupt), and
+        verify_round penalizes each position's target distribution
+        against the window rolled with its accepted prefix — exactly the
+        sequential plain-decode behavior."""
+        if self.spec_draft:
             return 1.0, 0
         rlast = int(seq.repeat_last_n)
         if rlast < 0:
@@ -2082,8 +2174,18 @@ class InferenceEngine:
             # seq.generated; callers that care use decode_steps_pipelined
             # exclusively).
             self.drain_pipeline()
-        if self.spec_enabled:
+        if self.spec_draft:
             return self._spec_decode_steps(max_steps)
+        if self.spec_ngram:
+            return self._ngram_decode_steps(max_steps)
+        return self._plain_decode_steps(max_steps)
+
+    def _plain_decode_steps(self, max_steps: Optional[int] = None
+                            ) -> Dict[int, List[int]]:
+        """The non-speculative fused-K decode round (decode_steps body);
+        also the dispatch ngram spec degrades to when NO slot has a
+        proposal this round — plain fused decode is strictly better than
+        a verify round that could only emit one token per lane."""
         ecfg = self.engine_cfg
         k_steps = max(1, ecfg.decode_steps_per_call)
         if max_steps is not None:
@@ -2354,6 +2456,10 @@ class InferenceEngine:
         host state; tokens for lanes that finished in an earlier call are
         discarded (their compute was speculative)."""
         call = self._inflight.pop(0)
+        if call.get("spec"):
+            # ngram spec round staged into the pipeline: its fold is
+            # emission-shaped (accept-prefix + caps), not K-step-shaped.
+            return self._sync_spec_call(call)
         t0 = time.perf_counter()
         pf = call.get("prefill")
         if call["outs"] is not None:
@@ -2462,11 +2568,13 @@ class InferenceEngine:
         Falls back to the synchronous path when depth <= 1 or spec is on.
         """
         depth = self.engine_cfg.decode_pipeline_depth
-        if depth <= 1 or self.spec_enabled:
+        if depth <= 1 or self.spec_draft:
             return self.decode_steps()         # gate runs inside
         if self.admission == "optimistic" and self.under_pressure:
             return self._pressure_settle_round()
         self._chaos_step_gate()
+        if self.spec_ngram:
+            return self._ngram_steps_pipelined()
         result: Dict[int, List[int]] = {}
         if self._pipeline_rung_blocked():
             result = self.drain_pipeline()     # settle, then grow rung
@@ -2633,6 +2741,50 @@ class InferenceEngine:
             self._maybe_finish(seq, seq.last_token)
         return result
 
+    def _spec_grant(self, active_seqs: List[Sequence], s_len: int,
+                    max_steps: Optional[int]) -> Tuple[List[Sequence],
+                                                       Dict[int, int]]:
+        """Per-slot emission caps + page grants for one spec round
+        (draft or ngram): the device writes KV for up to ``s_len``
+        positions, so provision pages for what fits and clamp emissions
+        to written capacity. Prefix-cache-held pages are reclaimable
+        capacity here just as in _grant_decode_steps — counting only the
+        raw free list would starve spec rounds once the cache warms up.
+        Starved lanes preempt (optimistic) or fail, mirroring the plain
+        path. Returns (surviving sequences, {slot: emit_cap})."""
+        ecfg = self.engine_cfg
+        emit_by_slot: Dict[int, int] = {}
+        for seq in active_seqs:
+            budget = seq.max_new_tokens - len(seq.generated)
+            room = ecfg.max_context - 1 - seq.ctx_len
+            emit_cap = max(0, min(s_len, budget, room))
+            if max_steps is not None:
+                emit_cap = min(emit_cap, max_steps)
+            want = min(s_len, room)
+            # Provision against pages HELD, not ctx: a partially-accepted
+            # round leaves the sequence holding pages past ceil(ctx/ps)
+            # (the rejected tail's rows), and recharging from ctx every
+            # round would leak one page per partial round until the
+            # block table overflows max_pages_per_seq.
+            total_pages = kvc.pages_needed(seq.ctx_len + want,
+                                           ecfg.page_size)
+            need = max(0, min(total_pages, self.max_pages)
+                       - len(seq.pages))
+            grantable = self._free_plus_evictable()
+            if need > grantable:
+                slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
+                emit_cap = min(emit_cap,
+                               slack + grantable * ecfg.page_size)
+                need = min(need, grantable)
+            if emit_cap <= 0:
+                self._starved(seq)
+                continue
+            if need > 0:
+                seq.pages.extend(self._allocate_reclaiming(need))
+            emit_by_slot[seq.slot] = emit_cap
+        return ([s for s in active_seqs if not s.done and s.slot >= 0],
+                emit_by_slot)
+
     def _spec_decode_steps(self, max_steps: Optional[int] = None
                            ) -> Dict[int, List[int]]:
         """One speculative round: draft proposes gamma tokens, target
@@ -2649,40 +2801,12 @@ class InferenceEngine:
         if not active_seqs:
             return {}
         active_seqs = self._preempt_for_pressure(active_seqs, s_len)
-
-        emit_by_slot: Dict[int, int] = {}
-        for seq in active_seqs:
-            budget = seq.max_new_tokens - len(seq.generated)
-            room = ecfg.max_context - 1 - seq.ctx_len
-            emit_cap = max(0, min(s_len, budget, room))
-            if max_steps is not None:
-                emit_cap = min(emit_cap, max_steps)
-            # The device writes KV for up to s_len positions; provision
-            # pages for what fits, clamp emissions to written capacity.
-            # Prefix-cache-held pages are reclaimable capacity here just
-            # as in _grant_decode_steps — counting only the raw free list
-            # would starve spec rounds once the cache warms up.
-            want = min(s_len, room)
-            need = kvc.pages_needed(want, ecfg.page_size,
-                                    already=seq.ctx_len)
-            grantable = self._free_plus_evictable()
-            if need > grantable:
-                slack = len(seq.pages) * ecfg.page_size - seq.ctx_len
-                emit_cap = min(emit_cap,
-                               slack + grantable * ecfg.page_size)
-                need = min(need, grantable)
-            if emit_cap <= 0:
-                self._starved(seq)
-                continue
-            if need > 0:
-                seq.pages.extend(self._allocate_reclaiming(need))
-            emit_by_slot[seq.slot] = emit_cap
-        active_seqs = [s for s in active_seqs
-                       if not s.done and s.slot >= 0]
+        active_seqs, emit_by_slot = self._spec_grant(active_seqs, s_len,
+                                                     max_steps)
         if not active_seqs:
             return {}
 
-        b = ecfg.max_batch_size       # spec runs single-rung (the top)
+        b = ecfg.max_batch_size       # draft spec runs single-rung (top)
         # Seeds and repetition penalties are not plumbed into spec rounds
         # (rejection sampling needs the unmodified target distribution).
         (tokens, ctx_lens, bts, temps, top_ps, top_ks,
@@ -2729,13 +2853,354 @@ class InferenceEngine:
             # budget/context run out), and clamp accepted to that window —
             # otherwise capped rounds overcount and the rate drifts.
             drafted = min(gamma, emit_by_slot[seq.slot])
+            accepted = min(int(n_acc[seq.slot]), drafted)
             self.spec_drafted += drafted
-            self.spec_accepted += min(int(n_acc[seq.slot]), drafted)
+            self.spec_accepted += accepted
+            if drafted > 0:
+                self.telemetry.spec_accept_rate.observe(accepted / drafted)
             if got:
                 result[seq.request_id] = got
         if self.telemetry.enabled:
             self.telemetry.tokens_per_dispatch.observe(
                 sum(len(t) for t in result.values()))
+        return result
+
+    # ------------------------------------------------------------------
+    # Draft-free n-gram speculation (spec_mode="ngram"; README
+    # "Speculative decoding"). The host proposes continuations by suffix-
+    # matching each sequence's own prompt+generated history (cheap numpy
+    # in the host bubble), and a verify-only round scores γ+1 positions
+    # in ONE target forward — every accepted token is a decode step the
+    # chip never ran sequentially. Per-sequence EWMA acceptance throttles
+    # cold streams to γ=0; rounds where nothing proposes run the plain
+    # fused-K graph, so speculation can never lose.
+    # ------------------------------------------------------------------
+
+    def _seq_spec_gamma(self, seq: Sequence) -> int:
+        """Current adaptive γ for one sequence, ticking the throttle
+        probe countdown: a γ=0-throttled sequence re-earns one round of
+        real proposals every ``spec_probe_every`` rounds, so a stream
+        that turns echoic mid-generation recovers its speedup."""
+        gamma = self.engine_cfg.num_speculative_tokens
+        if seq.spec_gamma < 0:
+            # Fresh streams EARN the full width: the first proposal
+            # rides the narrow γ=1 verify (cost ≈ one plain step), and
+            # one clean accept promotes to the full γ — so cold traffic
+            # that never echoes pays narrow rounds, not γ+1-wide ones.
+            seq.spec_gamma = 1 if gamma > 1 else gamma
+        if seq.spec_gamma == 0:
+            seq.spec_probe_countdown -= 1
+            if seq.spec_probe_countdown <= 0:
+                # Probe at γ=1: the narrow compiled verify width, so
+                # re-checking an echo-free stream costs ~one plain
+                # decode step. A clean accept lifts the EWMA and
+                # restores the full γ next round.
+                seq.spec_gamma = 1
+        return seq.spec_gamma
+
+    def _spec_update_adaptive(self, seq: Sequence, drafted: int,
+                              accepted: int) -> None:
+        """Fold one round's acceptance into the sequence's EWMA and
+        throttle/restore its γ. Observes the per-round acceptance-rate
+        histogram (the /metrics signal the replay artifact commits)."""
+        if drafted <= 0:
+            return
+        ecfg = self.engine_cfg
+        rate = accepted / drafted
+        alpha = ecfg.spec_ewma_alpha
+        seq.spec_accept_ewma += alpha * (rate - seq.spec_accept_ewma)
+        self.telemetry.spec_accept_rate.observe(rate)
+        thr = ecfg.spec_throttle_below
+        if thr > 0 and seq.spec_accept_ewma < thr:
+            if seq.spec_gamma != 0:
+                self.spec_throttles_total += 1
+            base = max(1, ecfg.spec_probe_every)
+            # Consecutive failed probes double the re-check interval
+            # (capped at 8x), so a stream that never echoes spends a
+            # vanishing fraction of its rounds on probe verifies.
+            seq.spec_probe_interval = min(
+                8 * base, max(base, seq.spec_probe_interval * 2))
+            seq.spec_gamma = 0
+            seq.spec_probe_countdown = seq.spec_probe_interval
+        else:
+            seq.spec_gamma = ecfg.num_speculative_tokens
+            seq.spec_probe_interval = 0
+
+    def _ngram_proposals(self, active_seqs: List[Sequence]
+                         ) -> Dict[int, np.ndarray]:
+        """Host-side prompt-lookup proposals for every non-throttled
+        lane: {slot: proposed token array (1..γ)}. Runs in the host
+        bubble between dispatches; sequences with no history match (or
+        throttled to γ=0) simply propose nothing."""
+        ecfg = self.engine_cfg
+        gammas = [self._seq_spec_gamma(seq) for seq in active_seqs]
+        # Probe alignment: ANY lane proposing makes the round a verify
+        # dispatch for the whole batch, so a lane whose probe is due
+        # drags every still-throttled lane into the same probe round —
+        # the batch pays one shared verify instead of one per lane's
+        # independent countdown (failed probes re-throttle with their
+        # own backed-off intervals as usual).
+        if any(g > 0 and s.spec_probe_interval > 0
+               for s, g in zip(active_seqs, gammas)):
+            gammas = [1 if g == 0 else g for g in gammas]
+        props: Dict[int, np.ndarray] = {}
+        for seq, gamma in zip(active_seqs, gammas):
+            if gamma <= 0:
+                continue
+            # Slice BEFORE concatenating: the proposer only reads the
+            # trailing NGRAM_SCAN_CAP tokens, and a full prompt+generated
+            # list concat would put O(context) Python copying per lane
+            # per round on the decode critical path at long contexts.
+            hist = seq.generated[-NGRAM_SCAN_CAP:]
+            if len(hist) < NGRAM_SCAN_CAP:
+                hist = (seq.prompt_tokens[len(hist) - NGRAM_SCAN_CAP:]
+                        + hist)
+            prop = ngram_propose(hist, gamma, ecfg.ngram_window)
+            if prop.size:
+                props[seq.slot] = prop
+            elif seq.spec_probe_interval > 0:
+                # A probing lane that found nothing to propose goes back
+                # to sleep instead of staying armed (scanning every
+                # round and firing a verify on the next garbage match);
+                # no new evidence, so the interval doesn't double.
+                seq.spec_gamma = 0
+                seq.spec_probe_countdown = seq.spec_probe_interval
+        return props
+
+    def _gate_mixed_batch(self, active_seqs: List[Sequence],
+                          proposals: Dict[int, np.ndarray]
+                          ) -> Dict[int, np.ndarray]:
+        """Mixed-batch guard for fused-K dispatch (K > 1): a verify
+        round advances a NON-proposing lane by exactly one token, while
+        a fallback round advances every lane by up to K — so a lone
+        echoic lane must not drag a wide batch of echo-free bystanders
+        into 1-token rounds. Dispatch the verify only when the
+        proposers' expected accepted tokens (EWMA-weighted) at least
+        cover one token per bystander; otherwise degrade the round to
+        the plain fused-K graph. K == 1 has no bystander deficit (a
+        verify round strictly dominates a 1-step call), so the gate is
+        off there. Returns proposals, or {} to force the fallback."""
+        k_steps = max(1, self.engine_cfg.decode_steps_per_call)
+        if k_steps <= 1 or not proposals:
+            return proposals
+        by_slot = {s.slot: s for s in active_seqs}
+        expected = sum(by_slot[slot].spec_accept_ewma * len(p)
+                       for slot, p in proposals.items()
+                       if slot in by_slot)
+        bystanders = len(active_seqs) - len(proposals)
+        return proposals if expected >= bystanders else {}
+
+    def _spec_width_for(self, proposals: Dict[int, np.ndarray]) -> int:
+        """Smallest compiled verify width (γ+1) covering this round's
+        longest proposal — probe-only rounds (every proposal length 1)
+        run the narrow graph at near-plain cost."""
+        longest = max(len(p) for p in proposals.values())
+        for w in self._spec_widths:
+            if w >= longest + 1:
+                return w
+        return self._spec_widths[-1]
+
+    def _dispatch_verify(self, active_seqs: List[Sequence],
+                         proposals: Dict[int, np.ndarray], s_len: int):
+        """Stage + dispatch one verify-only round at the smallest ladder
+        rung covering the batch and the compiled width ``s_len``
+        (non-blocking). Returns (VerifyRoundOut, {slot: n_proposed},
+        rung)."""
+        ecfg = self.engine_cfg
+        gamma = s_len - 1
+        b = self._rung_for_slots(active_seqs)
+        self._note_rung(b)
+        (tokens, ctx_lens, bts, temps, top_ps, top_ks,
+         _seeds, rpens, rlasts, windows) = self._stage_batch(active_seqs, b)
+        cap = np.zeros((b,), np.int32)
+        act = np.zeros((b,), bool)
+        drafts = np.zeros((b, gamma), np.int32)
+        n_prop = np.zeros((b,), np.int32)
+        for seq in active_seqs:
+            cap[seq.slot] = len(seq.pages) * ecfg.page_size
+            act[seq.slot] = True
+            prop = proposals.get(seq.slot)
+            if prop is not None and prop.size:
+                n = min(len(prop), gamma)
+                drafts[seq.slot, :n] = prop[:n]
+                n_prop[seq.slot] = n
+        # Per-request seeds are not plumbed into spec rounds (acceptance
+        # consumes randomness at a data-dependent rate, so a position-
+        # keyed stream would not reproduce anyway); greedy — where the
+        # byte-identity guarantee lives — is unaffected.
+        t0 = self._note_decode_entry(active_seqs)
+        out = self._verify_jit(
+            self.params, self.kv, jnp.asarray(tokens),
+            jnp.asarray(ctx_lens), jnp.asarray(bts), jnp.asarray(cap),
+            jnp.asarray(act), jnp.asarray(drafts), jnp.asarray(n_prop),
+            self._next_key(), jnp.asarray(temps), jnp.asarray(top_ps),
+            jnp.asarray(top_ks), jnp.asarray(rpens), jnp.asarray(rlasts),
+            jnp.asarray(windows))
+        self.kv = out.kv
+        self._note_decode_exit(t0, active_seqs)
+        self.spec_rounds_total += 1
+        if self.telemetry.enabled:
+            full = ecfg.num_speculative_tokens
+            gammas = [s.spec_gamma if s.spec_gamma >= 0 else full
+                      for s in active_seqs]
+            self.telemetry.spec_gamma_g.set(sum(gammas) / len(gammas))
+        return out, {s.slot: int(n_prop[s.slot]) for s in active_seqs}, b
+
+    def _fold_spec_emissions(self, seqs: Dict[int, Sequence],
+                             emit_by_slot: Dict[int, int],
+                             prop_by_slot: Dict[int, int],
+                             emitted: np.ndarray, n_acc: np.ndarray
+                             ) -> Dict[int, List[int]]:
+        """Fold one verify round's emissions into host state (shared by
+        the sync and dispatch-ahead ngram paths): emit caps truncate at
+        budget/pool limits, EOS stops a lane mid-round via
+        _maybe_finish, and each lane's acceptance updates its adaptive
+        γ. Lanes cancelled/preempted while the call was in flight are
+        skipped — their tokens were speculative compute."""
+        result: Dict[int, List[int]] = {}
+        s_len = emitted.shape[1]      # this round's compiled width
+        for slot, seq in seqs.items():
+            if seq.done or seq.slot != slot or self.slots[slot] is not seq:
+                continue
+            got: List[int] = []
+            for j in range(s_len):
+                if seq.done or len(got) >= emit_by_slot.get(slot, 0):
+                    break
+                tok = int(emitted[slot, j])
+                if tok < 0:
+                    break
+                seq.ctx_len += 1
+                seq.generated.append(tok)
+                if seq.first_token_time == 0.0:
+                    seq.first_token_time = time.perf_counter()
+                self._maybe_finish(seq, tok)
+                got.append(tok)
+            # Same clamped accounting as the draft path: only positions
+            # the host could emit count as drafted, and accepted clamps
+            # to that window, so capped rounds can't drift the rate.
+            drafted = min(prop_by_slot.get(slot, 0),
+                          emit_by_slot.get(slot, 0))
+            accepted = min(int(n_acc[slot]), drafted)
+            self.spec_drafted += drafted
+            self.spec_accepted += accepted
+            self._spec_update_adaptive(seq, drafted, accepted)
+            if got:
+                result[seq.request_id] = got
+        if self.telemetry.enabled:
+            self.telemetry.tokens_per_dispatch.observe(
+                sum(len(t) for t in result.values()))
+        return result
+
+    def _ngram_decode_steps(self, max_steps: Optional[int] = None
+                            ) -> Dict[int, List[int]]:
+        """One synchronous draft-free spec round: propose (host numpy),
+        verify-accept (one target forward at the current ladder rung),
+        fold. Rounds where NO slot proposes — cold streams, throttled
+        streams, no history echo — run the plain fused-K decode graph
+        instead, so ngram spec is never slower than plain decode."""
+        ecfg = self.engine_cfg
+        s_len = ecfg.num_speculative_tokens + 1
+        self._compact_slots()         # rung steps down when occupancy drops
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return {}
+        active_seqs = self._preempt_for_pressure(active_seqs, s_len)
+        active_seqs = [s for s in active_seqs
+                       if not s.done and s.slot >= 0]
+        if not active_seqs:
+            return {}
+        proposals = self._gate_mixed_batch(
+            active_seqs, self._ngram_proposals(active_seqs))
+        if not proposals:
+            self.spec_fallback_rounds += 1
+            return self._plain_decode_steps(max_steps)
+        s_len = self._spec_width_for(proposals)
+        active_seqs, emit_by_slot = self._spec_grant(active_seqs, s_len,
+                                                     max_steps)
+        if not active_seqs:
+            return {}
+        out, prop_by_slot, _ = self._dispatch_verify(active_seqs,
+                                                     proposals, s_len)
+        return self._fold_spec_emissions(
+            {s.slot: s for s in active_seqs}, emit_by_slot, prop_by_slot,
+            np.asarray(out.emitted), np.asarray(out.n_accepted))
+
+    def _stage_ngram_call(self) -> Optional[dict]:
+        """Stage one spec round non-blocking for the dispatch-ahead
+        pipeline (PR-4's hybrid-chunk pattern): the verify dispatch
+        enters ``_inflight`` and the host overlaps its device time with
+        scheduler work — admission, prefetch, callbacks, and the NEXT
+        round's n-gram matching. Rounds with no proposals stage a plain
+        fused-K decode call instead (the same dispatch-ahead machinery).
+        Caller guarantees the pipeline is empty (proposals need the
+        previous round's accepted tokens, so spec chains at depth 1 of
+        staging: sync round N, stage round N+1)."""
+        ecfg = self.engine_cfg
+        s_len = ecfg.num_speculative_tokens + 1
+        self._compact_slots()
+        active_seqs = self.active_sequences()
+        if not active_seqs:
+            return None
+        active_seqs = self._preempt_for_pressure(active_seqs, s_len)
+        active_seqs = [s for s in active_seqs
+                       if not s.done and s.slot >= 0]
+        if not active_seqs:
+            return None
+        proposals = self._gate_mixed_batch(
+            active_seqs, self._ngram_proposals(active_seqs))
+        if not proposals:
+            self.spec_fallback_rounds += 1
+            return self._stage_decode_call()
+        s_len = self._spec_width_for(proposals)
+        active_seqs, emit_by_slot = self._spec_grant(active_seqs, s_len,
+                                                     None)
+        if not active_seqs:
+            return None
+        out, prop_by_slot, rung = self._dispatch_verify(active_seqs,
+                                                        proposals, s_len)
+        return {"spec": True, "emitted": out.emitted,
+                "n_accepted": out.n_accepted,
+                "allowed": dict(emit_by_slot), "n_prop": prop_by_slot,
+                "seqs": {s.slot: s for s in active_seqs},
+                "rung": rung, "outs": None, "final": None,
+                "final_window": None}
+
+    def _sync_spec_call(self, call: dict) -> Dict[int, List[int]]:
+        """Block on an in-flight spec round and fold its emissions
+        (the _sync_oldest arm for ``spec`` calls)."""
+        t0 = time.perf_counter()
+        emitted = np.asarray(call["emitted"])           # [B, γ+1] blocks
+        n_acc = np.asarray(call["n_accepted"])
+        if self.telemetry.enabled:
+            dt = time.perf_counter() - t0
+            self.telemetry.decode_sync_s.observe(dt)
+            for seq in call["seqs"].values():
+                if not seq.done and seq.slot >= 0 \
+                        and self.slots[seq.slot] is seq:
+                    seq.dispatch_wall_s += dt
+        # Device wait, not host bubble (same rationale as _sync_oldest).
+        self._last_decode_end = (
+            time.perf_counter()
+            if any(s is not None and not s.done for s in self.slots)
+            else None)
+        return self._fold_spec_emissions(call["seqs"], call["allowed"],
+                                         call["n_prop"], emitted, n_acc)
+
+    def _ngram_steps_pipelined(self) -> Dict[int, List[int]]:
+        """Dispatch-ahead serving step for ngram spec: sync the in-flight
+        round (its accepted tokens seed the next proposals — spec rounds
+        cannot chain blind like plain decode carries), then stage the
+        next round non-blocking. At steady state one verify dispatch is
+        always in flight while the host does scheduler work + the next
+        round's n-gram matching — the PR-7 host bubble hides behind the
+        device just like plain dispatch-ahead."""
+        result: Dict[int, List[int]] = {}
+        if self._inflight:
+            for rid, toks in self._sync_oldest().items():
+                result.setdefault(rid, []).extend(toks)
+        call = self._stage_ngram_call()
+        if call is not None:
+            self._inflight.append(call)
         return result
 
     # ------------------------------------------------------------------

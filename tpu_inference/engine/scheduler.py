@@ -133,8 +133,20 @@ class SchedulerStats:
             out["prefix_cache"] = engine.prefix_cache.stats()
         if engine.spec_enabled:
             d, a = engine.spec_drafted, engine.spec_accepted
-            out["speculative"] = {"drafted": d, "accepted": a,
-                                  "acceptance_rate": (a / d) if d else 0.0}
+            out["speculative"] = {
+                # Proposal source + configured γ (README "Speculative
+                # decoding"): "ngram" = draft-free self-drafting with
+                # adaptive per-sequence γ; "draft" = draft-model rounds.
+                "mode": engine.spec_mode,
+                "gamma": engine.engine_cfg.num_speculative_tokens,
+                "drafted": d, "accepted": a,
+                "acceptance_rate": (a / d) if d else 0.0,
+                # ngram-mode round mix: verify rounds vs plain-decode
+                # fallbacks (no lane proposed), and γ=0 throttle events.
+                "rounds": engine.spec_rounds_total,
+                "fallback_rounds": engine.spec_fallback_rounds,
+                "throttles": engine.spec_throttles_total,
+            }
         # Step-phase histograms (telemetry.py): dispatch wall, bubble,
         # queue-wait, per-request phases — cumulative buckets + estimated
         # percentiles, diffable across scrapes (benchmarks commit the

@@ -94,13 +94,31 @@ def main() -> None:
                         "the context a same-sized pool holds; int4 "
                         "nibble-packs (quarter traffic, lossier — int8 "
                         "is the accuracy-safe tier)")
+    p.add_argument("--spec-mode", default="auto",
+                   choices=("auto", "off", "draft", "ngram"),
+                   help="speculative decoding proposal source: 'ngram' "
+                        "= draft-free self-drafting (prompt lookup "
+                        "against each sequence's own history; no draft "
+                        "model, no extra HBM; composes with the decode "
+                        "ladder, host KV tier and repeat_penalty); "
+                        "'draft' = a separate draft model "
+                        "(--draft-model); 'auto' = draft when "
+                        "--draft-model is given, else off")
     p.add_argument("--draft-model", default=None,
-                   help="enable speculative decoding with this draft "
-                        "preset or HF checkpoint dir")
+                   help="enable draft-model speculative decoding with "
+                        "this draft preset or HF checkpoint dir")
     p.add_argument("--draft-checkpoint", default=None,
                    help="HF safetensors dir for the draft model (required "
                         "when --checkpoint is set)")
-    p.add_argument("--num-speculative-tokens", type=int, default=4)
+    p.add_argument("--num-speculative-tokens", type=int, default=4,
+                   help="speculation depth γ: proposed tokens verified "
+                        "per round (each round emits 1..γ+1 tokens from "
+                        "one target forward); [1, 16] when spec is on")
+    p.add_argument("--ngram-window", type=int, default=3,
+                   help="ngram spec: longest suffix n-gram matched "
+                        "against the sequence's history ([1, 8]; "
+                        "matching tries window..1, most recent match "
+                        "wins)")
     p.add_argument("--decode-pipeline-depth", type=int, default=1,
                    help=">1 keeps that many fused-decode dispatches in "
                         "flight (hides dispatch latency; adds (depth-1)*K "
@@ -249,6 +267,24 @@ def main() -> None:
 
         jax.config.update("jax_debug_nans", True)
 
+    from tpu_inference.config import validate_spec_config
+
+    spec_mode = args.spec_mode
+    if spec_mode == "auto":
+        spec_mode = "draft" if args.draft_model else "off"
+    if spec_mode == "draft" and not args.draft_model:
+        p.error("--spec-mode draft requires --draft-model")
+    if spec_mode == "off" and args.draft_model:
+        p.error("--spec-mode off conflicts with --draft-model "
+                "(drop one)")
+    if spec_mode != "off":
+        try:
+            validate_spec_config(spec_mode, args.num_speculative_tokens,
+                                 args.ngram_window,
+                                 has_draft_model=bool(args.draft_model))
+        except ValueError as e:
+            p.error(str(e))
+
     from tpu_inference.engine.autosize import resolve_sizing_args
 
     max_batch_size, num_pages = resolve_sizing_args(args)
@@ -327,9 +363,12 @@ def main() -> None:
                           chunked_prefill_size=args.chunked_prefill_size,
                           hybrid_prefill=args.hybrid_prefill,
                           step_token_budget=args.step_token_budget,
+                          spec_mode=("ngram" if spec_mode == "ngram"
+                                     else "draft"),
+                          ngram_window=args.ngram_window,
                           num_speculative_tokens=(
                               args.num_speculative_tokens
-                              if args.draft_model else 0))
+                              if spec_mode != "off" else 0))
     if args.check_numerics:
         for eng in server.group.engines:
             eng.check_numerics()

@@ -590,10 +590,16 @@ class InferenceServer:
                     warnings.append(
                         f"repeat_last_n={repeat_last_n} clamped to the "
                         f"static penalty window {PENALTY_WINDOW}")
-                if self.engine.spec_enabled:
+                if getattr(self.engine, "spec_draft", False):
+                    # Draft-model spec only: the q/p acceptance ratio
+                    # needs both distributions unmodified. Draft-free
+                    # ngram spec applies the penalty inside the verify
+                    # round (one-hot proposals have no p to corrupt),
+                    # so it composes with no warning.
                     warnings.append(
-                        "repeat_penalty ignored: speculative decoding "
-                        "samples from the unmodified target distribution")
+                        "repeat_penalty ignored: draft-model speculative "
+                        "decoding samples from the unmodified target "
+                        "distribution")
             stop = opts.get("stop", body.get("stop"))
             if stop is None:
                 stop = []

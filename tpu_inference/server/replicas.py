@@ -817,8 +817,19 @@ class EngineGroup:
             drafted = sum(d["speculative"]["drafted"] for d in per)
             accepted = sum(d["speculative"]["accepted"] for d in per)
             agg["speculative"] = {
+                # Mode/γ are one shared EngineConfig, identical on every
+                # replica; counters sum across the fleet.
+                "mode": per[0]["speculative"].get("mode"),
+                "gamma": per[0]["speculative"].get("gamma"),
                 "drafted": drafted, "accepted": accepted,
-                "acceptance_rate": (accepted / drafted) if drafted else 0.0}
+                "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+                "rounds": sum(d["speculative"].get("rounds", 0)
+                              for d in per),
+                "fallback_rounds": sum(
+                    d["speculative"].get("fallback_rounds", 0)
+                    for d in per),
+                "throttles": sum(d["speculative"].get("throttles", 0)
+                                 for d in per)}
         agg["replicas"] = per
         agg["dp"] = len(per)
         agg["supervision"] = self.supervision_counters()

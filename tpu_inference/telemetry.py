@@ -154,6 +154,10 @@ class Gauge:
 # counts: 1 .. 512 covers tokens-per-dispatch at any sane fused-K*batch.
 SECONDS_BUCKETS = tuple(2.0 ** e for e in range(-17, 11))
 COUNT_BUCKETS = tuple(float(2 ** e) for e in range(0, 10))
+# Ratio-valued histograms (e.g. per-round speculative acceptance rate):
+# eighths of [0, 1] — fine enough to see "mostly rejected" vs "mostly
+# accepted", coarse enough to stay allocation-light.
+RATE_BUCKETS = tuple(i / 8 for i in range(9))
 
 
 class Histogram:
@@ -444,6 +448,7 @@ PHASE_HISTOGRAMS = {
     "hybrid_dispatch_s": "hybrid_dispatch_s",
     "decode_stall_during_prefill_s": "decode_stall_during_prefill_s",
     "kv_swap_s": "kv_swap_s",
+    "spec_acceptance_rate": "spec_accept_rate",
     "queue_wait_s": "queue_wait_s",
     "prefill_phase_s": "prefill_phase_s",
     "decode_phase_s": "decode_phase_s",
@@ -494,6 +499,7 @@ class EngineTelemetry:
             self.prefill_dispatches = NULL_METRIC
             self.hybrid_steps = NULL_METRIC
             self.degraded_mode = NULL_METRIC
+            self.spec_gamma_g = NULL_METRIC
             self.kv_offload_pages = NULL_METRIC
             self.kv_restore_pages = NULL_METRIC
             self.kv_offload_bytes = NULL_METRIC
@@ -531,6 +537,16 @@ class EngineTelemetry:
             "Host wall of one device<->host KV page-batch swap "
             "(offload is a blocking device_get; restore is the host "
             "side of an async scatter dispatch)")
+        self.spec_accept_rate = r.histogram(
+            "tpu_inf_spec_acceptance_rate",
+            "Per-sequence-round speculative acceptance rate "
+            "(accepted / drafted positions; one observation per lane "
+            "per spec round)",
+            buckets=RATE_BUCKETS)
+        self.spec_gamma_g = r.gauge(
+            "tpu_inf_spec_gamma",
+            "Mean adaptive speculation depth γ across the latest spec "
+            "round's lanes (0 = every lane throttled to plain decode)")
         self.kv_offload_pages = r.counter(
             "tpu_inf_kv_offload_pages_total",
             "KV pages demoted from the HBM pool to the host-RAM tier")
@@ -635,6 +651,32 @@ class EngineTelemetry:
                 "Decode lane occupancy: bound slots / top ladder rung",
                 fn=lambda: (sum(s is not None for s in engine.slots)
                             / max(engine.ladder[-1], 1)))
+
+    def bind_spec(self, engine) -> None:
+        """Read-through speculative-decoding counters over state the
+        engine already tracks (called only when spec decode is on, so
+        non-spec servers don't expose dead spec series)."""
+        if not self.enabled:
+            return
+        r = self.registry
+        r.counter("tpu_inf_spec_drafted_total",
+                  "Speculative positions proposed for verification "
+                  "(draft-model or n-gram proposals)",
+                  fn=lambda: engine.spec_drafted)
+        r.counter("tpu_inf_spec_accepted_total",
+                  "Speculative positions accepted by the target model",
+                  fn=lambda: engine.spec_accepted)
+        r.counter("tpu_inf_spec_rounds_total",
+                  "Verify rounds dispatched (ngram mode)",
+                  fn=lambda: engine.spec_rounds_total)
+        r.counter("tpu_inf_spec_fallback_rounds_total",
+                  "Spec-mode rounds that ran the plain fused-K decode "
+                  "graph because no lane proposed (cold/throttled "
+                  "streams — the 'spec never loses' path)",
+                  fn=lambda: engine.spec_fallback_rounds)
+        r.counter("tpu_inf_spec_throttles_total",
+                  "Sequences throttled to γ=0 by the acceptance EWMA",
+                  fn=lambda: engine.spec_throttles_total)
 
     def bind_host_pool(self, pool) -> None:
         """Read-through metrics over the host-RAM KV tier's capacity
