@@ -27,6 +27,7 @@ import sys
 import threading
 import time
 import urllib.request
+from typing import Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -174,7 +175,16 @@ def start_server(args) -> tuple:
             "routing": getattr(args, "routing", "prefix_affinity"),
             "route_hit_weight": getattr(args, "route_hit_weight", 1.0),
             "route_host_hit_weight":
-                getattr(args, "route_host_hit_weight", 0.5)},
+                getattr(args, "route_host_hit_weight", 0.5),
+            # Process fleet (README "Process fleet"): backend + worker
+            # supervision knobs for the subprocess arms.
+            "fleet": getattr(args, "fleet", "in-process"),
+            "fleet_migrate": getattr(args, "fleet_migrate", True),
+            "worker_restart_max":
+                getattr(args, "worker_restart_max", 3),
+            "worker_restart_backoff_s":
+                getattr(args, "worker_restart_backoff_s", 0.5),
+            "drain_timeout_s": getattr(args, "drain_timeout_s", 10.0)},
         spec_mode=("ngram" if getattr(args, "spec_mode", None) == "ngram"
                    else "draft"),
         ngram_window=getattr(args, "ngram_window", 3),
@@ -343,6 +353,18 @@ def main() -> dict:
                         "rate / throttle telemetry from /metrics")
     p.add_argument("--spec-streams", type=int, default=4,
                    help="compare-spec: concurrent streams per mix")
+    p.add_argument("--compare-fleet", action="store_true",
+                   help="run a pinned greedy burst through the two "
+                        "fleet backends (README 'Process fleet') — "
+                        "in-process threads vs subprocess workers, plus "
+                        "a subprocess arm with kill -9-a-worker chaos — "
+                        "asserting byte-identical outputs and recording "
+                        "tok/s ratio + failover counts; then a pinned "
+                        "drain scenario twice (migration vs plain "
+                        "resubmission), recording migrated vs "
+                        "recomputed tokens and swap-in-resumes")
+    p.add_argument("--fleet-streams", type=int, default=6,
+                   help="compare-fleet: concurrent streams per arm")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -353,12 +375,13 @@ def main() -> dict:
     args = p.parse_args()
 
     if sum(map(bool, (args.compare_admission, args.compare_hybrid,
-                      args.compare_ladder, args.compare_spec))) > 1:
+                      args.compare_ladder, args.compare_spec,
+                      args.compare_fleet))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
         p.error("--compare-admission/--compare-hybrid/--compare-ladder/"
-                "--compare-spec are mutually exclusive; run them as "
-                "separate invocations")
+                "--compare-spec/--compare-fleet are mutually exclusive; "
+                "run them as separate invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -415,6 +438,16 @@ def main() -> dict:
             args.decode_steps_per_call = 1
             args.num_speculative_tokens = 5
             args.ngram_window = 3
+        if args.compare_fleet:
+            # dp=2 both backends; host tier on so drain migration has a
+            # destination; no warmup (8 worker boots across the arms —
+            # lazy compile keeps the tier-1 lane affordable and greedy
+            # byte-identity is compile-order-independent).
+            args.dp = 2
+            args.num_pages, args.max_pages_per_seq = 128, 8
+            args.host_cache_pages = 64
+            args.decode_steps_per_call = 4
+            args.no_warmup = True
         if args.out is None:
             args.out = ("benchmarks/results/replay_hybrid.json"
                         if args.compare_hybrid
@@ -422,6 +455,8 @@ def main() -> dict:
                         if args.compare_ladder
                         else "benchmarks/results/replay_spec.json"
                         if args.compare_spec
+                        else "benchmarks/results/replay_fleet.json"
+                        if args.compare_fleet
                         else "benchmarks/results/replay_smoke.json")
 
     if args.platform != "auto":
@@ -462,6 +497,8 @@ def main() -> dict:
         return _compare_ladder(args)
     if args.compare_spec:
         return _compare_spec(args)
+    if args.compare_fleet:
+        return _compare_fleet(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -1088,6 +1125,213 @@ def _compare_spec(args) -> dict:
         "spec_never_loses": bool(
             (an["per_stream_tok_s"] or 0)
             >= 0.95 * (ap["per_stream_tok_s"] or 1e9)),
+    }
+    out = {"config": cfg_snapshot, **arms, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(arms)
+    return result
+
+
+def _wait_inflight_tokens(group, min_tokens: int,
+                          timeout: float = 120.0) -> Optional[int]:
+    """Block until the subprocess router has streamed ``min_tokens``
+    across its tracked requests, then return the replica index holding
+    the most in-flight work (the chaos victim). None if the burst
+    finished first."""
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        with group._lock:
+            entries = list(group._tracked.values())
+            total = sum(len(e.tokens) for e in entries)
+            if total >= min_tokens and entries:
+                counts = {}
+                for e in entries:
+                    if e.worker is not None:
+                        counts[e.worker.replica] = counts.get(
+                            e.worker.replica, 0) + 1
+                if counts:
+                    return max(counts, key=counts.get)
+        time.sleep(0.005)
+    return None
+
+
+def _fleet_arm(args, label: str, fleet: str, chaos: Optional[str] = None,
+               migrate: bool = True) -> dict:
+    """Boot one server on the given fleet backend, run the pinned
+    greedy burst, optionally injecting mid-burst chaos (``"kill9"`` =
+    SIGKILL the busiest worker; ``"drain"`` = graceful drain of the
+    busiest worker, with or without KV migration), and summarize."""
+    import hashlib
+
+    print(f"[replay] fleet arm: {label}", file=sys.stderr)
+    args.fleet = fleet
+    args.fleet_migrate = migrate
+    args.worker_restart_backoff_s = 0.1
+    args.worker_restart_max = 10
+    srv, port, stop = start_server(args)
+    group = srv.group
+    chaos_fired = False
+    try:
+        # Warm requests before the clock starts: the fleet arms boot
+        # without warmup (8 worker processes across the comparison), so
+        # these keep lazy XLA compile out of the timed burst — the arms
+        # then measure serving, not compile scheduling. Distinct cold
+        # prompts ride the rotating tie-break so every replica warms.
+        for i in range(2 * getattr(args, "dp", 1)):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/generate",
+                data=json.dumps({"model": args.model,
+                                 "prompt": f"[w{i}] warm",
+                                 "temperature": 0.0, "stream": False,
+                                 "options": {"num_predict": 4}}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=600).read()
+        box = {}
+
+        def run_burst():
+            box["records"] = asyncio.run(_ladder_burst(
+                port, args.model, args.fleet_streams, args.fleet_tokens))
+
+        t0 = time.perf_counter()
+        th = threading.Thread(target=run_burst, name="fleet-burst")
+        th.start()
+        if chaos is not None:
+            # Let every stream get going, then hit the busiest worker
+            # while its requests are mid-decode.
+            victim = _wait_inflight_tokens(
+                group, min_tokens=2 * args.fleet_streams)
+            if victim is not None:
+                if chaos == "kill9":
+                    group.apply_chaos({"replica": victim,
+                                       "kill": "kill9"})
+                else:
+                    group.drain_worker(victim, migrate=migrate)
+                chaos_fired = True
+        th.join()
+        wall = time.perf_counter() - t0
+        records = box["records"]
+        if chaos_fired:
+            # Let the supervisor finish the respawn before scraping, so
+            # the arm records the restart it caused (the burst usually
+            # outpaces worker boot).
+            deadline = time.perf_counter() + 60
+            while (time.perf_counter() < deadline
+                   and not all(h.state == "up" for h in group.workers)):
+                time.sleep(0.1)
+        after = json.loads(scrape_metrics(port, fmt="json")[0])
+        health = group.health_snapshot()
+    finally:
+        # Stop the fleet explicitly: the bench's loop-stop shortcut
+        # skips aiohttp cleanup, and subprocess workers are real OS
+        # processes that must not outlive their arm.
+        group.stop(drain=False)
+        stop()
+    h = hashlib.sha256()
+    for r in sorted(records, key=lambda r: r["idx"]):
+        h.update(f"{r['idx']}:".encode())
+        h.update(r["reply"].encode())
+        h.update(b"\x00")
+    tokens = sum(r["output_tokens"] for r in records)
+    sup = after.get("supervision") or {}
+    return {
+        "label": label, "fleet": fleet, "chaos": chaos,
+        "fleet_migrate": migrate, "chaos_fired": chaos_fired,
+        "requests": len(records),
+        "output_tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_s": round(tokens / wall, 2),
+        "e2e_s": _percentiles([r["e2e_s"] for r in records],
+                              ps=(50, 95)),
+        "outputs_sha256": h.hexdigest(),
+        "failovers": sup.get("failovers", 0),
+        "retries_attempted": sup.get("retries_attempted", 0),
+        "worker_restarts": sup.get("worker_restarts", 0),
+        "migrations": sup.get("migrations", 0),
+        "migrated_pages": sup.get("migrated_pages", 0),
+        "migrated_bytes": sup.get("migrated_bytes", 0),
+        "resume_resubmits": sup.get("resume_resubmits", 0),
+        "resume_recomputed_tokens": sup.get(
+            "resume_recomputed_tokens", 0),
+        "resume_reused_tokens": sup.get("resume_reused_tokens", 0),
+        "swap_in_resumes": sup.get("swap_in_resumes",
+                                   after.get("swap_in_resumes", 0)),
+        "fleet_status": health.get("status"),
+    }
+
+
+def _compare_fleet(args) -> dict:
+    """The process-fleet artifact (README "Process fleet"): one pinned
+    greedy burst served by (a) the in-process thread fleet, (b) the
+    subprocess worker fleet, and (c) the subprocess fleet with a worker
+    SIGKILLed mid-decode — outputs must be byte-identical across ALL
+    arms (failover resumes replay the router's token record, so even a
+    killed worker's streams complete exactly); then the pinned DRAIN
+    scenario twice — graceful SIGTERM-drain with KV page migration vs
+    plain resubmission — so one committed file carries the migration
+    win: swap-in-resumes > 0 and strictly fewer recomputed tokens than
+    the resubmission arm."""
+    args.fleet_tokens = 32
+    cfg_snapshot = {k: v for k, v in vars(args).items()
+                    if not k.startswith("_")}
+    arms = {}
+    arms["in_process"] = _fleet_arm(args, "in_process", "in-process")
+    arms["subprocess"] = _fleet_arm(args, "subprocess", "subprocess")
+    arms["subprocess_kill"] = _fleet_arm(
+        args, "subprocess_kill", "subprocess", chaos="kill9")
+    arms["drain_migrate"] = _fleet_arm(
+        args, "drain_migrate", "subprocess", chaos="drain", migrate=True)
+    arms["drain_resubmit"] = _fleet_arm(
+        args, "drain_resubmit", "subprocess", chaos="drain",
+        migrate=False)
+    args.fleet = "in-process"
+
+    ip, sp = arms["in_process"], arms["subprocess"]
+    kill = arms["subprocess_kill"]
+    dm, dr = arms["drain_migrate"], arms["drain_resubmit"]
+    shas = {a["outputs_sha256"] for a in arms.values()}
+    comparison = {
+        "streams": args.fleet_streams,
+        "tokens_per_s_in_process": ip["tokens_per_s"],
+        "tokens_per_s_subprocess": sp["tokens_per_s"],
+        # The RPC-hop cost (or multi-process win — workers dodge the
+        # router's GIL), reported transparently.
+        "tok_s_ratio": round(sp["tokens_per_s"]
+                             / max(ip["tokens_per_s"], 1e-9), 4),
+        "e2e_p50_in_process_s": ip["e2e_s"]["p50"],
+        "e2e_p50_subprocess_s": sp["e2e_s"]["p50"],
+        # Byte-identity across backends AND chaos: the fleet is a
+        # placement/supervision decision, never a behavior change.
+        "outputs_identical": len(shas) == 1,
+        # kill -9 arm: the real out-of-process failure mode.
+        "kill_chaos_fired": kill["chaos_fired"],
+        "failover_count": kill["failovers"],
+        "kill_worker_restarts": kill["worker_restarts"],
+        "kill_fleet_status": kill["fleet_status"],
+        # Drain scenario: migration vs resubmission.
+        "migrations": dm["migrations"],
+        "migrated_pages": dm["migrated_pages"],
+        "migrated_bytes": dm["migrated_bytes"],
+        "swap_in_resumes": dm["swap_in_resumes"],
+        "recomputed_tokens_migrate": dm["resume_recomputed_tokens"],
+        "recomputed_tokens_resubmit": dr["resume_recomputed_tokens"],
+        "reused_tokens_migrate": dm["resume_reused_tokens"],
+        "reused_tokens_resubmit": dr["resume_reused_tokens"],
+        # The artifact's claims (acceptance): byte-identity everywhere,
+        # the killed worker's streams failed over and completed, and
+        # drain-time migration swap-in-resumed with strictly fewer
+        # recomputed tokens than resubmission.
+        "failover_wins": bool(
+            len(shas) == 1 and kill["chaos_fired"]
+            and kill["failovers"] >= 1
+            and kill["worker_restarts"] >= 1),
+        "migration_wins": bool(
+            dm["chaos_fired"] and dr["chaos_fired"]
+            and dm["swap_in_resumes"] > 0
+            and dm["migrated_pages"] > 0
+            and dm["resume_recomputed_tokens"]
+            < dr["resume_recomputed_tokens"]),
     }
     out = {"config": cfg_snapshot, **arms, "comparison": comparison}
     print(json.dumps(comparison, indent=1))

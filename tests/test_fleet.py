@@ -1,0 +1,423 @@
+"""Process fleet (README "Process fleet"): router + engine-worker
+processes with KV page migration.
+
+Covers the subsystem at three levels:
+
+- pure units: the RPC frame codec, JSON config transport, and the
+  migration wire format (bit-exact host-page round-trips for every
+  kv_quant layout) — no processes, no jax device work beyond an engine.
+- engine-level: host-tier import (capacity, LRU-for-imports, tier
+  invariant, leak cleanliness).
+- REAL processes: a module-scoped dp=2 subprocess fleet exercised for
+  backend equivalence (byte-identical greedy outputs vs the in-process
+  EngineGroup), ``kill -9``-a-worker-mid-decode chaos (requests fail
+  over from the router's token record and complete byte-identically;
+  the fleet restarts the worker; survivors' pools stay leak-free), the
+  SIGTERM drain-and-migrate path (admission on the destination becomes
+  a swap-in-resume), and metrics-label hygiene across restarts (stable
+  ``replica="i"`` label, no counter resets, no duplicate series).
+"""
+
+import hashlib
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                  ParallelConfig, ServerConfig,
+                                  framework_config_from_dict,
+                                  framework_config_to_dict, tiny_llama)
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+
+# One geometry for every fleet test: small enough to boot a worker in
+# seconds, host tier on so drain migration has somewhere to land.
+ENGINE_KW = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
+                 max_batch_size=2, prefill_buckets=(16,),
+                 host_cache_pages=32)
+
+
+def _cfg(dp=2, **server_kw) -> FrameworkConfig:
+    server_kw.setdefault("fleet", "subprocess")
+    server_kw.setdefault("worker_restart_max", 10)
+    server_kw.setdefault("worker_restart_backoff_s", 0.1)
+    server_kw.setdefault("drain_timeout_s", 8.0)
+    return FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(**ENGINE_KW),
+        parallel=ParallelConfig(dp=dp),
+        server=ServerConfig(model_name="t", tokenizer="byte",
+                            warmup=False, **server_kw))
+
+
+# ------------------------------------------------------------- units
+
+
+def test_frame_codec_roundtrip():
+    """Length-prefixed JSON + binary attachment round-trips through a
+    real socketpair, including interleaved frames and empty blobs."""
+    import socket
+
+    from tpu_inference.server.worker import recv_frame, send_frame
+
+    a, b = socket.socketpair()
+    rfile = b.makefile("rb")
+    send_frame(a, {"id": 1, "verb": "hello"})
+    send_frame(a, {"ev": "token", "t": 42}, blob=b"\x00\x01\xffbytes")
+    obj, blob = recv_frame(rfile)
+    assert obj == {"id": 1, "verb": "hello"} and blob == b""
+    obj, blob = recv_frame(rfile)
+    assert obj["t"] == 42 and blob == b"\x00\x01\xffbytes"
+    a.close()
+    with pytest.raises(ConnectionError):
+        recv_frame(rfile)
+    b.close()
+
+
+def test_config_json_transport_roundtrip():
+    """The router->worker config envelope survives JSON: dtypes by
+    name, tuples, nested dataclasses, fleet knobs."""
+    cfg = _cfg(dp=3)
+    cfg2 = framework_config_from_dict(
+        json.loads(json.dumps(framework_config_to_dict(cfg))))
+    assert cfg2.model == cfg.model
+    assert cfg2.engine == cfg.engine
+    assert cfg2.parallel == cfg.parallel
+    assert cfg2.server == cfg.server
+    assert cfg2.engine.prefill_buckets == (16,)
+    assert cfg2.model.dtype == cfg.model.dtype
+
+
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_host_page_serialization_bit_exact(quant):
+    """The migration wire format round-trips every kv_quant host-page
+    layout bit-exactly (the PR-6 stored layout, serialized)."""
+    rng = np.random.default_rng(7)
+    if quant == "none":
+        mk = lambda: rng.standard_normal((2, 8, 2, 16)).astype(np.float32)
+        pages = [kvc.HostKVPage(mk(), mk()) for _ in range(3)]
+    else:
+        code_dt = np.uint8 if quant == "int4" else np.int8
+        d = 8 if quant == "int4" else 16
+        mk = lambda: rng.integers(0, 255, (2, 8, 2, d)).astype(code_dt)
+        sc = lambda: rng.standard_normal((2, 8, 2)).astype(np.float32)
+        pages = [kvc.HostKVPage(mk(), mk(), sc(), sc()) for _ in range(3)]
+    blob = kvc.serialize_host_pages(pages)
+    back = kvc.deserialize_host_pages(blob)
+    assert len(back) == len(pages)
+    for orig, got in zip(pages, back):
+        np.testing.assert_array_equal(orig.k, got.k)
+        np.testing.assert_array_equal(orig.v, got.v)
+        if orig.k_scale is None:
+            assert got.k_scale is None
+        else:
+            np.testing.assert_array_equal(orig.k_scale, got.k_scale)
+            np.testing.assert_array_equal(orig.v_scale, got.v_scale)
+        assert orig.nbytes == got.nbytes
+    assert kvc.deserialize_host_pages(kvc.serialize_host_pages([])) == []
+
+
+def test_import_host_capacity_and_tier_invariant():
+    """Engine-level migration import: entries land in the host tier
+    (newest-LRU), duplicates of either tier are skipped, imports evict
+    the tier's own oldest warmth to fit, overflow drops the remainder,
+    and the leak invariant holds after a clear."""
+    from tests._leak import assert_pool_clean
+
+    engine = InferenceEngine(tiny_llama(vocab_size=512),
+                             EngineConfig(**{**ENGINE_KW,
+                                             "host_cache_pages": 4}))
+    cache, pool = engine.prefix_cache, engine.host_pool
+
+    def entry(tag: int):
+        k = np.full((2, 8, 2, 16), tag, np.float32)
+        return kvc.HostKVPage(k, k.copy())
+
+    d = [bytes([i]) * 16 for i in range(8)]
+    assert cache.import_host([(d[0], entry(0)), (d[1], entry(1))]) == 2
+    assert pool.used == 2 and pool.imported_total == 2
+    # Duplicate digest: skipped, not double-resident.
+    assert cache.import_host([(d[0], entry(9))]) == 0
+    # Fill to capacity, then one more: the OLDEST host entry evicts.
+    assert cache.import_host([(d[2], entry(2)), (d[3], entry(3))]) == 2
+    assert cache.import_host([(d[4], entry(4))]) == 1
+    assert pool.used == 4 and d[0] not in cache._host
+    assert d[4] in cache._host
+    # Offering more than capacity drops the tail (never over-fills).
+    added = cache.import_host([(d[i], entry(i)) for i in range(5, 8)])
+    assert pool.used == 4 and added <= 3
+    # Apply-queue path (the worker's import-kv RPC marshals through the
+    # engine loop): queued entries adopt on apply, event fires.
+    done = engine.request_import_host([(b"z" * 16, entry(42))])
+    engine.apply_pending_imports()
+    assert done.is_set()
+    assert engine.migrate_in_pages >= 1
+    assert_pool_clean(engine)
+
+
+# ------------------------------------------------- real process fleet
+
+
+def _submit(group, rid, prompt, max_new, timeout=180.0):
+    toks, done, box = [], threading.Event(), {}
+    seq = Sequence(request_id=rid, prompt_tokens=list(prompt),
+                   max_new_tokens=max_new)
+    group.submit(seq, lambda s, t: toks.append(t),
+                 lambda s: (box.update(seq=s), done.set()))
+    return toks, done, box
+
+
+def _finish(done, box, timeout=180.0):
+    assert done.wait(timeout), "request did not finish"
+    return box["seq"]
+
+
+def _wait_states(group, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(h.state == "up" for h in group.workers):
+            return
+        time.sleep(0.1)
+    raise AssertionError(
+        f"fleet never healed: {[h.state for h in group.workers]}")
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=2))
+    group.start()
+    yield group
+    group.stop(drain=False)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """In-process engine with the same seed/geometry as every worker:
+    greedy outputs must match the fleet's byte for byte."""
+    return InferenceEngine(tiny_llama(vocab_size=512),
+                           EngineConfig(**ENGINE_KW), seed=0)
+
+
+def test_fleet_basic_and_surfaces(fleet, oracle):
+    toks, done, box = _submit(fleet, 0, [1, 2, 3, 4, 5], 12)
+    fin = _finish(done, box)
+    assert fin.finish_reason == "length"
+    assert toks == oracle.generate([[1, 2, 3, 4, 5]],
+                                   max_new_tokens=12)[0]
+    assert fin.routed_replica in (0, 1)
+
+    hs = fleet.health_snapshot()
+    assert hs["status"] == "ok" and hs["fleet"] == "subprocess"
+    assert len(hs["replicas"]) == 2
+    for r in hs["replicas"]:
+        assert r["pid"] and "restarts" in r and "routing" in r
+        assert "pool_pressure" in r and "host_cache" in r
+    ss = fleet.stats_snapshot()
+    assert ss["dp"] == 2 and ss["tokens_generated"] >= 12
+    assert "phases" in ss and "supervision" in ss
+    pt = fleet.prometheus_text()
+    assert 'replica="0"' in pt and 'replica="1"' in pt
+    assert "tpu_inf_worker_up" in pt
+    assert "tpu_inf_fleet_migrations_total" in pt
+    # /debug/requests analogue: merged recent timelines.
+    recent = fleet.recent_snapshot(10)
+    assert recent and recent[-1]["finish_reason"] == "length"
+
+
+def test_backend_equivalence_pinned_mix(fleet):
+    """Satellite: the same pinned greedy mix through --fleet in-process
+    and --fleet subprocess produces byte-identical outputs
+    (outputs_sha256), identical finish reasons, and matching
+    route/telemetry counter shapes."""
+    from tpu_inference.server.http import build_engine_group
+
+    prompts = [[1, 2, 3], [9, 8, 7, 6], [5, 5, 5, 5, 5], [2, 4, 6]]
+    budgets = [10, 14, 8, 200]          # 200 hits the context cap
+
+    def run(group):
+        outs, reasons = [], []
+        pend = [_submit(group, 1000 + i, p, b)
+                for i, (p, b) in enumerate(zip(prompts, budgets))]
+        for toks, done, box in pend:
+            fin = _finish(done, box)
+            outs.append(list(toks))
+            reasons.append(fin.finish_reason)
+        h = hashlib.sha256()
+        for o in outs:
+            h.update(np.asarray(o, np.int32).tobytes() + b"|")
+        return h.hexdigest(), reasons, group.stats_snapshot()
+
+    cfg = _cfg(dp=2, fleet="in-process")
+    inproc = build_engine_group(cfg).start()
+    try:
+        sha_in, reasons_in, stats_in = run(inproc)
+    finally:
+        inproc.stop(drain=False)
+    sha_sub, reasons_sub, stats_sub = run(fleet)
+
+    assert sha_sub == sha_in
+    assert reasons_sub == reasons_in
+    # Counter-shape parity: every in-process supervision counter exists
+    # in the subprocess fleet's view, and the aggregated stats share
+    # the core serving keys.
+    assert set(stats_in["supervision"]) <= set(stats_sub["supervision"])
+    core = {"steps", "prefills", "tokens_generated", "requests_finished",
+            "preemptions", "recompute_resumes", "swap_in_resumes",
+            "migrate_out_pages", "migrate_in_pages", "kv_pages_total",
+            "decode_ladder", "phases", "replicas", "dp", "supervision"}
+    assert core <= set(stats_in) and core <= set(stats_sub)
+    # Route stats per replica share the same shape.
+    h_in = inproc.health_snapshot()["replicas"][0]["routing"]
+    h_sub = fleet.health_snapshot()["replicas"][0]["routing"]
+    assert set(h_in) == set(h_sub)
+
+
+def test_kill9_chaos_failover(fleet, oracle):
+    """Acceptance: kill -9 a worker mid-decode. In-flight requests on
+    the killed worker fail over (router token record, recompute-resume
+    on the survivor) and COMPLETE byte-identically; /healthz shows the
+    restart; no KV pages leak on the survivors."""
+    _wait_states(fleet)
+    failovers0 = fleet.failovers
+    # Two long streams: the cold-prompt rotating tie-break spreads them
+    # across both workers, so SOME worker holds a mid-decode stream.
+    a = _submit(fleet, 2000, [7, 8, 9], 40)
+    b = _submit(fleet, 2001, [3, 1, 4, 1, 5], 40)
+    deadline = time.monotonic() + 60
+    while (len(a[0]) < 4 or len(b[0]) < 4) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(a[0]) >= 4 and len(b[0]) >= 4
+    with fleet._lock:
+        victim_idx = fleet._tracked[2000].worker.replica
+    r = fleet.apply_chaos({"replica": victim_idx, "kill": "kill9"})
+    assert r["killed"] == "kill9"
+
+    fin_a = _finish(a[1], a[2])
+    fin_b = _finish(b[1], b[2])
+    assert fin_a.finish_reason == "length"
+    assert fin_b.finish_reason == "length"
+    # Byte-identity: the failover resume replays the streamed prefix
+    # and continues exactly where the dead worker left off (greedy).
+    assert a[0] == oracle.generate([[7, 8, 9]], max_new_tokens=40)[0]
+    assert b[0] == oracle.generate([[3, 1, 4, 1, 5]],
+                                   max_new_tokens=40)[0]
+    assert fleet.failovers > failovers0
+
+    # The fleet restarts the worker under the same replica label.
+    _wait_states(fleet)
+    hs = fleet.health_snapshot()
+    assert hs["replicas"][victim_idx]["restarts"] >= 1
+    assert hs["supervision"]["worker_restarts"] >= 1
+
+    # Leak invariant on the survivors (worker-side debug snapshot: the
+    # tests/_leak checks, evaluated in the worker process after
+    # clearing its cache references).
+    for h in fleet.workers:
+        snap = h.client.rpc("debug", clear=True)
+        assert not snap["pipeline_pending"]
+        assert snap["preempted_uncollected"] == 0
+        assert snap["slots_bound"] == 0
+        assert snap["num_free"] == snap["num_pages"] - 1, snap
+        assert snap["refs_held"] == 0 and snap["evictable_count"] == 0
+        assert snap["host_used"] == 0
+        assert snap.get("tier_overlap", 0) == 0
+
+
+def test_sigterm_drain_migrates_kv(fleet, oracle):
+    """Tentpole proof: graceful drain (SIGTERM) exports the in-flight
+    sequence's KV pages over the migration channel; the router imports
+    them into the destination's host tier and resubmission becomes a
+    swap-in-resume — tokens byte-identical, migrated pages > 0, and the
+    destination records a swap_in_resume."""
+    _wait_states(fleet)
+    migrations0 = fleet.migrations
+    pages0 = fleet.migrated_pages
+    prompt = [11, 12, 13, 14, 15, 16, 17]
+    toks, done, box = _submit(fleet, 3000, prompt, 48)
+    deadline = time.monotonic() + 60
+    # Wait until a couple of FULL pages of KV exist (page_size=8).
+    while len(toks) < 18 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(toks) >= 18
+    with fleet._lock:
+        src_idx = fleet._tracked[3000].worker.replica
+    fleet.apply_chaos({"replica": src_idx, "kill": "sigterm"})
+
+    fin = _finish(done, box)
+    assert fin.finish_reason == "length"
+    assert toks == oracle.generate([prompt], max_new_tokens=48)[0]
+    assert fleet.migrations > migrations0
+    assert fleet.migrated_pages > pages0
+    assert fleet.resume_reused_tokens > 0
+    sup = fleet.supervision_counters()
+    assert sup["swap_in_resumes"] >= 1
+    assert sup["migrated_bytes"] > 0
+    _wait_states(fleet)
+
+
+def test_metrics_label_stable_across_restart(fleet):
+    """Satellite: per-worker series keep the stable replica="i" label
+    across a restart, fleet-level counters never reset (restart carry),
+    and no series is double-reported in the aggregated scrape."""
+    from tests import _prom
+
+    _wait_states(fleet)
+    # Traffic so worker counters are non-zero, then force the periodic
+    # metrics cache (the carry source) to be fresh.
+    toks, done, box = _submit(fleet, 4000, [2, 7, 1, 8], 10)
+    _finish(done, box)
+    fleet._refresh_caches()
+
+    def scrape():
+        _, samples = _prom.parse(fleet.prometheus_text())
+        out = {}
+        for name, labels, value in samples:
+            key = (name, tuple(sorted(labels.items())))
+            assert key not in out, f"duplicate series {key}"
+            out[key] = value
+        return out
+
+    before = scrape()
+
+    def series(samples, name):
+        return {labels: v for (n, labels), v in samples.items()
+                if n == name}
+
+    tok_before = series(before, "tpu_inf_tokens_generated_total")
+    replicas = {dict(labels).get("replica") for labels in tok_before}
+    assert replicas == {"0", "1"}
+
+    # Restart worker 0 gracefully (drain carries the final dump).
+    fleet.apply_chaos({"replica": 0, "kill": "sigterm"})
+    deadline = time.monotonic() + 60
+    while fleet.workers[0].state == "up" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    _wait_states(fleet)
+
+    after = scrape()                 # scrape() re-asserts no duplicates
+    tok_after = series(after, "tpu_inf_tokens_generated_total")
+    assert set(tok_after) == set(tok_before)
+    for labels, v in tok_before.items():
+        # Monotone across the restart: the carry folds the dead
+        # incarnation's total under the same replica label.
+        assert tok_after[labels] >= v, (labels, v, tok_after[labels])
+    # Fleet-side restart counter moved under the stable label.
+    restarts = series(after, "tpu_inf_worker_restarts_total")
+    assert restarts[(("replica", "0"),)] >= 1
+
+
+def test_draining_worker_refuses_submit_routes_to_sibling(fleet, oracle):
+    """A request submitted while one worker drains lands on the
+    sibling (the draining worker's refusal re-routes, not errors)."""
+    _wait_states(fleet)
+    fleet.apply_chaos({"replica": 1, "kill": "sigterm"})
+    toks, done, box = _submit(fleet, 5000, [6, 6, 6], 8)
+    fin = _finish(done, box)
+    assert fin.finish_reason == "length"
+    assert toks == oracle.generate([[6, 6, 6]], max_new_tokens=8)[0]
+    _wait_states(fleet)

@@ -246,6 +246,65 @@ def test_replay_smoke_compare_spec(tmp_path, monkeypatch):
     assert c["spec_never_loses"]
 
 
+def test_replay_smoke_compare_fleet(tmp_path, monkeypatch):
+    """Tier-1 process-fleet smoke (CPU, dp=2): the in-process vs
+    subprocess comparison lane serves a pinned greedy burst through the
+    full HTTP path on both fleet backends, then with a worker
+    SIGKILLed mid-decode, then the pinned drain scenario twice
+    (migration vs resubmission) — five boots, eight real worker
+    processes total. Live assertions are the DETERMINISTIC claims:
+    byte-identical outputs across every arm (the fleet backend — and a
+    kill -9 — is a placement/supervision decision, never a behavior
+    change), the killed worker's in-flight requests failing over and
+    completing with the worker restarted, and drain-time migration
+    recording swap-in-resumes with strictly fewer recomputed tokens
+    than plain resubmission. Throughput magnitudes are reported, not
+    graded (loaded-CI-box stance)."""
+    root, replay = _load_replay()
+    out = tmp_path / "replay_fleet.json"
+    monkeypatch.chdir(root)
+    monkeypatch.setattr(sys, "argv",
+                        ["replay.py", "--smoke", "--compare-fleet",
+                         "--out", str(out)])
+    cmp = replay.main()
+
+    art = json.loads(out.read_text())
+    assert art["config"]["smoke"] is True
+    for arm in ("in_process", "subprocess", "subprocess_kill",
+                "drain_migrate", "drain_resubmit"):
+        s = art[arm]
+        assert s["requests"] > 0 and s["output_tokens"] > 0, (arm, s)
+    assert art["in_process"]["fleet"] == "in-process"
+    assert art["subprocess"]["fleet"] == "subprocess"
+    # Byte-identity across backends and chaos arms.
+    assert cmp["outputs_identical"], cmp
+    # The kill arm really killed a worker mid-decode, its requests
+    # failed over and completed, and the supervisor restarted it.
+    assert cmp["kill_chaos_fired"]
+    assert cmp["failover_count"] >= 1
+    assert cmp["kill_worker_restarts"] >= 1
+    assert cmp["failover_wins"], cmp
+    # The drain arms really drained, the migration arm moved KV pages
+    # and swap-in-resumed, and it recomputed strictly fewer tokens
+    # than the resubmission arm.
+    assert cmp["migrations"] >= 1
+    assert cmp["migrated_pages"] >= 1 and cmp["migrated_bytes"] > 0
+    assert cmp["swap_in_resumes"] >= 1
+    assert (cmp["recomputed_tokens_migrate"]
+            < cmp["recomputed_tokens_resubmit"]), cmp
+    assert cmp["migration_wins"], cmp
+
+    # The committed artifact carries the same acceptance claims.
+    committed = json.loads(open(os.path.join(
+        root, "benchmarks", "results", "replay_fleet.json")).read())
+    c = committed["comparison"]
+    assert c["outputs_identical"] and c["failover_wins"]
+    assert c["migration_wins"]
+    assert c["swap_in_resumes"] >= 1
+    assert (c["recomputed_tokens_migrate"]
+            < c["recomputed_tokens_resubmit"])
+
+
 def test_replay_smoke_compare_tiering(tmp_path, monkeypatch):
     """Tier-1 tiered-KV-cache smoke (CPU, tiny model): the host-tier
     off-vs-on comparison lane replays the pinned multi-turn mix with the

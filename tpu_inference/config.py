@@ -290,9 +290,12 @@ class ParallelConfig:
     with XLA all-reduce over ICI; EP (Mixtral) reuses the tp axis for experts
     (parallel/shardings.py). SP shards the sequence dim for ring-attention
     prefill. The server builds a mesh from this config when n_devices > 1
-    (server/http.py InferenceServer.__init__). A dp > 1 axis replicates
-    params/compute on a single engine (used by the driver dry run); true
-    replica-per-group serving is one server process per dp group.
+    (server/http.py InferenceServer.__init__). dp > 1 is replica-per-group
+    serving: each replica owns a tp*sp submesh, KV pool and scheduler,
+    behind either fleet backend (ServerConfig.fleet) — "in-process"
+    threads in one process (server/replicas.py EngineGroup) or
+    "subprocess" engine-worker OS processes supervised by a router
+    (server/fleet.py ProcessEngineGroup).
     """
 
     dp: int = 1
@@ -475,14 +478,18 @@ class EngineConfig:
     # POST /debug/chaos {"page_pressure": n}) so pool-exhaustion paths
     # run deterministically on CPU. Off in production.
     chaos_page_pressure: int = 0
-    # Engine-level fault injection (the in-process counterpart of
+    # Engine-level fault injection (the engine-side counterpart of
     # ServerConfig.chaos_*): every prefill/decode dispatch raises with
     # this probability, exercising the scheduler error paths and the
-    # replica health machine deterministically on CPU. Off in production.
+    # replica health machine deterministically on CPU. Works under both
+    # fleet backends (per-worker via the chaos RPC in "subprocess" mode;
+    # kill -9 / SIGTERM-drain chaos for real process faults lives in the
+    # fleet layer — POST /debug/chaos {"kill": ...}). Off in production.
     chaos_step_failure_rate: float = 0.0
     # Each dispatch sleeps this long first, simulating the documented TPU
-    # wedge failure mode (benchmarks/run_tpu_round5.sh guards against it
-    # out-of-process; the step watchdog detects it in-process).
+    # wedge failure mode (the step watchdog detects it in either fleet
+    # backend; with --fleet subprocess the wedge is confined to one
+    # worker process instead of sharing the router's GIL).
     chaos_step_wedge_s: float = 0.0
     # Reuse the decode-step host staging arrays (block tables, sampling
     # params) across dispatches, refreshing only the rows whose occupant
@@ -641,6 +648,29 @@ class ServerConfig:
     # so warmth cannot herd every conversation onto one overloaded
     # replica. Not a CLI flag; tune in config when page_size is unusual.
     route_load_pages: float = 1.0
+    # --- Process fleet (README "Process fleet") ---
+    # Fleet backend: "in-process" = dp EngineSchedulers as threads of the
+    # server process (server/replicas.py EngineGroup — one process, one
+    # GIL, one failure domain); "subprocess" = a router plus one
+    # engine-worker OS process per replica, speaking a length-prefixed
+    # JSON RPC over a local unix socket (server/worker.py +
+    # server/fleet.py ProcessEngineGroup). Same facade either way.
+    fleet: str = "in-process"
+    # Subprocess fleet: restarts allowed per worker (with doubling
+    # backoff from worker_restart_backoff_s) before it is left down and
+    # the fleet serves degraded on the survivors.
+    worker_restart_max: int = 3
+    worker_restart_backoff_s: float = 0.5
+    # Subprocess fleet: a SIGTERM'd (or drain-RPC'd) worker gets this
+    # long to settle in-flight dispatches and export its sequences' KV
+    # pages before exiting.
+    drain_timeout_s: float = 10.0
+    # Drain-time KV page migration: a draining worker exports in-flight
+    # sequences' KV pages (the PR-6 host serialization layout) over the
+    # RPC channel and the router imports them into the destination
+    # worker's host tier, so resubmission becomes a swap-in-resume.
+    # False = the resubmission-only comparison arm (full re-prefill).
+    fleet_migrate: bool = True
 
 
 @dataclasses.dataclass
@@ -653,3 +683,62 @@ class FrameworkConfig:
     server: ServerConfig = dataclasses.field(default_factory=ServerConfig)
     checkpoint_path: Optional[str] = None  # HF safetensors dir; None = random init
     seed: int = 0
+
+
+# ---------------------------------------------------------------------------
+# JSON config transport (subprocess fleet): the router serializes one
+# FrameworkConfig and ships it to each engine-worker process over stdin,
+# so router and workers can never drift on engine geometry (page_size /
+# ladder / prefix digests all depend on it). Only the non-JSON-native
+# leaves need special casing: the model dtype (by numpy name) and the
+# tuple-valued EngineConfig fields.
+# ---------------------------------------------------------------------------
+
+_TUPLE_FIELDS = ("decode_ladder", "prefill_buckets")
+
+
+def model_config_to_dict(m: ModelConfig) -> dict:
+    import numpy as np
+
+    d = dataclasses.asdict(m)
+    d["dtype"] = np.dtype(m.dtype).name
+    return d
+
+
+def model_config_from_dict(d: dict) -> ModelConfig:
+    d = dict(d)
+    dtype = d.get("dtype")
+    if isinstance(dtype, str):
+        # jnp exposes bfloat16/float16/float32 as attributes; np.dtype
+        # round-trips them by name once jax (ml_dtypes) is imported.
+        d["dtype"] = getattr(jnp, dtype)
+    rs = d.get("rope_scaling")
+    if isinstance(rs, dict):
+        d["rope_scaling"] = RopeScaling(**rs)
+    return ModelConfig(**d)
+
+
+def framework_config_to_dict(cfg: FrameworkConfig) -> dict:
+    return {
+        "model": model_config_to_dict(cfg.model),
+        "engine": dataclasses.asdict(cfg.engine),
+        "parallel": dataclasses.asdict(cfg.parallel),
+        "server": dataclasses.asdict(cfg.server),
+        "checkpoint_path": cfg.checkpoint_path,
+        "seed": cfg.seed,
+    }
+
+
+def framework_config_from_dict(d: dict) -> FrameworkConfig:
+    eng = dict(d.get("engine") or {})
+    for k in _TUPLE_FIELDS:
+        if k in eng and eng[k] is not None:
+            eng[k] = tuple(eng[k])
+    return FrameworkConfig(
+        model=model_config_from_dict(d["model"]),
+        engine=EngineConfig(**eng),
+        parallel=ParallelConfig(**(d.get("parallel") or {})),
+        server=ServerConfig(**(d.get("server") or {})),
+        checkpoint_path=d.get("checkpoint_path"),
+        seed=d.get("seed", 0),
+    )

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import random as _chaos_random
+import threading
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -436,6 +437,21 @@ class InferenceEngine:
         self.resumes_total = 0            # recompute-resume prefills
         self.swap_in_resumes = 0          # resumes that restored KV pages
         self.hybrid_steps_total = 0       # fused prefill+decode dispatches
+        # KV page migration (README "Process fleet"): pages/bytes this
+        # engine exported at drain time and imported from a sibling
+        # replica's drain. Plain ints (GIL-atomic reads from scrape
+        # threads), exported read-through by bind_engine.
+        self.migrate_out_pages = 0
+        self.migrate_out_bytes = 0
+        self.migrate_in_pages = 0
+        self.migrate_in_bytes = 0
+        # Cross-thread migration imports (the worker's import-kv RPC
+        # lands on an RPC thread; the host tier is engine-thread only):
+        # queued here, applied by the scheduler loop before admission so
+        # an import acked before its request's submit is visible to that
+        # request's prefill. Each entry is (entries, done_event).
+        self._pending_imports: List[tuple] = []
+        self._pending_imports_lock = threading.Lock()
         self._admit_counter = 0           # admission recency for victims
         # Sequences preempted since the caller last collected them; the
         # scheduler requeues these at the head of its wait queue.
@@ -558,7 +574,6 @@ class InferenceEngine:
         # Dispatch-ahead decode pipeline (decode_steps_pipelined).
         self._inflight: List[dict] = []
         # Embeddings graph (built on first /api/embeddings use).
-        import threading
         self._embed_jit = None
         self._embed_lock = threading.Lock()
 
@@ -1409,6 +1424,78 @@ class InferenceEngine:
         if complete:
             seq.host_prefetched = True
         return len(taken)
+
+    # ------------------------------------------------------------------
+    # KV page migration (README "Process fleet"): drain-time export of a
+    # live sequence's KV pages in the host serialization layout, and
+    # import of a sibling replica's export into this engine's host tier.
+    # ------------------------------------------------------------------
+
+    def export_sequence_kv(self, seq: Sequence
+                           ) -> Tuple[List[bytes], List["kvc.HostKVPage"]]:
+        """Drain-time migration export: (chain digests, host page
+        copies) for the sequence's full, settled KV pages — prompt plus
+        generated-so-far, exactly the stream a destination's
+        recompute-resume prefill will hash, so the import lands as
+        host-tier hits there and admission becomes a swap-in-resume.
+
+        Only the contiguous run of full, non-SWA-evicted pages from
+        page 0 exports (a chain hit must be contiguous from the start;
+        the partial last page recomputes at the destination). Call with
+        the scheduler stopped and the pipeline drained — it reads the
+        live pool."""
+        from tpu_inference.engine.prefix_cache import _chain_hashes
+        if not seq.pages or seq.ctx_len <= 0:
+            return [], []
+        ecfg = self.engine_cfg
+        # Mirror _publish_to_cache's stream reconstruction: the tokens
+        # actually resident in KV, in page order.
+        base = self._prefill_tokens(seq)[-(ecfg.max_context - 1):]
+        in_kv = (base + seq.generated[seq.resume_base:])[:seq.ctx_len]
+        digests = _chain_hashes(in_kv, ecfg.page_size)
+        n = min(len(digests), len(seq.pages))
+        run = 0
+        while run < n and seq.pages[run] != 0:
+            run += 1
+        if run == 0:
+            return [], []
+        host = self._offload_pages(seq.pages[:run])
+        self.migrate_out_pages += len(host)
+        self.migrate_out_bytes += sum(hp.nbytes for hp in host)
+        return digests[:run], host
+
+    def request_import_host(self, entries) -> threading.Event:
+        """Queue migrated (digest, HostKVPage) entries for adoption into
+        the host tier. Any thread; returns an Event set once the engine
+        loop has applied the import — the worker's import-kv RPC replies
+        only then, so a subsequently submitted request is guaranteed to
+        see the pages at prefill time."""
+        done = threading.Event()
+        with self._pending_imports_lock:
+            self._pending_imports.append((list(entries), done))
+        return done
+
+    def apply_pending_imports(self) -> None:
+        """Adopt queued migration imports (engine thread — called by the
+        scheduler loop right before admission, next to
+        apply_pending_page_pressure). No-ops without a host tier, but
+        always signals completion so RPC callers never hang."""
+        with self._pending_imports_lock:
+            pending, self._pending_imports = self._pending_imports, []
+        for entries, done in pending:
+            try:
+                if self.prefix_cache is not None and self.host_pool is not None:
+                    # Pool-delta accounting: import_host may SKIP
+                    # already-resident digests anywhere in the list, so
+                    # summing a prefix of ``entries`` would charge the
+                    # wrong pages' bytes.
+                    bytes_before = self.host_pool.import_bytes_total
+                    self.migrate_in_pages += self.prefix_cache.import_host(
+                        entries)
+                    self.migrate_in_bytes += (
+                        self.host_pool.import_bytes_total - bytes_before)
+            finally:
+                done.set()
 
     def _grant_decode_steps(self, seq: Sequence, k_steps: int,
                             pred_ctx: Optional[int] = None,

@@ -448,8 +448,10 @@ class HostPagePool:
         self.offloaded_total = 0          # pages demoted device -> host
         self.restored_total = 0           # pages promoted host -> device
         self.evicted_total = 0            # second-tier (host LRU) drops
+        self.imported_total = 0           # pages migrated in (fleet drain)
         self.offload_bytes_total = 0
         self.restore_bytes_total = 0
+        self.import_bytes_total = 0
 
     def can_hold(self, n: int = 1) -> bool:
         return self.used + n <= self.capacity
@@ -475,6 +477,15 @@ class HostPagePool:
         self.bytes_resident -= nbytes
         self.evicted_total += 1
 
+    def note_import(self, nbytes: int) -> None:
+        """A page migrated IN from another replica's drain export (fleet
+        KV migration): occupies capacity like a demote, but counted
+        separately — imports are warmth received, not local churn."""
+        self.used += 1
+        self.bytes_resident += nbytes
+        self.imported_total += 1
+        self.import_bytes_total += nbytes
+
     def readmit(self, nbytes: int) -> bool:
         """Undo one note_restore for an entry a failed swap-in returns:
         reverses the restore counters, then re-admits the entry IF the
@@ -488,3 +499,94 @@ class HostPagePool:
         self.used += 1
         self.bytes_resident += nbytes
         return True
+
+
+# ---------------------------------------------------------------------------
+# Migration wire format (README "Process fleet"): HostKVPage batches
+# serialized for the fleet's drain-time KV migration channel. The layout
+# is the host tier's stored layout verbatim — pool-dtype k/v blocks plus
+# optional f32 scales — so any kv_quant mode round-trips bit-exactly and
+# an imported page is indistinguishable from a locally demoted one.
+# ---------------------------------------------------------------------------
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, reaching into ml_dtypes for bfloat16 (numpy
+    only knows it once ml_dtypes registered it — jax imports do that,
+    but a standalone deserializer must not rely on import order)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_host_pages(pages: List[HostKVPage]) -> bytes:
+    """Pack host page copies into one binary blob:
+    ``[u32 header_len][json header][raw k|v|k_scale|v_scale per page]``.
+    All pages in a batch come from one pool, so shapes/dtypes are
+    batch-constant and live once in the header."""
+    import json
+    import struct
+
+    if not pages:
+        return struct.pack(">I", 2) + b"{}"
+    first = pages[0]
+    meta = {
+        "n": len(pages),
+        "k_dtype": np.dtype(first.k.dtype).name,
+        "k_shape": list(first.k.shape),
+        "scaled": first.k_scale is not None,
+    }
+    if meta["scaled"]:
+        meta["scale_dtype"] = np.dtype(first.k_scale.dtype).name
+        meta["scale_shape"] = list(first.k_scale.shape)
+    parts = []
+    for hp in pages:
+        parts.append(np.ascontiguousarray(hp.k).tobytes())
+        parts.append(np.ascontiguousarray(hp.v).tobytes())
+        if meta["scaled"]:
+            parts.append(np.ascontiguousarray(hp.k_scale).tobytes())
+            parts.append(np.ascontiguousarray(hp.v_scale).tobytes())
+    header = json.dumps(meta).encode()
+    return struct.pack(">I", len(header)) + header + b"".join(parts)
+
+
+def deserialize_host_pages(blob: bytes) -> List[HostKVPage]:
+    """Inverse of :func:`serialize_host_pages`. Each returned page owns
+    its bytes (copies out of the blob), so the caller may drop the blob
+    and the pages live independently in the host tier."""
+    import json
+    import struct
+
+    (hlen,) = struct.unpack(">I", blob[:4])
+    meta = json.loads(blob[4:4 + hlen].decode())
+    if not meta:
+        return []
+    k_dtype = _np_dtype(meta["k_dtype"])
+    k_shape = tuple(meta["k_shape"])
+    k_size = int(np.prod(k_shape)) * k_dtype.itemsize
+    scaled = meta.get("scaled", False)
+    if scaled:
+        s_dtype = _np_dtype(meta["scale_dtype"])
+        s_shape = tuple(meta["scale_shape"])
+        s_size = int(np.prod(s_shape)) * s_dtype.itemsize
+    at = 4 + hlen
+    out: List[HostKVPage] = []
+
+    def take(n, dtype, shape):
+        nonlocal at
+        arr = np.frombuffer(blob, dtype=dtype, count=int(np.prod(shape)),
+                            offset=at).reshape(shape).copy()
+        at += n
+        return arr
+
+    for _ in range(meta["n"]):
+        k = take(k_size, k_dtype, k_shape)
+        v = take(k_size, k_dtype, k_shape)
+        ks = vs = None
+        if scaled:
+            ks = take(s_size, s_dtype, s_shape)
+            vs = take(s_size, s_dtype, s_shape)
+        out.append(HostKVPage(k, v, ks, vs))
+    return out

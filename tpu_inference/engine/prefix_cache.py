@@ -367,6 +367,34 @@ class PrefixCache:
         if entry is not None:
             self.host_pool.note_evict(entry.nbytes)
 
+    def import_host(self, entries: Sequence[Tuple[bytes, HostKVPage]]
+                    ) -> int:
+        """Adopt MIGRATED host page copies (another replica's drain
+        export — README "Process fleet") into the host tier, newest-LRU.
+        Digests already resident in either tier are skipped (the local
+        copy is at least as fresh); capacity is made by dropping the
+        host tier's own oldest entries — migrated pages are about to be
+        used by a resubmitted request, so they outrank idle warmth.
+        Stops (dropping the remainder) when the tier cannot hold more:
+        losing a migrated page costs recompute, never correctness.
+        Engine thread only (same stance as evict/insert). Returns the
+        pages adopted."""
+        if self.host_pool is None or self.host_pool.capacity <= 0:
+            return 0
+        added = 0
+        for digest, entry in entries:
+            if digest in self._table or digest in self._host:
+                continue
+            while not self.host_pool.can_hold(1) and self._host:
+                _, old = self._host.popitem(last=False)
+                self.host_pool.note_evict(old.nbytes)
+            if not self.host_pool.can_hold(1):
+                break
+            self._host[digest] = entry
+            self.host_pool.note_import(entry.nbytes)
+            added += 1
+        return added
+
     # ------------------------------------------------------------- evict
 
     def _forget(self, digest: bytes) -> int:
@@ -451,6 +479,7 @@ class PrefixCache:
                 "host_bytes_resident": hp.bytes_resident,
                 "offloaded_pages": hp.offloaded_total,
                 "restored_pages": hp.restored_total,
+                "imported_pages": hp.imported_total,
                 "host_evictions": hp.evicted_total,
             })
         return out

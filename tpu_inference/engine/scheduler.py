@@ -96,6 +96,10 @@ class SchedulerStats:
             # Tiered KV cache: resumes whose published pages survived
             # (HBM or host tier) and swapped in instead of recomputing.
             "swap_in_resumes": engine.swap_in_resumes,
+            # KV page migration (README "Process fleet"): pages exported
+            # at drain / imported from a sibling replica's drain.
+            "migrate_out_pages": engine.migrate_out_pages,
+            "migrate_in_pages": engine.migrate_in_pages,
             # Hybrid prefill-decode stepping (README "Scheduling"):
             # whether chunks fuse into decode dispatches, and how many
             # fused dispatches have run.
@@ -254,6 +258,12 @@ class EngineScheduler:
         seq.enqueue_time = time.perf_counter()
         with self._lock:
             self._waiting.append(_Pending(seq, on_token, on_finish))
+        self._work.set()
+
+    def kick(self) -> None:
+        """Wake the engine loop from its idle wait (e.g. after queueing
+        a cross-thread engine request like a migration import) so it is
+        applied promptly instead of at the next 100 ms poll."""
         self._work.set()
 
     def cancel(self, request_id: int) -> None:
@@ -738,8 +748,12 @@ class EngineScheduler:
         engine = self.engine
         while not self._stop.is_set():
             # Cross-thread chaos page-pressure requests (/debug/chaos)
-            # apply HERE — the allocator is engine-thread only.
+            # and migration imports (the worker's import-kv RPC) apply
+            # HERE — the allocator and host tier are engine-thread only,
+            # and imports must land before admission so a migrated
+            # request's prefill sees them.
             engine.apply_pending_page_pressure()
+            engine.apply_pending_imports()
             self._admit()
             active = engine.active_sequences()
             if not active:

@@ -77,6 +77,28 @@ def main() -> None:
                    help="data-parallel replicas: each gets its own tp*sp "
                         "submesh, KV pool and scheduler; requests route "
                         "to the least-loaded replica")
+    p.add_argument("--fleet", default="in-process",
+                   choices=("in-process", "subprocess"),
+                   help="dp fleet backend (README 'Process fleet'): "
+                        "'in-process' runs every replica as a thread of "
+                        "this server (one process, one GIL, one failure "
+                        "domain); 'subprocess' runs a router plus one "
+                        "engine-worker OS process per replica over a "
+                        "local JSON RPC — worker faults are isolated, "
+                        "workers restart with backoff, and graceful "
+                        "drains migrate KV pages instead of recomputing")
+    p.add_argument("--worker-restart-max", type=int, default=3,
+                   help="subprocess fleet: restarts allowed per worker "
+                        "(doubling backoff) before it stays down and "
+                        "the fleet serves degraded on the survivors")
+    p.add_argument("--drain-timeout-s", type=float, default=10.0,
+                   help="subprocess fleet: budget a SIGTERM'd worker "
+                        "gets to settle dispatches and export KV pages "
+                        "before exiting")
+    p.add_argument("--no-fleet-migrate", action="store_true",
+                   help="subprocess fleet: disable drain-time KV page "
+                        "migration (resubmissions re-prefill from "
+                        "scratch — the benchmark comparison arm)")
     p.add_argument("--attn-backend", default="auto",
                    choices=("auto", "dense", "pallas"),
                    help="decode attention: Pallas paged kernel (TPU) or "
@@ -284,6 +306,10 @@ def main() -> None:
                                  has_draft_model=bool(args.draft_model))
         except ValueError as e:
             p.error(str(e))
+    if args.fleet == "subprocess" and args.draft_model:
+        p.error("--fleet subprocess does not support --draft-model "
+                "(workers boot their own params; use --spec-mode ngram "
+                "or the in-process fleet)")
 
     from tpu_inference.engine.autosize import resolve_sizing_args
 
@@ -334,6 +360,10 @@ def main() -> None:
                               route_hit_weight=args.route_hit_weight,
                               route_host_hit_weight=(
                                   args.route_host_hit_weight),
+                              fleet=args.fleet,
+                              worker_restart_max=args.worker_restart_max,
+                              drain_timeout_s=args.drain_timeout_s,
+                              fleet_migrate=not args.no_fleet_migrate,
                               step_watchdog_s=args.step_watchdog_s,
                               quarantine_after_failures=args.quarantine_after,
                               quarantine_cooldown_s=args.quarantine_cooldown_s,
@@ -370,6 +400,9 @@ def main() -> None:
                               args.num_speculative_tokens
                               if spec_mode != "off" else 0))
     if args.check_numerics:
+        if args.fleet == "subprocess":
+            p.error("--check-numerics needs the in-process fleet "
+                    "(workers own their params)")
         for eng in server.group.engines:
             eng.check_numerics()
         print("numerics check passed: params finite, forward NaN-free")

@@ -1,0 +1,1223 @@
+"""Process fleet router: ``EngineGroup`` semantics over worker processes.
+
+The out-of-process half of ROADMAP item 3 (README "Process fleet").
+``ProcessEngineGroup`` implements the same facade as the in-process
+``EngineGroup`` (submit/cancel, health/stats/metrics/recent snapshots,
+prefix-affinity routing, failover, admission control) behind
+``--fleet subprocess``, but each dp replica is its own engine-worker OS
+process (server/worker.py) speaking the length-prefixed JSON RPC over a
+local unix socket — so a worker fault (wedge, crash, ``kill -9``) is one
+process, not the whole fleet, and the GIL stops being the dp ceiling.
+
+Supervision: a monitor thread restarts dead workers with doubling
+backoff up to ``ServerConfig.worker_restart_max`` per worker, keeping
+the ``replica="i"`` metrics label STABLE across incarnations — counter
+and histogram series from dead incarnations fold into a per-replica
+carry (telemetry.fold_dump_into_carry) so the aggregated /metrics scrape
+never resets or double-reports across a restart.
+
+Failure handling replaces the two recompute burns with better moves:
+
+- graceful drain (SIGTERM / drain RPC): the worker exports each live
+  request's KV pages (host serialization layout) as ``migrate`` events;
+  the router imports them into the destination's host tier and resubmits
+  with the streamed-token record, so admission there is a
+  swap-in-resume (engine.swap_in_resumes) instead of a re-prefill.
+- ``kill -9`` mid-decode: no export is possible, so the router falls
+  back to resubmission failover — it replays its own token record as a
+  recompute-resume on a survivor (token-identical under greedy), and
+  the client stream continues where it left off.
+
+Routing stays PR-5/PR-6 three-temperature prefix affinity: the router
+hashes each prompt once and probes every worker's cache tiers through
+the side-effect-free ``peek`` RPC, scoring with the same formula as
+EngineGroup._pick. Tokens stream through the router without buffering
+(one event frame per token, forwarded as it arrives).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_inference import telemetry
+from tpu_inference.config import (FrameworkConfig, framework_config_to_dict)
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.engine import Sequence
+from tpu_inference.engine.prefix_cache import _chain_hashes
+from tpu_inference.server.replicas import (FleetSaturated, FleetUnavailable,
+                                           _RETRYABLE, _clone_request,
+                                           aggregate_replica_stats)
+from tpu_inference.server.worker import recv_frame, send_frame
+
+
+class WorkerGone(ConnectionError):
+    """RPC failed because the worker's process/connection died."""
+
+
+class WorkerClient:
+    """One live RPC connection to one worker incarnation. Requests are
+    correlated by id; unsolicited event frames dispatch to the group's
+    handler on this client's reader thread."""
+
+    def __init__(self, path: str, proc: subprocess.Popen,
+                 connect_timeout: float = 1800.0):
+        import socket as _socket
+
+        deadline = time.monotonic() + connect_timeout
+        last_err: Optional[Exception] = None
+        self.sock = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise WorkerGone(
+                    f"worker exited rc={proc.returncode} before accepting")
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            try:
+                s.connect(path)
+                self.sock = s
+                break
+            except OSError as e:
+                last_err = e
+                s.close()
+                time.sleep(0.05)
+        if self.sock is None:
+            raise WorkerGone(f"could not connect to worker: {last_err}")
+        self.rfile = self.sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._pending: Dict[int, dict] = {}
+        self._plock = threading.Lock()
+        self.alive = True
+        self.on_event: Optional[Callable] = None     # set by the group
+        self.on_lost: Optional[Callable] = None
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="fleet-worker-reader",
+                                        daemon=True)
+
+    def start_reader(self) -> None:
+        self._reader.start()
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def rpc(self, verb: str, timeout: float = 60.0, blob: bytes = b"",
+            **kw) -> dict:
+        """Send one request frame and wait for its reply. Raises
+        WorkerGone on a dead connection, RuntimeError on an error
+        reply."""
+        if not self.alive:
+            raise WorkerGone("connection closed")
+        rid = next(self._ids)
+        waiter = {"evt": threading.Event(), "reply": None}
+        with self._plock:
+            self._pending[rid] = waiter
+        msg = {"id": rid, "verb": verb}
+        msg.update(kw)
+        try:
+            with self._wlock:
+                send_frame(self.sock, msg, blob)
+        except OSError as e:
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise WorkerGone(str(e))
+        if not waiter["evt"].wait(timeout):
+            with self._plock:
+                self._pending.pop(rid, None)
+            if not self.alive:
+                raise WorkerGone("connection lost mid-RPC")
+            raise TimeoutError(f"worker RPC {verb!r} timed out")
+        reply = waiter["reply"]
+        if reply is None or not reply[0].get("ok", False):
+            err = (reply[0].get("error", "worker error") if reply
+                   else "connection lost")
+            kind = reply[0].get("kind", "") if reply else "gone"
+            if kind in ("gone", "draining"):
+                raise WorkerGone(err)
+            raise RuntimeError(f"worker RPC {verb!r}: {err}")
+        return reply[0]
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                obj, blob = recv_frame(self.rfile)
+                if "ev" in obj:
+                    if self.on_event is not None:
+                        self.on_event(self, obj, blob)
+                    continue
+                with self._plock:
+                    waiter = self._pending.pop(obj.get("id"), None)
+                if waiter is not None:
+                    waiter["reply"] = (obj, blob)
+                    waiter["evt"].set()
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            self.alive = False
+            with self._plock:
+                pending, self._pending = self._pending, {}
+            for waiter in pending.values():
+                waiter["evt"].set()
+            if self.on_lost is not None:
+                self.on_lost(self)
+
+
+# Worker lifecycle states.
+BOOTING = "booting"
+UP = "up"
+DRAINING = "draining"
+RESTARTING = "restarting"
+DEAD = "dead"           # restart budget exhausted (or boot failed)
+
+
+class WorkerHandle:
+    """Supervision state for one replica slot across incarnations. The
+    replica index (and its metrics label) is stable; the process, socket
+    and client change per restart."""
+
+    def __init__(self, replica: int):
+        self.replica = replica
+        self.state = BOOTING
+        self.proc: Optional[subprocess.Popen] = None
+        self.client: Optional[WorkerClient] = None
+        self.socket_path = ""
+        self.incarnation = 0
+        self.restarts = 0               # successful respawns
+        self.consecutive_failures = 0   # backoff driver
+        self.restart_at = 0.0           # monotonic deadline for respawn
+        self.started_unix = 0.0
+        self.pid: Optional[int] = None
+        self.info: dict = {}
+        self.last_stats: dict = {}
+        self.last_metrics: list = []
+        self.last_health: dict = {}
+        # Monotonic-series carry from dead incarnations (telemetry.
+        # fold_dump_into_carry) — the restart-survival half of the
+        # stable replica label. folded_incarnation makes the fold
+        # idempotent: the drained event and the monitor's process-exit
+        # detection can both report one death.
+        self.carry: Dict[tuple, dict] = {}
+        self.folded_incarnation = 0
+
+    @property
+    def routable(self) -> bool:
+        return self.state == UP
+
+
+class _Tracked:
+    """Router-side state for one in-flight request across attempts,
+    workers, and migrations."""
+
+    __slots__ = ("template", "on_token", "on_finish", "worker", "client",
+                 "generation", "attempts", "tokens", "seq_local",
+                 "resume_stream_len", "t_submit")
+
+    def __init__(self, template: Sequence, on_token, on_finish):
+        self.template = template
+        self.on_token = on_token
+        self.on_finish = on_finish
+        self.worker: Optional[WorkerHandle] = None
+        self.client: Optional[WorkerClient] = None
+        self.generation = 0
+        self.attempts = 0
+        # Every token streamed to the caller, in order — the failover
+        # record that lets a killed worker's mid-stream request
+        # recompute-resume on a survivor instead of failing.
+        self.tokens: List[int] = []
+        self.seq_local = _clone_request(template)
+        # Tokens the latest resume-resubmission re-prefilled (prompt +
+        # replayed generated), for the migrated-vs-recomputed accounting.
+        self.resume_stream_len = 0
+        self.t_submit = time.perf_counter()
+
+
+class _EngineInfo:
+    """Model/engine facts the HTTP layer reads off ``group.engine``
+    (/api/ps, /api/show, boot prints), fetched once from worker 0's
+    hello RPC. ``prefix_cache`` mimics the engine attribute's truthiness
+    (the HTTP layer only checks ``is not None``)."""
+
+    def __init__(self, hello: dict):
+        self.n_params = hello.get("n_params", 0)
+        self.weight_bytes = hello.get("weight_bytes", 0)
+        self.attn_backend = hello.get("attn_backend", "?")
+        self.ladder = tuple(hello.get("ladder") or (1,))
+        self.swa_evict = hello.get("swa_evict", False)
+        self.prefix_cache = True if hello.get("prefix_cache") else None
+        self.spec_draft = hello.get("spec_draft", False)
+        self.host_pool = None
+
+
+class ProcessEngineGroup:
+    """Router + N engine-worker processes behind the EngineGroup facade
+    (``ServerConfig.fleet = "subprocess"``)."""
+
+    def __init__(self, cfg: FrameworkConfig):
+        pcfg = cfg.parallel
+        self.cfg = cfg
+        self.server_cfg = cfg.server
+        self.engine_cfg = cfg.engine
+        self.dp = max(1, pcfg.dp)
+        self.workers = [WorkerHandle(i) for i in range(self.dp)]
+        self._sock_dir = tempfile.mkdtemp(prefix="tpuinf-fleet-")
+        self._started = False
+        self._stopping = False
+        self._start_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._tracked: Dict[int, _Tracked] = {}
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.engine: Optional[_EngineInfo] = None
+        self.warmup_total_s = 0.0
+        # Fleet counters — the same supervision family as EngineGroup
+        # (torn-read-tolerant plain ints) plus the process-fleet extras.
+        self.retries_attempted = 0
+        self.retries_succeeded = 0
+        self.failovers = 0
+        self.requests_shed = 0
+        self.requests_unavailable = 0
+        self.route_prefix_hits = 0
+        self.route_cold = 0
+        self.migrations = 0             # drain exports received
+        self.migrated_pages = 0
+        self.migrated_bytes = 0
+        self.resume_resubmits = 0       # resume-replay resubmissions
+        self.resume_recomputed_tokens = 0
+        self.resume_reused_tokens = 0
+        self._rr = 0
+        self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0,
+                              "host_hit_pages": 0}
+                             for _ in range(self.dp)]
+        self._fleet_registry = telemetry.Registry()
+        self._build_registry()
+
+    # ------------------------------------------------------ registries
+
+    def _build_registry(self) -> None:
+        r = self._fleet_registry
+        r.gauge("tpu_inf_replicas", "Configured dp replicas",
+                fn=lambda: self.dp)
+        r.counter("tpu_inf_retries_attempted_total",
+                  "Failover resubmissions attempted",
+                  fn=lambda: self.retries_attempted)
+        r.counter("tpu_inf_retries_succeeded_total",
+                  "Failover resubmissions that finished cleanly",
+                  fn=lambda: self.retries_succeeded)
+        r.counter("tpu_inf_failovers_total",
+                  "Requests stranded by a dead/draining worker and "
+                  "resubmitted",
+                  fn=lambda: self.failovers)
+        r.counter("tpu_inf_requests_shed_total",
+                  "Requests shed at the admission queue cap (HTTP 429)",
+                  fn=lambda: self.requests_shed)
+        r.counter("tpu_inf_requests_unavailable_total",
+                  "Requests rejected with no routable worker (HTTP 503)",
+                  fn=lambda: self.requests_unavailable)
+        r.counter("tpu_inf_route_prefix_hits_total",
+                  "Dispatches routed with a non-zero prefix-cache peek",
+                  fn=lambda: self.route_prefix_hits)
+        r.counter("tpu_inf_route_cold_total",
+                  "Dispatches routed with no cached prefix on any "
+                  "scored worker",
+                  fn=lambda: self.route_cold)
+        self._route_hit_pages_hist = r.histogram(
+            "tpu_inf_route_hit_pages",
+            "Peeked prefix-cache hit pages per warm-routed dispatch",
+            buckets=telemetry.COUNT_BUCKETS)
+        r.counter("tpu_inf_fleet_migrations_total",
+                  "In-flight requests migrated off a draining worker",
+                  fn=lambda: self.migrations)
+        r.counter("tpu_inf_fleet_migrated_pages_total",
+                  "KV pages moved worker-to-worker by drain migration",
+                  fn=lambda: self.migrated_pages)
+        r.counter("tpu_inf_fleet_migrated_bytes_total",
+                  "Bytes moved worker-to-worker by drain migration",
+                  fn=lambda: self.migrated_bytes)
+        r.counter("tpu_inf_resume_recomputed_tokens_total",
+                  "Tokens re-prefilled from scratch by fleet "
+                  "resubmission resumes (lower is better — migration "
+                  "exists to shrink this)",
+                  fn=lambda: self.resume_recomputed_tokens)
+        r.counter("tpu_inf_resume_reused_tokens_total",
+                  "Tokens served from cache tiers (incl. migrated "
+                  "pages) during fleet resubmission resumes",
+                  fn=lambda: self.resume_reused_tokens)
+        for h in self.workers:
+            r.gauge("tpu_inf_replica_routable",
+                    "1 when the worker accepts traffic",
+                    fn=lambda hh=h: float(hh.routable),
+                    replica=str(h.replica))
+            r.gauge("tpu_inf_worker_up",
+                    "1 while the worker process is serving",
+                    fn=lambda hh=h: float(hh.state == UP),
+                    replica=str(h.replica))
+            r.counter("tpu_inf_worker_restarts_total",
+                      "Worker process respawns (stable replica label "
+                      "across incarnations)",
+                      fn=lambda hh=h: hh.restarts,
+                      replica=str(h.replica))
+
+    # ----------------------------------------------------------- spawn
+
+    def _envelope(self) -> dict:
+        import jax
+
+        pcfg = self.cfg.parallel
+        return {
+            "config": framework_config_to_dict(self.cfg),
+            "platform": jax.default_backend(),
+            "cpu_devices": max(1, pcfg.tp * pcfg.sp),
+            "warmup": self.cfg.server.warmup,
+        }
+
+    def _spawn(self, h: WorkerHandle) -> None:
+        """Launch one worker incarnation and wait for its hello (which
+        blocks until the worker's engine is built and warmed)."""
+        h.incarnation += 1
+        h.socket_path = os.path.join(
+            self._sock_dir, f"w{h.replica}.{h.incarnation}.sock")
+        env = dict(os.environ)
+        # The repo may be run uninstalled (benchmarks insert sys.path
+        # manually); the worker interpreter needs the same root.
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_inference.server.worker",
+             "--socket", h.socket_path, "--replica", str(h.replica)],
+            stdin=subprocess.PIPE, env=env)
+        try:
+            assert proc.stdin is not None
+            proc.stdin.write(json.dumps(self._envelope()).encode())
+            proc.stdin.close()
+            client = WorkerClient(h.socket_path, proc)
+            client.on_event = lambda c, obj, blob, hh=h: self._on_event(
+                hh, c, obj, blob)
+            client.on_lost = lambda c, hh=h: self._on_conn_lost(hh, c)
+            client.start_reader()
+            hello = client.rpc("hello", timeout=1800.0)
+        except BaseException:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            raise
+        h.proc, h.client = proc, client
+        h.pid = hello.get("pid")
+        h.info = hello
+        h.started_unix = time.time()
+        h.state = UP
+        h.consecutive_failures = 0
+        self.warmup_total_s += hello.get("warmup_s", 0.0)
+        if self.engine is None:
+            self.engine = _EngineInfo(hello)
+        telemetry.log_event(
+            "worker_up", level="info", replica=h.replica,
+            pid=h.pid, incarnation=h.incarnation)
+
+    def _ensure_started(self) -> None:
+        with self._start_lock:
+            if self._started:
+                return
+            for h in self.workers:
+                self._spawn(h)
+            self._started = True
+
+    # ---------------------------------------------------------- facade
+
+    @property
+    def engines(self) -> List[_EngineInfo]:
+        """Len/iteration parity with EngineGroup.engines (the HTTP layer
+        reads ``len(group.engines)`` for the dp count)."""
+        info = self.engine or _EngineInfo({})
+        return [info] * self.dp
+
+    def warmup(self) -> float:
+        self._ensure_started()
+        return self.warmup_total_s
+
+    def start(self) -> "ProcessEngineGroup":
+        self._ensure_started()
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(target=self._watch,
+                                         name="fleet-monitor",
+                                         daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        self._stopping = True
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+            self._monitor = None
+        for h in self.workers:
+            if h.client is not None and h.client.alive:
+                try:
+                    h.client.rpc("shutdown", timeout=timeout + 30.0,
+                                 drain=drain, timeout_s=timeout)
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    pass
+            if h.proc is not None and h.proc.poll() is None:
+                try:
+                    h.proc.terminate()
+                    h.proc.wait(timeout=10.0)
+                except (subprocess.TimeoutExpired, OSError):
+                    try:
+                        h.proc.kill()
+                        h.proc.wait(timeout=5.0)
+                    except (subprocess.TimeoutExpired, OSError):
+                        pass
+            if h.client is not None:
+                h.client.close()
+            h.state = DEAD
+        # Anything still tracked gets its terminal callback (shutdown),
+        # so no client stream hangs on a router teardown.
+        with self._lock:
+            leftovers = list(self._tracked.values())
+            self._tracked.clear()
+        for entry in leftovers:
+            ghost = entry.seq_local
+            ghost.done, ghost.finish_reason = True, "shutdown"
+            ghost.finish_time = time.perf_counter()
+            entry.on_finish(ghost)
+
+    # ------------------------------------------------------ supervision
+
+    def _watch(self) -> None:
+        """Monitor thread: process liveness, restart backoff, and the
+        periodic metrics/stats cache that bounds kill -9 carry loss."""
+        last_scrape = 0.0
+        while not self._monitor_stop.wait(0.2):
+            now = time.monotonic()
+            for h in self.workers:
+                if h.state in (UP, DRAINING) and h.proc is not None \
+                        and h.proc.poll() is not None:
+                    self._on_worker_down(
+                        h, f"exit rc={h.proc.returncode}")
+                elif h.state == RESTARTING and now >= h.restart_at \
+                        and not self._stopping:
+                    try:
+                        self._spawn(h)
+                        h.restarts += 1
+                    except (WorkerGone, TimeoutError, RuntimeError,
+                            OSError) as e:
+                        h.consecutive_failures += 1
+                        telemetry.log_event(
+                            "worker_respawn_failed", level="error",
+                            replica=h.replica, error=str(e))
+                        self._schedule_restart(h)
+            if now - last_scrape >= 1.0:
+                last_scrape = now
+                self._refresh_caches()
+
+    def _refresh_caches(self) -> None:
+        for h in self.workers:
+            if h.state != UP or h.client is None:
+                continue
+            try:
+                h.last_metrics = h.client.rpc(
+                    "metrics", timeout=10.0)["samples"]
+                h.last_stats = h.client.rpc(
+                    "stats", timeout=10.0)["stats"]
+                h.last_health = h.client.rpc("healthz", timeout=10.0)
+            except (WorkerGone, TimeoutError, RuntimeError):
+                pass
+
+    def _schedule_restart(self, h: WorkerHandle) -> None:
+        scfg = self.server_cfg
+        # Budget covers BOTH successful respawns and consecutive boot
+        # failures — a worker whose boot crashes deterministically
+        # (deleted checkpoint, bad device) must go DEAD, not respawn a
+        # jax-importing process forever.
+        if (self._stopping or h.restarts >= scfg.worker_restart_max
+                or h.consecutive_failures > scfg.worker_restart_max):
+            h.state = DEAD
+            telemetry.log_event("worker_dead", level="error",
+                                replica=h.replica, restarts=h.restarts,
+                                consecutive_failures=h.consecutive_failures)
+            return
+        backoff = min(30.0, scfg.worker_restart_backoff_s
+                      * (2 ** max(0, h.consecutive_failures)))
+        h.restart_at = time.monotonic() + backoff
+        h.state = RESTARTING
+
+    def _on_conn_lost(self, h: WorkerHandle, client: WorkerClient) -> None:
+        if self._stopping or h.client is not client:
+            return
+        # Reader died first (socket reset); the monitor would catch the
+        # process exit too — whoever flips the state first acts.
+        if h.state in (UP, DRAINING):
+            self._on_worker_down(h, "connection lost")
+
+    def _on_worker_down(self, h: WorkerHandle, reason: str) -> None:
+        """A worker incarnation died (kill -9, crash, or post-drain
+        exit): fold its last-seen monotonic series into the carry, fail
+        over its in-flight requests from the router's token record, and
+        schedule a respawn under the same replica label."""
+        with self._lock:
+            # Monitor (proc poll) and reader (conn lost) can both see
+            # the death; the state flip under the lock picks one actor.
+            if h.state not in (UP, DRAINING):
+                return
+            h.state = RESTARTING
+        h.consecutive_failures += 1
+        if h.proc is not None and h.proc.poll() is None:
+            try:
+                h.proc.kill()
+            except OSError:
+                pass
+        if h.client is not None:
+            h.client.close()
+        if h.folded_incarnation != h.incarnation:
+            # Once per incarnation: the drained-event path and a second
+            # death report must not double-fold the same totals. The
+            # folded dump is then CLEARED — rendering it alongside the
+            # carry (e.g. a scrape hitting the fresh incarnation before
+            # its first metrics RPC succeeds) would double-count.
+            h.folded_incarnation = h.incarnation
+            telemetry.fold_dump_into_carry(h.carry, h.last_metrics)
+            h.last_metrics = []
+        telemetry.log_event("worker_down", level="warning",
+                            replica=h.replica, reason=reason)
+        self._schedule_restart(h)
+        self._failover_worker(h)
+
+    # --------------------------------------------------------- routing
+
+    def _routable(self) -> List[WorkerHandle]:
+        return [h for h in self.workers if h.routable]
+
+    def _fleet_load(self, h: WorkerHandle) -> int:
+        with self._lock:
+            return sum(1 for e in self._tracked.values()
+                       if e.worker is h)
+
+    def _digests_for(self, seq: Sequence) -> Tuple[List[bytes], int]:
+        """Routing-time prefix digests — same truncation/trim rule as
+        EngineGroup._digests_for (replicas.py), over the router's own
+        copy of the engine config."""
+        ecfg = self.engine_cfg
+        prompt_len = min(len(seq.prompt_tokens), ecfg.max_context - 1)
+        prompt_pages = kvc.pages_needed(prompt_len, ecfg.page_size)
+        cap = (prompt_len - 1) // ecfg.page_size
+        if cap <= 0:
+            return [], prompt_pages
+        if seq.prefix_digests is None:
+            tokens = seq.prompt_tokens
+            prompt = (tokens[-prompt_len:] if len(tokens) > prompt_len
+                      else tokens)
+            seq.prefix_digests = _chain_hashes(prompt, ecfg.page_size)
+        return seq.prefix_digests[:cap], prompt_pages
+
+    def _peek(self, h: WorkerHandle, digests: List[bytes]) -> dict:
+        try:
+            return h.client.rpc("peek", timeout=10.0,
+                                digests=[d.hex() for d in digests])
+        except (WorkerGone, TimeoutError, RuntimeError):
+            return {"hbm": 0, "host": 0, "load": self._fleet_load(h),
+                    "pressure": False}
+
+    def _rotate(self, ties: list):
+        if len(ties) == 1:
+            return ties[0]
+        idx = self._rr % len(ties)
+        self._rr += 1
+        return ties[idx]
+
+    def _pick(self, cands: List[WorkerHandle],
+              seq: Optional[Sequence] = None
+              ) -> Tuple[WorkerHandle, Tuple[int, int], int]:
+        """Choose a worker; returns (handle, (hbm, host) peeked pages,
+        load at decision time). Same three-temperature scoring formula
+        as EngineGroup._pick (replicas.py — the in-process fleet is the
+        documented contract), with worker state fetched over the peek
+        RPC instead of read off a scheduler object."""
+        cfg = self.server_cfg
+        digests: List[bytes] = []
+        prompt_pages = 0
+        if seq is not None and cfg.routing == "prefix_affinity":
+            digests, prompt_pages = self._digests_for(seq)
+        peeks = [self._peek(h, digests) for h in cands]
+        if digests and any(p["hbm"] + p["host"] for p in peeks):
+            scored = []
+            for h, p in zip(cands, peeks):
+                score = (prompt_pages - cfg.route_hit_weight * p["hbm"]
+                         - cfg.route_host_hit_weight * p["host"]
+                         + cfg.route_load_pages * p["load"])
+                if p["pressure"]:
+                    score += prompt_pages + 1
+                scored.append(((score, p["pressure"], p["load"]),
+                               h, (p["hbm"], p["host"]), p["load"]))
+            best = min(key for key, _, _, _ in scored)
+            return self._rotate([(h, hit, load)
+                                 for key, h, hit, load in scored
+                                 if key == best])
+        keyed = [((p["pressure"], p["load"]), h, p["load"])
+                 for h, p in zip(cands, peeks)]
+        best = min(key for key, _, _ in keyed)
+        return self._rotate([(h, (0, 0), load)
+                             for key, h, load in keyed if key == best])
+
+    # ------------------------------------------------------- submission
+
+    def submit(self, seq: Sequence, on_token: Callable,
+               on_finish: Callable) -> None:
+        routable = self._routable()
+        if not routable:
+            with self._lock:
+                self.requests_unavailable += 1
+            raise FleetUnavailable("no routable worker",
+                                   self.server_cfg.retry_after_s)
+        h, hit, load = self._pick(routable, seq)
+        cap = self.server_cfg.admission_queue_depth
+        if cap > 0 and load >= cap:
+            # Affinity saturated a warm worker: least-loaded fallback
+            # before shedding, exactly like EngineGroup.submit.
+            h2, _, load2 = self._pick(routable)
+            if load2 >= cap:
+                with self._lock:
+                    self.requests_shed += 1
+                raise FleetSaturated(
+                    f"admission queue cap reached ({load2} >= {cap} on "
+                    "the least-loaded worker)",
+                    self.server_cfg.retry_after_s)
+            h, hit = h2, self._peek_hit(h2, seq)
+        entry = _Tracked(_clone_request(seq), on_token, on_finish)
+        entry.seq_local.trace_id = seq.trace_id
+        entry.seq_local.enqueue_time = time.perf_counter()
+        with self._lock:
+            self._tracked[seq.request_id] = entry
+        if not self._dispatch(entry, h, hit):
+            self._retry_or_fail(entry, exclude=h)
+
+    def _peek_hit(self, h: WorkerHandle, seq: Sequence) -> Tuple[int, int]:
+        if self.server_cfg.routing != "prefix_affinity":
+            return (0, 0)
+        p = self._peek(h, self._digests_for(seq)[0])
+        return (p["hbm"], p["host"])
+
+    def _dispatch(self, entry: _Tracked, h: WorkerHandle,
+                  hit: Tuple[int, int]) -> bool:
+        """Submit one attempt to one worker. Returns False when the
+        worker refused (dead/draining) so the caller can re-route."""
+        t = entry.template
+        gen_tokens = list(entry.tokens)
+        with self._lock:
+            entry.worker, entry.client = h, h.client
+        hbm, host = hit
+        total_hit = hbm + host
+        sl = entry.seq_local
+        sl.routed_replica = h.replica
+        sl.route_hit_pages = total_hit
+        sl.route_host_hit_pages = host
+        sl.attempt = entry.attempts
+        stats = self._route_stats[h.replica]
+        if total_hit > 0:
+            self.route_prefix_hits += 1
+            stats["hits"] += 1
+            stats["hit_pages"] += total_hit
+            stats["host_hit_pages"] += host
+            self._route_hit_pages_hist.observe(total_hit)
+        else:
+            self.route_cold += 1
+            stats["cold"] += 1
+        if gen_tokens:
+            self.resume_resubmits += 1
+            entry.resume_stream_len = (
+                min(len(t.prompt_tokens) + len(gen_tokens),
+                    self.engine_cfg.max_context - 1))
+        payload = {
+            "request_id": t.request_id,
+            "prompt_tokens": list(t.prompt_tokens),
+            "max_new_tokens": t.max_new_tokens,
+            "temperature": t.temperature, "top_p": t.top_p,
+            "top_k": t.top_k, "seed": t.seed,
+            "repeat_penalty": t.repeat_penalty,
+            "repeat_last_n": t.repeat_last_n,
+            "eos_token_id": t.eos_token_id,
+            "trace_id": t.trace_id,
+            "attempt": entry.attempts,
+            "generated": gen_tokens,
+        }
+        try:
+            h.client.rpc("submit", timeout=60.0, seq=payload)
+            return True
+        except TimeoutError:
+            # The RPC may still be QUEUED behind a busy reader thread:
+            # without a cancel the worker would eventually execute it
+            # and decode a ghost alongside the re-routed copy. Best
+            # effort — if the worker is truly dead the cancel fails too.
+            try:
+                h.client.rpc("cancel", timeout=5.0,
+                             rid=t.request_id)
+            except (WorkerGone, TimeoutError, RuntimeError):
+                pass
+            return False
+        except (WorkerGone, RuntimeError):
+            return False
+
+    def _retry_or_fail(self, entry: _Tracked,
+                       exclude: Optional[WorkerHandle] = None) -> None:
+        """Re-route one attempt after a refused/failed dispatch; fail
+        cleanly when no worker remains."""
+        if exclude is not None:
+            with self._lock:
+                if entry.worker is not exclude:
+                    # A competing path (worker-down failover / migrate)
+                    # detached and re-dispatched this entry while our
+                    # dispatch to `exclude` was failing — re-routing it
+                    # again here would run the request twice.
+                    return
+                entry.worker = entry.client = None
+        others = [h for h in self._routable() if h is not exclude]
+        pool = others or self._routable()
+        if pool:
+            h, hit, _ = self._pick(pool, entry.template)
+            if self._dispatch(entry, h, hit):
+                return
+        rid = entry.template.request_id
+        with self._lock:
+            self._tracked.pop(rid, None)
+        ghost = entry.seq_local
+        ghost.done, ghost.finish_reason = True, "unavailable"
+        ghost.finish_time = time.perf_counter()
+        entry.on_finish(ghost)
+
+    def cancel(self, request_id: int) -> None:
+        with self._lock:
+            entry = self._tracked.pop(request_id, None)
+            if entry is not None:
+                entry.generation += 1
+                h = entry.worker
+        if entry is None or h is None or h.client is None:
+            return
+
+        def _rpc_cancel(client=h.client):
+            # Fire-and-forget: cancel is called from HTTP handlers
+            # (timeouts, disconnects, stop sequences) that must not
+            # block on a slow worker; a lost cancel only costs the
+            # worker a few wasted tokens before its own reap.
+            try:
+                client.rpc("cancel", timeout=10.0, rid=request_id)
+            except (WorkerGone, TimeoutError, RuntimeError):
+                pass
+
+        threading.Thread(target=_rpc_cancel, name="fleet-cancel",
+                         daemon=True).start()
+
+    # ----------------------------------------------------------- events
+
+    def _on_event(self, h: WorkerHandle, client: WorkerClient,
+                  obj: dict, blob: bytes) -> None:
+        ev = obj.get("ev")
+        if self._stopping and ev in ("migrate", "drained"):
+            return      # teardown: no re-routing onto closing workers
+        if ev == "token":
+            self._on_token(h, client, obj)
+        elif ev == "finish":
+            self._on_finish(h, client, obj)
+        elif ev == "migrate":
+            self._on_migrate(h, client, obj, blob)
+        elif ev == "drained":
+            self._on_drained(h, client, obj)
+
+    def _entry_for(self, rid: int, h: WorkerHandle,
+                   client: WorkerClient) -> Optional[_Tracked]:
+        entry = self._tracked.get(rid)
+        if entry is None or entry.worker is not h \
+                or entry.client is not client:
+            return None
+        return entry
+
+    def _on_token(self, h, client, obj) -> None:
+        with self._lock:
+            entry = self._entry_for(obj["rid"], h, client)
+            if entry is None:
+                return
+            tok = int(obj["t"])
+            entry.tokens.append(tok)
+            sl = entry.seq_local
+            sl.generated.append(tok)
+            if sl.first_token_time == 0.0:
+                sl.first_token_time = time.perf_counter()
+        entry.on_token(sl, tok)
+
+    def _on_finish(self, h, client, obj) -> None:
+        rid = obj["rid"]
+        reason = obj.get("reason", "stop")
+        with self._lock:
+            entry = self._entry_for(rid, h, client)
+            if entry is None:
+                return
+            retryable = (reason in _RETRYABLE
+                         and not entry.tokens
+                         and entry.attempts
+                         < self.server_cfg.failover_max_retries)
+            pool = ([w for w in self._routable() if w is not h]
+                    or self._routable()) if retryable else []
+            if pool:
+                entry.attempts += 1
+                entry.generation += 1
+                entry.worker = entry.client = None   # claim (see above)
+                self.retries_attempted += 1
+            else:
+                self._tracked.pop(rid, None)
+                if entry.attempts and reason in ("stop", "length"):
+                    self.retries_succeeded += 1
+            # Migration accounting: the resume stream this attempt
+            # re-prefilled, minus what the destination's cache tiers
+            # (incl. migrated pages) served.
+            if entry.resume_stream_len and not pool:
+                cached = int(obj.get("cached_tokens", 0))
+                reused = min(cached, entry.resume_stream_len)
+                self.resume_reused_tokens += reused
+                self.resume_recomputed_tokens += (
+                    entry.resume_stream_len - reused)
+        if pool:
+            hh, hit, _ = self._pick(pool, entry.template)
+            if self._dispatch(entry, hh, hit):
+                return
+            self._retry_or_fail(entry, exclude=hh)
+            return
+        sl = entry.seq_local
+        sl.done = True
+        sl.finish_reason = reason
+        sl.finish_time = time.perf_counter()
+        sl.cached_tokens = int(obj.get("cached_tokens", 0))
+        sl.host_restored_pages = int(obj.get("host_restored_pages", 0))
+        sl.preemptions = int(obj.get("preemptions", 0))
+        if sl.first_token_time and obj.get("prefill_s") is not None:
+            # Synthesize a local prefill_start from the worker-reported
+            # prefill duration so the Ollama duration counters hold.
+            sl.prefill_start = max(
+                sl.enqueue_time,
+                sl.first_token_time - float(obj["prefill_s"]))
+        entry.on_finish(sl)
+
+    def _on_migrate(self, h, client, obj, blob) -> None:
+        """A draining worker exported one in-flight request: import its
+        KV pages into a destination worker's host tier and resubmit with
+        the router's token record — the swap-in-resume path."""
+        rid = obj["rid"]
+        with self._lock:
+            entry = self._entry_for(rid, h, client)
+            if entry is None:
+                return
+            entry.generation += 1
+            # DETACH under the lock: the monitor's worker-down failover
+            # can race this handler for the same entry (the draining
+            # process exits while its last events are still in the
+            # reader's buffer); whoever claims it first owns the one
+            # resubmission, the loser's _entry_for sees a changed
+            # worker and stands down.
+            entry.worker = entry.client = None
+            entry.attempts += 1
+            self.migrations += 1
+            self.retries_attempted += 1
+            self.failovers += 1
+        n_gen = int(obj.get("n_generated", 0))
+        if n_gen != len(entry.tokens):
+            telemetry.log_event(
+                "migrate_token_mismatch", level="warning",
+                request_id=entry.template.trace_id or str(rid),
+                worker_generated=n_gen, router_streamed=len(entry.tokens))
+        digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
+        others = [w for w in self._routable() if w is not h]
+        if not others:
+            # No exclude: this entry is already claimed (detached) by
+            # the block above and no dispatch was attempted — the guard
+            # in _retry_or_fail only applies after a failed dispatch.
+            self._retry_or_fail(entry)
+            return
+        dest, hit, _ = self._pick(others, entry.template)
+        if (blob and digests and self.server_cfg.fleet_migrate
+                and dest.client is not None):
+            try:
+                r = dest.client.rpc("import-kv", timeout=60.0, blob=blob,
+                                    digests=[d.hex() for d in digests])
+                with self._lock:
+                    self.migrated_pages += int(r.get("adopted", 0))
+                    self.migrated_bytes += len(blob)
+                # Re-peek so the routing span reflects the just-imported
+                # warmth the resubmission will actually find.
+                hit = self._peek_hit(dest, entry.template)
+            except (WorkerGone, TimeoutError, RuntimeError) as e:
+                telemetry.log_event("migrate_import_failed",
+                                    level="warning", error=str(e))
+        telemetry.log_event(
+            "request_migrated", level="warning",
+            request_id=entry.template.trace_id or str(rid),
+            source=h.replica, dest=dest.replica,
+            pages=len(digests), streamed=len(entry.tokens))
+        if not self._dispatch(entry, dest, hit):
+            self._retry_or_fail(entry, exclude=dest)
+
+    def _on_drained(self, h, client, obj) -> None:
+        """Graceful exit notice: the final stats/metrics dump IS the
+        restart carry (nothing is lost on a drain, unlike kill -9 where
+        the carry is the last periodic scrape)."""
+        if obj.get("metrics") and h.folded_incarnation != h.incarnation:
+            h.last_metrics = obj["metrics"]
+        if obj.get("stats"):
+            h.last_stats = obj["stats"]
+        if h.state == UP:
+            h.state = DRAINING
+        telemetry.log_event(
+            "worker_drained", level="info", replica=h.replica,
+            migrated_requests=obj.get("migrated_requests", 0))
+        # The process exits right after this event; the monitor's poll()
+        # flips it to RESTARTING and respawns. Any request the drain did
+        # NOT migrate (e.g. migration raced the export budget) fails
+        # over from the router's token record like a kill.
+
+    def _failover_worker(self, h: WorkerHandle) -> None:
+        """Resubmit every tracked request of a dead worker from the
+        router's own token record (recompute-resume on a survivor;
+        token-identical under greedy). Requests with no survivor fail
+        cleanly with "unavailable"."""
+        with self._lock:
+            victims = [e for e in self._tracked.values()
+                       if e.worker is h]
+            for e in victims:
+                e.generation += 1
+                # Detach (see _on_migrate): claims the one resubmission
+                # against a racing migrate-event handler.
+                e.worker = e.client = None
+                e.attempts += 1
+                self.retries_attempted += 1
+                self.failovers += 1
+        for entry in victims:
+            others = [w for w in self._routable() if w is not h]
+            if not others:
+                rid = entry.template.request_id
+                with self._lock:
+                    self._tracked.pop(rid, None)
+                ghost = entry.seq_local
+                ghost.done, ghost.finish_reason = True, "unavailable"
+                ghost.finish_time = time.perf_counter()
+                entry.on_finish(ghost)
+                continue
+            dest, hit, _ = self._pick(others, entry.template)
+            telemetry.log_event(
+                "request_failover", level="warning",
+                request_id=(entry.template.trace_id
+                            or str(entry.template.request_id)),
+                resubmitted=True, attempts=entry.attempts,
+                streamed=len(entry.tokens))
+            if not self._dispatch(entry, dest, hit):
+                self._retry_or_fail(entry, exclude=dest)
+
+    # ------------------------------------------------------------ chaos
+
+    def apply_chaos(self, body: dict) -> dict:
+        """POST /debug/chaos for the subprocess fleet: engine-level
+        knobs forward to workers over the chaos RPC; the process-level
+        verbs the in-process fleet can only simulate are REAL here —
+        ``{"replica": i, "kill": "kill9"}`` SIGKILLs the worker process
+        (supervisor restarts it; in-flight requests fail over from the
+        router's token record) and ``{"kill": "sigterm"}`` triggers the
+        graceful drain-and-migrate path."""
+        kill = body.get("kill")
+        if kill is not None:
+            if kill not in ("kill9", "sigkill", "sigterm", "drain"):
+                raise ValueError(
+                    f"unknown kill chaos {kill!r}: one of "
+                    "('kill9', 'sigterm')")
+            idx = int(body["replica"])
+            h = self.workers[idx]
+            if h.proc is None or h.proc.poll() is not None:
+                raise ValueError(f"worker {idx} has no live process")
+            sig = (signal.SIGKILL if kill in ("kill9", "sigkill")
+                   else signal.SIGTERM)
+            os.kill(h.pid, sig)
+            return {"replica": idx, "killed": kill, "pid": h.pid}
+        replica = body.get("replica")
+        targets = (self.workers if replica is None
+                   else [self.workers[int(replica)]])
+        fields = {k: body[k] for k in ("step_failure_rate",
+                                       "step_wedge_s", "page_pressure")
+                  if body.get(k) is not None}
+        out = []
+        for h in self.workers:
+            state = {"step_failure_rate": None, "step_wedge_s": None,
+                     "page_pressure": None}
+            if h.client is not None and h.client.alive:
+                try:
+                    state = h.client.rpc(
+                        "chaos", timeout=10.0,
+                        **(fields if h in targets else {}))
+                    state = {k: v for k, v in state.items()
+                             if k not in ("id", "ok")}
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    pass
+            out.append(state)
+        return {"replicas": out}
+
+    def drain_worker(self, replica: int,
+                     migrate: Optional[bool] = None) -> None:
+        """Programmatic graceful drain (benchmarks): same path as
+        SIGTERM, but selectable migration for the comparison arm."""
+        h = self.workers[replica]
+        if h.client is None:
+            raise ValueError(f"worker {replica} not running")
+        kw = {} if migrate is None else {"migrate": migrate}
+        h.client.rpc("drain", timeout=30.0, **kw)
+
+    # ---------------------------------------------------- observability
+
+    def embed_many(self, batch):
+        import numpy as np
+
+        routable = self._routable()
+        if not routable:
+            with self._lock:
+                self.requests_unavailable += 1
+            raise FleetUnavailable("no routable worker",
+                                   self.server_cfg.retry_after_s)
+        h, _, _ = self._pick(routable)
+        r = h.client.rpc("embed", timeout=600.0, batch=batch)
+        return np.asarray(r["embeddings"])
+
+    def supervision_counters(self) -> dict:
+        stats = [h.last_stats for h in self.workers if h.last_stats]
+        with self._lock:
+            return {
+                "retries_attempted": self.retries_attempted,
+                "retries_succeeded": self.retries_succeeded,
+                "failovers": self.failovers,
+                "requests_shed": self.requests_shed,
+                "requests_unavailable": self.requests_unavailable,
+                "route_prefix_hits": self.route_prefix_hits,
+                "route_cold": self.route_cold,
+                "preemptions": sum(d.get("preemptions", 0)
+                                   for d in stats),
+                "recompute_resumes": sum(d.get("recompute_resumes", 0)
+                                         for d in stats),
+                "states": [h.state for h in self.workers],
+                # Process-fleet extras (README "Process fleet").
+                "fleet": "subprocess",
+                "worker_restarts": sum(h.restarts for h in self.workers),
+                "migrations": self.migrations,
+                "migrated_pages": self.migrated_pages,
+                "migrated_bytes": self.migrated_bytes,
+                "resume_resubmits": self.resume_resubmits,
+                "resume_recomputed_tokens": self.resume_recomputed_tokens,
+                "resume_reused_tokens": self.resume_reused_tokens,
+                "swap_in_resumes": sum(d.get("swap_in_resumes", 0)
+                                       for d in stats),
+            }
+
+    def health_snapshot(self) -> dict:
+        replicas = []
+        for h in self.workers:
+            hz = dict(h.last_health) if h.state == UP else {}
+            if h.state == UP and h.client is not None:
+                try:
+                    hz = h.client.rpc("healthz", timeout=10.0)
+                    hz.pop("id", None), hz.pop("ok", None)
+                    h.last_health = hz
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    pass
+            d = {
+                "state": ("healthy" if h.state == UP else h.state),
+                "worker_state": h.state,
+                "pid": h.pid,
+                "uptime_s": (round(time.time() - h.started_unix, 3)
+                             if h.started_unix and h.state == UP
+                             else 0.0),
+                "restarts": h.restarts,
+                "incarnation": h.incarnation,
+                "routing": dict(self._route_stats[h.replica]),
+            }
+            for k in ("pool_pressure", "under_pressure", "preemptions",
+                      "load", "draining", "host_cache",
+                      "swap_in_resumes"):
+                if k in hz:
+                    d[k] = hz[k]
+            replicas.append(d)
+        routable = sum(1 for h in self.workers if h.routable)
+        if routable == 0:
+            status = "unavailable"
+        elif routable == len(self.workers):
+            status = "ok"
+        else:
+            status = "degraded"
+        return {
+            "status": status,
+            "fleet": "subprocess",
+            "routing": self.server_cfg.routing,
+            "replicas": replicas,
+            "supervision": self.supervision_counters(),
+        }
+
+    def stats_snapshot(self) -> dict:
+        per = []
+        for h in self.workers:
+            d = None
+            if h.state == UP and h.client is not None:
+                try:
+                    d = h.client.rpc("stats", timeout=30.0)["stats"]
+                    h.last_stats = d
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    d = None
+            if d is None:
+                d = dict(h.last_stats) if h.last_stats else None
+            if d is not None:
+                d["health"] = {"state": h.state, "pid": h.pid,
+                               "restarts": h.restarts}
+                per.append(d)
+        if not per:
+            return {"supervision": self.supervision_counters(),
+                    "dp": self.dp}
+        return aggregate_replica_stats(per,
+                                       self.supervision_counters())
+
+    def prometheus_text(self) -> str:
+        groups = []
+        for h in self.workers:
+            dump = None
+            if h.state == UP and h.client is not None:
+                try:
+                    dump = h.client.rpc("metrics",
+                                        timeout=30.0)["samples"]
+                    h.last_metrics = dump
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    dump = None
+            if dump is None:
+                # Dead/booting worker: keep its series rendering so
+                # nothing vanishes mid-restart — from the last live
+                # dump if the death hasn't been folded into the carry
+                # yet, else from the carry ALONE (rendering both would
+                # double-count the folded totals during the gap).
+                dump = (h.last_metrics
+                        if h.folded_incarnation != h.incarnation else [])
+            merged = telemetry.apply_carry(h.carry, dump)
+            groups.append(({"replica": str(h.replica)},
+                           telemetry.registry_from_dump(merged)))
+        groups.append(({}, self._fleet_registry))
+        return telemetry.render_prometheus(groups)
+
+    def recent_snapshot(self, n: int) -> List[dict]:
+        items: List[dict] = []
+        for h in self.workers:
+            if h.state != UP or h.client is None:
+                continue
+            try:
+                items.extend(h.client.rpc("recent", timeout=10.0,
+                                          n=n)["recent"])
+            except (WorkerGone, TimeoutError, RuntimeError):
+                pass
+        items.sort(key=lambda t: t.get("finished_unix", 0.0))
+        return items[-n:]

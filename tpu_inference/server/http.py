@@ -59,13 +59,17 @@ def _now_iso() -> str:
 
 def build_engine_group(cfg: FrameworkConfig, load_params=None,
                        draft_cfg=None, load_draft=None) -> "EngineGroup":
-    """Construct the dp replica engines for a FrameworkConfig.
+    """Construct the dp replica fleet for a FrameworkConfig.
 
-    dp=1: one engine over the whole (tp, sp) mesh. dp>1: replica-per-group
-    serving — each replica gets its own tp*sp-device submesh, KV pool and
-    scheduler thread (server/replicas.py). ``load_params``/``load_draft``
-    are callables (mesh | None) -> params so checkpoints stream into each
-    replica's own device layout.
+    ``cfg.server.fleet`` picks the backend (README "Process fleet"):
+    "in-process" builds dp engines in this process behind an EngineGroup
+    (dp=1: one engine over the whole (tp, sp) mesh; dp>1: each replica
+    its own tp*sp-device submesh, KV pool and scheduler thread);
+    "subprocess" returns a ProcessEngineGroup router that spawns one
+    engine-worker OS process per replica at start(). ``load_params``/
+    ``load_draft`` are callables (mesh | None) -> params so checkpoints
+    stream into each replica's own device layout (in-process only —
+    workers load their own checkpoints from cfg.checkpoint_path).
     """
     import jax
 
@@ -73,6 +77,17 @@ def build_engine_group(cfg: FrameworkConfig, load_params=None,
     from tpu_inference.parallel.mesh import build_mesh
     from tpu_inference.server.replicas import EngineGroup
 
+    if cfg.server.fleet == "subprocess":
+        if draft_cfg is not None:
+            raise ValueError(
+                "--fleet subprocess does not support draft-model "
+                "speculative decoding yet (the worker boots its own "
+                "params; use spec_mode='ngram' or the in-process fleet)")
+        from tpu_inference.server.fleet import ProcessEngineGroup
+        return ProcessEngineGroup(cfg)
+    if cfg.server.fleet != "in-process":
+        raise ValueError(f"unknown fleet backend {cfg.server.fleet!r}; "
+                         "one of ('in-process', 'subprocess')")
     pcfg = cfg.parallel
     if pcfg.dp <= 1:
         meshes = [build_mesh(pcfg) if pcfg.n_devices > 1 else None]
@@ -133,11 +148,18 @@ class InferenceServer:
             group = (EngineGroup([engine], cfg.server) if engine is not None
                      else build_engine_group(cfg))
         self.group = group
-        self.engine = group.engine            # primary replica (tests/bench)
         self.load_duration_ns = (load_duration_ns if load_duration_ns
                                  is not None else
                                  int((time.perf_counter() - t0) * 1e9))
         self._ids = itertools.count()
+
+    @property
+    def engine(self):
+        """Primary replica's engine facts (tests/bench and the model-
+        card routes). In-process: the engine object itself; subprocess
+        fleet: a read-only info proxy fetched from worker 0 (None until
+        the fleet has spawned — routes only run after startup)."""
+        return self.group.engine
 
     # ------------------------------------------------------------- app
 
@@ -165,16 +187,20 @@ class InferenceServer:
         if self.cfg.server.warmup:
             secs = self.group.warmup()
             print(f"engine warmup: compiled all graphs in {secs:.1f}s")
+        # start() before the boot prints: the subprocess fleet spawns
+        # its workers here, and the prints below read worker-0 facts.
+        self.group.start()
         scfg = self.cfg.server
         wd = (f"{scfg.step_watchdog_s:g}s" if scfg.step_watchdog_s > 0
               else "off")
         cap = scfg.admission_queue_depth or "off"
         host_pages = self.cfg.engine.host_cache_pages
-        ladder = self.engine.ladder
+        ladder = self.engine.ladder if self.engine is not None else (1,)
         if len(ladder) > 1:
             print(f"batch ladder: rungs={list(ladder)} "
                   f"(decode graph per rung; dispatch follows occupancy)")
-        print(f"supervision: dp={len(self.group.engines)} "
+        print(f"supervision: fleet={scfg.fleet} "
+              f"dp={len(self.group.engines)} "
               f"routing={scfg.routing} "
               f"hit_weight={scfg.route_hit_weight:g} "
               f"host_hit_weight={scfg.route_host_hit_weight:g} "
@@ -184,7 +210,6 @@ class InferenceServer:
               f"cooldown={scfg.quarantine_cooldown_s:g}s "
               f"failover_retries={scfg.failover_max_retries} "
               f"queue_cap={cap}")
-        self.group.start()
 
     async def _on_cleanup(self, app) -> None:
         self.group.stop(drain=False)
@@ -201,8 +226,10 @@ class InferenceServer:
         """Fleet health: per-replica state machine + shed/retry counters.
         200 while at least one replica is routable ("ok"/"degraded"),
         503 with Retry-After when the whole fleet is quarantined — load
-        balancers and the traffic generator back off on exactly this."""
-        snap = self.group.health_snapshot()
+        balancers and the traffic generator back off on exactly this.
+        Off the event loop: under --fleet subprocess this does worker
+        RPCs (in-process it is in-memory reads; to_thread is cheap)."""
+        snap = await asyncio.to_thread(self.group.health_snapshot)
         if snap["status"] == "unavailable":
             return web.json_response(
                 snap, status=503, headers=self._retry_after_headers(
@@ -354,10 +381,13 @@ class InferenceServer:
         standard collector, per-replica labels under dp>1); the legacy
         JSON snapshot is preserved under ``?format=json`` (which also
         carries the diffable "phases" histograms the bench scrapes)."""
+        # to_thread: the subprocess fleet scrapes each worker over RPC —
+        # a slow worker must stall this scrape, not the whole server.
         if request.query.get("format") == "json":
-            return web.json_response(self.group.stats_snapshot())
+            return web.json_response(
+                await asyncio.to_thread(self.group.stats_snapshot))
         return web.Response(
-            text=self.group.prometheus_text(),
+            text=await asyncio.to_thread(self.group.prometheus_text),
             headers={"Content-Type": telemetry.PROMETHEUS_CONTENT_TYPE})
 
     async def handle_debug_requests(self, request: web.Request
@@ -372,7 +402,8 @@ class InferenceServer:
                 content_type="application/json")
         if n <= 0:
             return web.json_response([])
-        return web.json_response(self.group.recent_snapshot(n))
+        return web.json_response(
+            await asyncio.to_thread(self.group.recent_snapshot, n))
 
     async def handle_profile(self, request: web.Request) -> web.Response:
         """Start/stop a jax.profiler trace (TensorBoard / Perfetto).
@@ -429,12 +460,16 @@ class InferenceServer:
                     content_type="application/json")
 
     async def handle_chaos(self, request: web.Request) -> web.Response:
-        """Arm/disarm engine-level fault injection at runtime:
-        ``POST {"replica": i | null, "step_failure_rate": p,
-        "step_wedge_s": s}`` — null replica applies to all. Returns the
-        per-replica settings now in effect. Debug-only (with
-        /debug/requests), so chaos cannot be armed on a production
-        endpoint that didn't opt in."""
+        """Arm/disarm fault injection at runtime: ``POST {"replica": i |
+        null, "step_failure_rate": p, "step_wedge_s": s,
+        "page_pressure": n}`` — null replica applies to all. The
+        subprocess fleet additionally takes ``{"replica": i, "kill":
+        "kill9" | "sigterm"}`` — the REAL out-of-process failure modes
+        (SIGKILL a worker mid-decode; SIGTERM = graceful drain with KV
+        migration) the in-process chaos_step_wedge_s only simulates.
+        Returns the per-replica settings now in effect. Debug-only
+        (with /debug/requests), so chaos cannot be armed on a
+        production endpoint that didn't opt in."""
         try:
             body = await request.json()
             assert isinstance(body, dict)
@@ -442,38 +477,15 @@ class InferenceServer:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "body must be a JSON object"}),
                 content_type="application/json")
-        engines = self.group.engines
-        replica = body.get("replica")
         try:
-            targets = (engines if replica is None
-                       else [engines[int(replica)]])
-            rate = body.get("step_failure_rate")
-            wedge = body.get("step_wedge_s")
-            pressure = body.get("page_pressure")
-            for eng in targets:
-                if rate is not None:
-                    eng.chaos_step_failure_rate = float(rate)
-                if wedge is not None:
-                    eng.chaos_step_wedge_s = float(wedge)
-                if pressure is not None:
-                    # Holds real pages out of the KV pool (clamped to
-                    # what's free) — deterministic exhaustion testing.
-                    # Applied by the engine loop (the allocator is
-                    # engine-thread only), usually within milliseconds.
-                    eng.request_page_pressure(int(pressure))
-        except (IndexError, TypeError, ValueError) as e:
+            # Both fleet backends implement apply_chaos; process-level
+            # kill verbs are a usage error on the in-process one.
+            result = await asyncio.to_thread(self.group.apply_chaos, body)
+        except (IndexError, TypeError, ValueError, KeyError) as e:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": f"invalid chaos spec: {e}"}),
                 content_type="application/json")
-
-        def _pp(e):
-            t = e._pressure_target
-            return e.chaos_page_pressure if t is None else t
-
-        return web.json_response({"replicas": [
-            {"step_failure_rate": e.chaos_step_failure_rate,
-             "step_wedge_s": e.chaos_step_wedge_s,
-             "page_pressure": _pp(e)} for e in engines]})
+        return web.json_response(result)
 
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         """Ollama ``/api/chat``: messages-based wrapper over the same
@@ -683,7 +695,14 @@ class InferenceServer:
             loop.call_soon_threadsafe(queue.put_nowait, ("finish", s))
 
         try:
-            self.group.submit(seq, on_token, on_finish)
+            # to_thread: under --fleet subprocess, submit does routing
+            # peeks + the submit RPC over worker sockets — blocking I/O
+            # that must not freeze the event loop (and so every other
+            # stream) behind one slow worker. In-process submit is
+            # thread-safe by design (callbacks already arrive from
+            # engine threads).
+            await asyncio.to_thread(self.group.submit, seq, on_token,
+                                    on_finish)
         except FleetSaturated as e:
             # Admission control: reject NOW with a backoff hint instead
             # of queueing until request_timeout_s.

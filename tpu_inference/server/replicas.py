@@ -4,10 +4,16 @@
 for the sharding dry-run, useless for throughput: one scheduler, one
 decode batch). True dp serving is replica-per-group — each replica owns a
 ``tp*sp``-device submesh, its own KV pool, and its own continuous-batching
-scheduler thread; the HTTP layer routes each request to the least-loaded
-replica. The reference's analogue is the load balancer in front of its
-external endpoint (implicit, out of repo — SURVEY.md §0); here it is
-in-process.
+scheduler. The reference's analogue is the load balancer in front of its
+external endpoint (implicit, out of repo — SURVEY.md §0); here it comes
+in TWO backends behind one facade (``ServerConfig.fleet``, README
+"Process fleet"): this module's ``EngineGroup`` runs every replica as a
+thread of the server process (simple, but one Python process, one GIL,
+one failure domain), while ``server/fleet.py``'s ``ProcessEngineGroup``
+runs each replica as its own engine-worker OS process behind a router,
+with supervised restarts, kill -9 failover, and drain-time KV page
+migration. The routing/failover/admission semantics below are the
+contract both backends implement.
 
 Supervision (README "Failure handling & degraded operation"): each
 replica carries a health state machine
@@ -744,93 +750,144 @@ class EngineGroup:
         items.sort(key=lambda t: t.get("finished_unix", 0.0))
         return items[-n:]
 
-    # Per-chip gauges / config constants that must not be summed across
-    # replicas. KV page counts SUM (total and in_use together, so fleet
-    # utilization = in_use/total stays consistent); depth is config.
-    _NON_ADDITIVE = ("model_params", "approx_flops_per_token",
-                     "mean_batch_occupancy", "decode_pipeline_depth",
-                     "pool_pressure",
-                     # Batch ladder: rung/occupancy are per-replica
-                     # states (summing rungs would fabricate a fleet
-                     # batch size); re-aggregated below. rung_switches
-                     # stays additive (a fleet churn total).
-                     "decode_rung", "rung_peak", "lane_occupancy",
-                     "mfu_estimate")
-
     def stats_snapshot(self) -> dict:
         """Aggregate counters + per-replica breakdown."""
         per = [s.stats.snapshot(s.engine) for s in self.schedulers]
         for d, h in zip(per, self.health):
             d["health"] = h.snapshot()
-        if len(per) == 1:
-            out = dict(per[0])
-            out["supervision"] = self.supervision_counters()
-            return out
-        agg = dict(per[0])
-        for d in per[1:]:
-            for k, v in d.items():
-                if (k in self._NON_ADDITIVE or isinstance(v, bool)
-                        or not isinstance(v, (int, float))):
-                    continue
-                agg[k] = agg.get(k, 0) + v
-        # Replica 0's health dict would masquerade as the fleet's;
-        # per-replica health lives under "replicas", fleet under
-        # "supervision".
-        agg.pop("health", None)
-        # Fleet phase histograms = element-wise bucket merge across
-        # replicas (replica 0's copy would otherwise masquerade as the
-        # fleet's); per-replica views stay under "replicas".
-        phase_keys = sorted(set().union(
-            *(d.get("phases", {}).keys() for d in per)))
-        agg["phases"] = {
-            k: telemetry.merge_phases(
-                [d.get("phases", {}).get(k) for d in per])
-            for k in phase_keys}
-        agg["mean_batch_occupancy"] = (
-            sum(d["mean_batch_occupancy"] for d in per) / len(per))
-        # Batch ladder fleet view: active/peak rung = the highest any
-        # replica runs (replica 0's copy must not masquerade as the
-        # fleet's); occupancy/MFU = fleet means; decode_ladder is the
-        # one shared EngineConfig's rungs, identical on every replica.
-        # Replica detail stays under "replicas".
-        agg["decode_rung"] = max(d["decode_rung"] for d in per)
-        agg["rung_peak"] = max(d["rung_peak"] for d in per)
-        agg["lane_occupancy"] = round(
-            sum(d["lane_occupancy"] for d in per) / len(per), 4)
-        mfus = [d["mfu_estimate"] for d in per
-                if d.get("mfu_estimate") is not None]
-        agg["mfu_estimate"] = (round(sum(mfus) / len(mfus), 6)
-                               if mfus else None)
-        if "prefix_cache" in per[0]:
-            agg["prefix_cache"] = {
-                k: sum(d["prefix_cache"][k] for d in per)
-                for k in per[0]["prefix_cache"]}
-        # Fleet decode-dispatch latency = element-wise worst replica (an
-        # operator alarms on p99; replica 0's copy masquerading as the
-        # fleet number would hide a degraded replica).
-        rings = [d.get("decode_call_s") for d in per]
-        rings = [r for r in rings if r]
-        agg["decode_call_s"] = (
-            {k: max(r[k] for r in rings) for k in rings[0]} if rings
-            else None)
-        if "speculative" in per[0]:
-            drafted = sum(d["speculative"]["drafted"] for d in per)
-            accepted = sum(d["speculative"]["accepted"] for d in per)
-            agg["speculative"] = {
-                # Mode/γ are one shared EngineConfig, identical on every
-                # replica; counters sum across the fleet.
-                "mode": per[0]["speculative"].get("mode"),
-                "gamma": per[0]["speculative"].get("gamma"),
-                "drafted": drafted, "accepted": accepted,
-                "acceptance_rate": (accepted / drafted) if drafted else 0.0,
-                "rounds": sum(d["speculative"].get("rounds", 0)
-                              for d in per),
-                "fallback_rounds": sum(
-                    d["speculative"].get("fallback_rounds", 0)
-                    for d in per),
-                "throttles": sum(d["speculative"].get("throttles", 0)
-                                 for d in per)}
-        agg["replicas"] = per
-        agg["dp"] = len(per)
-        agg["supervision"] = self.supervision_counters()
-        return agg
+        return aggregate_replica_stats(per, self.supervision_counters())
+
+    def apply_chaos(self, body: dict) -> dict:
+        """Arm/disarm engine-level fault injection (POST /debug/chaos):
+        ``{"replica": i | null, "step_failure_rate": p, "step_wedge_s":
+        s, "page_pressure": n}`` — null replica applies to all. The
+        subprocess fleet adds process-level verbs ("kill"); here they
+        are a usage error (there is no process to kill in-process —
+        chaos_step_wedge_s is the in-process simulation). Raises
+        ValueError/IndexError/TypeError on bad specs (HTTP 400)."""
+        if body.get("kill") is not None:
+            raise ValueError(
+                "'kill' chaos (kill9/sigterm) needs --fleet subprocess; "
+                "the in-process fleet simulates faults via "
+                "step_failure_rate / step_wedge_s / page_pressure")
+        engines = self.engines
+        replica = body.get("replica")
+        targets = (engines if replica is None
+                   else [engines[int(replica)]])
+        rate = body.get("step_failure_rate")
+        wedge = body.get("step_wedge_s")
+        pressure = body.get("page_pressure")
+        for eng in targets:
+            if rate is not None:
+                eng.chaos_step_failure_rate = float(rate)
+            if wedge is not None:
+                eng.chaos_step_wedge_s = float(wedge)
+            if pressure is not None:
+                # Holds real pages out of the KV pool (clamped to
+                # what's free) — deterministic exhaustion testing.
+                # Applied by the engine loop (the allocator is
+                # engine-thread only), usually within milliseconds.
+                eng.request_page_pressure(int(pressure))
+
+        def _pp(e):
+            t = e._pressure_target
+            return e.chaos_page_pressure if t is None else t
+
+        return {"replicas": [
+            {"step_failure_rate": e.chaos_step_failure_rate,
+             "step_wedge_s": e.chaos_step_wedge_s,
+             "page_pressure": _pp(e)} for e in engines]}
+
+
+# Per-chip gauges / config constants that must not be summed across
+# replicas. KV page counts SUM (total and in_use together, so fleet
+# utilization = in_use/total stays consistent); depth is config.
+_NON_ADDITIVE = ("model_params", "approx_flops_per_token",
+                 "mean_batch_occupancy", "decode_pipeline_depth",
+                 "pool_pressure",
+                 # Batch ladder: rung/occupancy are per-replica
+                 # states (summing rungs would fabricate a fleet
+                 # batch size); re-aggregated below. rung_switches
+                 # stays additive (a fleet churn total).
+                 "decode_rung", "rung_peak", "lane_occupancy",
+                 "mfu_estimate")
+
+
+def aggregate_replica_stats(per: List[dict], supervision: dict) -> dict:
+    """Fold per-replica scheduler snapshots into the fleet stats dict —
+    THE aggregation rule, shared by both fleet backends (EngineGroup
+    over live scheduler objects; ProcessEngineGroup over stats dicts
+    fetched from worker processes), so /metrics?format=json has one
+    shape regardless of --fleet."""
+    if len(per) == 1:
+        out = dict(per[0])
+        out["supervision"] = supervision
+        return out
+    agg = dict(per[0])
+    for d in per[1:]:
+        for k, v in d.items():
+            if (k in _NON_ADDITIVE or isinstance(v, bool)
+                    or not isinstance(v, (int, float))):
+                continue
+            base = agg.get(k, 0)
+            agg[k] = (base if isinstance(base, (int, float))
+                      and not isinstance(base, bool) else 0) + v
+    # Replica 0's health dict would masquerade as the fleet's;
+    # per-replica health lives under "replicas", fleet under
+    # "supervision".
+    agg.pop("health", None)
+    # Fleet phase histograms = element-wise bucket merge across
+    # replicas (replica 0's copy would otherwise masquerade as the
+    # fleet's); per-replica views stay under "replicas".
+    phase_keys = sorted(set().union(
+        *(d.get("phases", {}).keys() for d in per)))
+    agg["phases"] = {
+        k: telemetry.merge_phases(
+            [d.get("phases", {}).get(k) for d in per])
+        for k in phase_keys}
+    agg["mean_batch_occupancy"] = (
+        sum(d.get("mean_batch_occupancy", 0.0) for d in per) / len(per))
+    # Batch ladder fleet view: active/peak rung = the highest any
+    # replica runs (replica 0's copy must not masquerade as the
+    # fleet's); occupancy/MFU = fleet means; decode_ladder is the
+    # one shared EngineConfig's rungs, identical on every replica.
+    # Replica detail stays under "replicas".
+    agg["decode_rung"] = max(d.get("decode_rung", 0) for d in per)
+    agg["rung_peak"] = max(d.get("rung_peak", 0) for d in per)
+    agg["lane_occupancy"] = round(
+        sum(d.get("lane_occupancy", 0.0) for d in per) / len(per), 4)
+    mfus = [d["mfu_estimate"] for d in per
+            if d.get("mfu_estimate") is not None]
+    agg["mfu_estimate"] = (round(sum(mfus) / len(mfus), 6)
+                           if mfus else None)
+    if "prefix_cache" in per[0]:
+        agg["prefix_cache"] = {
+            k: sum(d.get("prefix_cache", {}).get(k, 0) for d in per)
+            for k in per[0]["prefix_cache"]}
+    # Fleet decode-dispatch latency = element-wise worst replica (an
+    # operator alarms on p99; replica 0's copy masquerading as the
+    # fleet number would hide a degraded replica).
+    rings = [d.get("decode_call_s") for d in per]
+    rings = [r for r in rings if r]
+    agg["decode_call_s"] = (
+        {k: max(r[k] for r in rings if k in r) for k in rings[0]}
+        if rings else None)
+    if "speculative" in per[0]:
+        specs = [d.get("speculative") or {} for d in per]
+        drafted = sum(s.get("drafted", 0) for s in specs)
+        accepted = sum(s.get("accepted", 0) for s in specs)
+        agg["speculative"] = {
+            # Mode/γ are one shared EngineConfig, identical on every
+            # replica; counters sum across the fleet.
+            "mode": specs[0].get("mode"),
+            "gamma": specs[0].get("gamma"),
+            "drafted": drafted, "accepted": accepted,
+            "acceptance_rate": (accepted / drafted) if drafted else 0.0,
+            "rounds": sum(s.get("rounds", 0) for s in specs),
+            "fallback_rounds": sum(s.get("fallback_rounds", 0)
+                                   for s in specs),
+            "throttles": sum(s.get("throttles", 0) for s in specs)}
+    agg["replicas"] = per
+    agg["dp"] = len(per)
+    agg["supervision"] = supervision
+    return agg

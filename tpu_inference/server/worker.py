@@ -1,0 +1,633 @@
+"""Engine-worker process: one engine + scheduler per OS process.
+
+One half of the subprocess fleet (README "Process fleet"; the other half
+is ``server/fleet.py``'s router). The worker owns exactly one dp replica
+— its own devices, KV pool, prefix cache/host tier, and continuous-
+batching scheduler thread — and serves a small length-prefixed JSON RPC
+over a local unix socket:
+
+    frame   = [u32 json_len][u32 blob_len][json][blob]
+    request = {"id": n, "verb": ..., ...}        -> {"id": n, "ok": ...}
+    event   = {"ev": "token" | "finish" | "migrate" | "drained", ...}
+
+Verbs: ``hello`` (worker/model facts), ``submit`` / ``cancel`` (request
+lifecycle; tokens and the terminal record stream back as events on the
+same connection, unbuffered), ``peek`` (side-effect-free tiered prefix
+probe + load/pressure — the router's prefix-affinity scoring input),
+``stats`` / ``metrics`` / ``healthz`` / ``recent`` (observability),
+``chaos`` (engine-level fault injection), ``embed``, ``drain``
+(graceful wind-down with KV export), ``import-kv`` (adopt a sibling
+replica's drain export into the host tier), ``shutdown``, and ``debug``
+(pool-invariant snapshot for the leak tests).
+
+Graceful drain (SIGTERM or the drain RPC): the worker stops admitting,
+settles in-flight dispatches, and — with migration enabled — exports
+each live sequence's KV pages in the host serialization layout
+(engine.export_sequence_kv) as one ``migrate`` event per request, so
+the router can import them into a destination worker's host tier and
+resubmission becomes a swap-in-resume instead of a from-scratch
+re-prefill. ``kill -9`` skips all of this by definition; the router's
+resubmission failover (fleet-side token record, recompute-resume)
+covers it.
+
+The module top imports only the stdlib so the router can import the
+frame codec without paying for jax; everything heavy loads inside
+``EngineWorker.boot``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import signal
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, Dict, Tuple
+
+# ---------------------------------------------------------------------------
+# Frame codec (shared with server/fleet.py)
+# ---------------------------------------------------------------------------
+
+# A frame larger than this is a protocol error, not a workload: the
+# biggest legitimate payload is a drain export of one sequence's pages
+# (max_pages_per_seq * page bytes, well under this).
+MAX_FRAME = 1 << 31
+
+
+def send_frame(sock: socket.socket, obj: Dict[str, Any],
+               blob: bytes = b"") -> None:
+    payload = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">II", len(payload), len(blob))
+                 + payload + blob)
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    data = rfile.read(n)
+    if data is None or len(data) < n:
+        raise ConnectionError("peer closed mid-frame")
+    return data
+
+
+def recv_frame(rfile) -> Tuple[Dict[str, Any], bytes]:
+    """Read one frame from a buffered reader (``sock.makefile('rb')``).
+    Raises ConnectionError at EOF."""
+    hdr = rfile.read(8)
+    if not hdr:
+        raise ConnectionError("peer closed")
+    if len(hdr) < 8:
+        raise ConnectionError("peer closed mid-header")
+    jlen, blen = struct.unpack(">II", hdr)
+    if jlen > MAX_FRAME or blen > MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({jlen}+{blen} bytes)")
+    obj = json.loads(_read_exact(rfile, jlen).decode())
+    blob = _read_exact(rfile, blen) if blen else b""
+    return obj, blob
+
+
+class _Conn:
+    """One router connection: a reader thread dispatching verbs and a
+    writer thread draining an outbound queue, so engine-thread callbacks
+    (token/finish events) never block on socket I/O."""
+
+    def __init__(self, worker: "EngineWorker", sock: socket.socket):
+        self.worker = worker
+        self.sock = sock
+        self.rfile = sock.makefile("rb")
+        self.outq: "queue.Queue" = queue.Queue()
+        self.alive = True
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name="worker-conn-writer",
+                                        daemon=True)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="worker-conn-reader",
+                                        daemon=True)
+        self._writer.start()
+        self._reader.start()
+
+    def send(self, obj: Dict[str, Any], blob: bytes = b"") -> None:
+        if self.alive:
+            self.outq.put((obj, blob))
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Wait for every ALREADY-queued frame to finish its sendall
+        (drain exit path: the migrate/drained events must leave before
+        the process does). A sentinel rides the queue — the writer sets
+        it only after the preceding frames' writes completed, so this
+        cannot race a frame mid-write like an emptiness poll would."""
+        evt = threading.Event()
+        self.outq.put(("__flush__", evt))
+        evt.wait(timeout)
+
+    def close(self) -> None:
+        self.alive = False
+        self.outq.put(None)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _write_loop(self) -> None:
+        while True:
+            item = self.outq.get()
+            if item is None:
+                return
+            if item[0] == "__flush__":
+                item[1].set()
+                continue
+            try:
+                send_frame(self.sock, item[0], item[1])
+            except OSError:
+                self.alive = False
+                return
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                obj, blob = recv_frame(self.rfile)
+                self.worker.handle(self, obj, blob)
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            self.alive = False
+            self.worker.forget_conn(self)
+
+
+class EngineWorker:
+    """One replica's engine + scheduler behind the RPC socket."""
+
+    def __init__(self, cfg, replica: int, socket_path: str,
+                 warmup: bool = True):
+        self.cfg = cfg
+        self.replica = replica
+        self.socket_path = socket_path
+        self.do_warmup = warmup
+        self.warmup_s = 0.0
+        self.started_unix = time.time()
+        # Orphan guard: a worker whose router died (kill -9 of the
+        # ROUTER, bench shortcut teardown) must not linger as an idle
+        # orphan — reparenting to init is the tell.
+        self._parent_pid = os.getppid()
+        self.engine = None
+        self.sched = None
+        self.draining = False
+        self._drained_evt = threading.Event()
+        self._shutdown = threading.Event()
+        self._conns: list = []
+        self._conns_lock = threading.Lock()
+        # rid -> the connection that submitted it (migrate events go
+        # back to the submitting router connection).
+        self._req_conn: Dict[int, _Conn] = {}
+
+    # ------------------------------------------------------------- boot
+
+    def boot(self) -> None:
+        from tpu_inference.engine.engine import InferenceEngine
+        from tpu_inference.engine.scheduler import EngineScheduler
+
+        cfg = self.cfg
+        pcfg = cfg.parallel
+        mesh = None
+        if pcfg.tp * pcfg.sp > 1:
+            from tpu_inference.config import ParallelConfig
+            from tpu_inference.parallel.mesh import build_mesh
+            mesh = build_mesh(ParallelConfig(tp=pcfg.tp, sp=pcfg.sp))
+        params = None
+        if cfg.checkpoint_path:
+            from tpu_inference.models import weights
+            shardings = None
+            if mesh is not None:
+                from tpu_inference.parallel import shardings as shd
+                shardings = shd.param_shardings(cfg.model, mesh)
+            params = weights.load_checkpoint(
+                cfg.model, cfg.checkpoint_path, shardings=shardings,
+                quant=cfg.engine.quant)
+        self.engine = InferenceEngine(cfg.model, cfg.engine, params=params,
+                                      seed=cfg.seed, mesh=mesh)
+        self.sched = EngineScheduler(self.engine)
+        if self.do_warmup:
+            self.warmup_s = self.engine.warmup()
+        self.sched.start()
+
+    # ------------------------------------------------------------ serve
+
+    def serve(self) -> None:
+        """Bind/listen FIRST (so the router's connect succeeds while the
+        engine still boots — its hello RPC simply waits), then boot, then
+        accept until shutdown."""
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.socket_path)
+        srv.listen(4)
+        srv.settimeout(0.25)
+        self.boot()
+        print(f"[worker {self.replica}] pid={os.getpid()} serving on "
+              f"{self.socket_path}", file=sys.stderr, flush=True)
+        while not self._shutdown.is_set():
+            if os.getppid() != self._parent_pid:
+                print(f"[worker {self.replica}] router gone (reparented)"
+                      " — exiting", file=sys.stderr, flush=True)
+                break
+            try:
+                sock, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with self._conns_lock:
+                self._conns.append(_Conn(self, sock))
+        try:
+            srv.close()
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def forget_conn(self, conn: _Conn) -> None:
+        with self._conns_lock:
+            if conn in self._conns:
+                self._conns.remove(conn)
+
+    def _broadcast(self, obj: Dict[str, Any], blob: bytes = b"") -> None:
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.send(obj, blob)
+
+    # --------------------------------------------------------- dispatch
+
+    # Verbs that can block for seconds (device forwards, engine-loop
+    # waits, scheduler drains) run on their own thread so the reader
+    # stays responsive — the router's routing peeks must never stall
+    # behind a migration import or an embed batch on the same worker.
+    _SLOW_VERBS = ("import_kv", "embed", "shutdown")
+
+    def handle(self, conn: _Conn, obj: Dict[str, Any],
+               blob: bytes) -> None:
+        rid = obj.get("id")
+        verb = str(obj.get("verb")).replace("-", "_")
+
+        def run() -> None:
+            try:
+                fn = getattr(self, "_verb_" + verb, None)
+                if fn is None:
+                    raise ValueError(f"unknown verb {obj.get('verb')!r}")
+                reply = fn(conn, obj, blob)
+                if reply is not None:
+                    out = {"id": rid, "ok": True}
+                    out.update(reply)
+                    conn.send(out)
+            except Exception as e:  # noqa: BLE001 — RPC errors reply
+                conn.send({"id": rid, "ok": False, "error": str(e),
+                           "kind": type(e).__name__})
+
+        if verb in self._SLOW_VERBS:
+            threading.Thread(target=run, name=f"worker-{verb}",
+                             daemon=True).start()
+        else:
+            run()
+
+    # ------------------------------------------------------------ verbs
+
+    def _verb_hello(self, conn, obj, blob) -> dict:
+        e = self.engine
+        return {
+            "pid": os.getpid(),
+            "replica": self.replica,
+            "uptime_s": round(time.time() - self.started_unix, 3),
+            "warmup_s": round(self.warmup_s, 3),
+            "n_params": e.n_params,
+            "weight_bytes": e.weight_bytes,
+            "attn_backend": e.attn_backend,
+            "ladder": list(e.ladder),
+            "swa_evict": e.swa_evict,
+            "prefix_cache": e.prefix_cache is not None,
+            "host_cache_pages": (e.host_pool.capacity
+                                 if e.host_pool is not None else 0),
+            "spec_draft": bool(getattr(e, "spec_draft", False)),
+            "spec_mode": e.spec_mode if e.spec_enabled else None,
+        }
+
+    def _verb_submit(self, conn, obj, blob) -> dict:
+        if self.draining:
+            return {"ok": False, "kind": "draining",
+                    "error": "worker draining"}
+        from tpu_inference.engine.engine import Sequence
+        s = obj["seq"]
+        seq = Sequence(
+            request_id=int(s["request_id"]),
+            prompt_tokens=list(s["prompt_tokens"]),
+            max_new_tokens=int(s["max_new_tokens"]),
+            temperature=float(s.get("temperature", 0.0)),
+            top_p=float(s.get("top_p", 1.0)),
+            top_k=s.get("top_k"),
+            seed=s.get("seed"),
+            repeat_penalty=float(s.get("repeat_penalty", 1.0)),
+            repeat_last_n=int(s.get("repeat_last_n", 64)),
+            eos_token_id=s.get("eos_token_id"),
+            trace_id=s.get("trace_id", ""),
+            attempt=int(s.get("attempt", 0)))
+        generated = s.get("generated") or []
+        if generated:
+            # Fleet-side recompute-resume (README "Process fleet"): the
+            # router replays the tokens it already streamed; prefill
+            # covers prompt + generated (host-tier hits from a drain
+            # import make it a swap-in-resume) and decode continues.
+            seq.generated = list(generated)
+            seq.resume_base = len(generated)
+        rid = seq.request_id
+        self._req_conn[rid] = conn
+
+        def on_token(sq, tok: int) -> None:
+            conn.send({"ev": "token", "rid": rid, "t": int(tok)})
+
+        def on_finish(sq) -> None:
+            self._req_conn.pop(rid, None)
+            fin = sq.finish_time or time.perf_counter()
+            first = sq.first_token_time or fin
+            start = sq.prefill_start or first
+            conn.send({
+                "ev": "finish", "rid": rid,
+                "reason": sq.finish_reason or "stop",
+                "n_generated": len(sq.generated),
+                "cached_tokens": sq.cached_tokens,
+                "host_restored_pages": sq.host_restored_pages,
+                "preemptions": sq.preemptions,
+                "resume_base": sq.resume_base,
+                "prefill_s": round(max(0.0, first - start), 6),
+                "decode_s": round(max(0.0, fin - first), 6),
+            })
+
+        self.sched.submit(seq, on_token, on_finish)
+        return {"rid": rid}
+
+    def _verb_cancel(self, conn, obj, blob) -> dict:
+        self.sched.cancel(int(obj["rid"]))
+        self._req_conn.pop(int(obj["rid"]), None)
+        return {}
+
+    def _verb_peek(self, conn, obj, blob) -> dict:
+        """Router scoring probe: tiered prefix peek + load/pressure.
+        Side-effect-free on the cache (PrefixCache.peek contract), safe
+        from this RPC thread."""
+        digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
+        hbm = host = 0
+        pc = self.engine.prefix_cache
+        if pc is not None and digests:
+            hbm, host = pc.peek_digests_tiered(digests)
+        return {"hbm": hbm, "host": host, "load": self.sched.load,
+                "pressure": bool(self.engine.under_pressure)}
+
+    def _verb_stats(self, conn, obj, blob) -> dict:
+        return {"stats": self.sched.stats.snapshot(self.engine)}
+
+    def _verb_metrics(self, conn, obj, blob) -> dict:
+        from tpu_inference import telemetry
+        return {"samples": telemetry.dump_registry(
+            self.engine.telemetry.registry)}
+
+    def _verb_healthz(self, conn, obj, blob) -> dict:
+        e = self.engine
+        out = {
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self.started_unix, 3),
+            "draining": self.draining,
+            "load": self.sched.load,
+            "pool_pressure": round(e.pool_pressure, 4),
+            "under_pressure": e.under_pressure,
+            "preemptions": e.preemptions_total,
+            "swap_in_resumes": e.swap_in_resumes,
+        }
+        if e.host_pool is not None:
+            out["host_cache"] = {
+                "capacity_pages": e.host_pool.capacity,
+                "pages_used": e.host_pool.used,
+                "offloaded": e.host_pool.offloaded_total,
+                "restored": e.host_pool.restored_total,
+                "imported": e.host_pool.imported_total,
+                "evicted": e.host_pool.evicted_total,
+                "swap_in_resumes": e.swap_in_resumes,
+            }
+        return out
+
+    def _verb_recent(self, conn, obj, blob) -> dict:
+        return {"recent": self.sched.recent_snapshot(
+            int(obj.get("n", 50)))}
+
+    def _verb_chaos(self, conn, obj, blob) -> dict:
+        e = self.engine
+        rate = obj.get("step_failure_rate")
+        wedge = obj.get("step_wedge_s")
+        pressure = obj.get("page_pressure")
+        if rate is not None:
+            e.chaos_step_failure_rate = float(rate)
+        if wedge is not None:
+            e.chaos_step_wedge_s = float(wedge)
+        if pressure is not None:
+            e.request_page_pressure(int(pressure))
+        t = e._pressure_target
+        return {"step_failure_rate": e.chaos_step_failure_rate,
+                "step_wedge_s": e.chaos_step_wedge_s,
+                "page_pressure": (e.chaos_page_pressure if t is None
+                                  else t)}
+
+    def _verb_embed(self, conn, obj, blob) -> dict:
+        vecs = self.engine.embed_many([list(b) for b in obj["batch"]])
+        return {"embeddings": vecs.tolist()}
+
+    def _verb_import_kv(self, conn, obj, blob) -> dict:
+        """Adopt a sibling replica's drain export into the host tier.
+        Replies only after the engine loop APPLIED the import, so the
+        router's subsequent resubmit is guaranteed to see the pages."""
+        from tpu_inference.engine import kv_cache as kvc
+        digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
+        pages = kvc.deserialize_host_pages(blob) if blob else []
+        n = min(len(digests), len(pages))
+        before = self.engine.migrate_in_pages
+        done = self.engine.request_import_host(
+            list(zip(digests[:n], pages[:n])))
+        self.sched.kick()
+        applied = done.wait(timeout=10.0)
+        return {"offered": n, "applied": bool(applied),
+                "adopted": self.engine.migrate_in_pages - before}
+
+    def _verb_drain(self, conn, obj, blob) -> dict:
+        migrate = obj.get("migrate")
+        if migrate is None:
+            migrate = self.cfg.server.fleet_migrate
+        threading.Thread(target=self.drain, args=(bool(migrate),),
+                         name="worker-drain", daemon=True).start()
+        return {"draining": True}
+
+    def _verb_debug(self, conn, obj, blob) -> dict:
+        """Pool-invariant snapshot for the cross-process leak tests
+        (tests/_leak.py's checks, worker-side): optionally clears the
+        prefix cache first so 'fully reclaimable' is checkable. Only
+        meaningful when the worker is idle."""
+        e = self.engine
+        cache = e.prefix_cache
+        out = {"pipeline_pending": bool(e.pipeline_pending),
+               "preempted_uncollected": len(e._preempted_out)}
+        if cache is not None and cache.host_pool is not None:
+            pool = cache.host_pool
+            out["host_used_matches_entries"] = (
+                pool.used == len(cache._host))
+            out["host_bytes_match"] = (pool.bytes_resident == sum(
+                en.nbytes for en in cache._host.values()))
+            out["host_within_capacity"] = (
+                0 <= pool.used <= pool.capacity)
+            out["tier_overlap"] = len(set(cache._host)
+                                      & set(cache._table))
+        if obj.get("clear"):
+            e.set_page_pressure(0)
+            if cache is not None:
+                cache.clear()
+        alloc = e.allocator
+        out.update({
+            "num_free": alloc.num_free,
+            "num_pages": alloc.num_pages,
+            "refs_held": sum(1 for p in range(1, alloc.num_pages)
+                             if alloc._refs[p] > 0),
+            "evictable_count": alloc.evictable_count,
+            "slots_bound": sum(s is not None for s in e.slots),
+            "host_used": (cache.host_pool.used
+                          if cache is not None
+                          and cache.host_pool is not None else 0),
+        })
+        return out
+
+    def _verb_shutdown(self, conn, obj, blob) -> dict:
+        drain = bool(obj.get("drain", True))
+        timeout = float(obj.get("timeout_s", 30.0))
+        self.draining = True
+        self.sched.stop(drain=drain, timeout=timeout)
+        self._shutdown.set()
+        return {"stopped": True}
+
+    # ------------------------------------------------------------ drain
+
+    def drain(self, migrate: bool) -> None:
+        """Graceful wind-down (SIGTERM / drain RPC): freeze the
+        scheduler, settle in-flight device work (delivering its tokens),
+        export every live request — KV pages included when migration is
+        on — as ``migrate`` events, then broadcast ``drained`` (with the
+        final stats + metrics dump, the router's restart carry) and
+        exit."""
+        if self.draining:
+            return
+        self.draining = True
+        from tpu_inference import telemetry
+        from tpu_inference.engine import kv_cache as kvc
+        t0 = time.monotonic()
+        budget = max(1.0, self.cfg.server.drain_timeout_s)
+        engine, sched = self.engine, self.sched
+        telemetry.log_event("worker_drain", level="warning",
+                            replica=self.replica, migrate=migrate,
+                            load=sched.load)
+        sched.stop(drain=False, timeout=budget)
+        try:
+            if engine.pipeline_pending:
+                sched._deliver(engine.drain_pipeline())
+        except Exception:  # noqa: BLE001 — a dying dispatch mustn't block exit
+            engine.abort_pipeline()
+        engine.take_preempted()
+        with sched._lock:
+            pendings = (list(sched._callbacks.values())
+                        + list(sched._waiting))
+        migrated = 0
+        for pending in pendings:
+            seq = pending.seq
+            if seq.done:
+                continue
+            digests, host_pages = [], []
+            if (migrate and seq.pages
+                    and time.monotonic() - t0 < budget):
+                try:
+                    digests, host_pages = engine.export_sequence_kv(seq)
+                except Exception:  # noqa: BLE001
+                    digests, host_pages = [], []
+            ev = {"ev": "migrate", "rid": seq.request_id,
+                  "n_generated": len(seq.generated),
+                  "digests": [d.hex() for d in digests]}
+            blob = (kvc.serialize_host_pages(host_pages)
+                    if host_pages else b"")
+            target = self._req_conn.get(seq.request_id)
+            if target is not None and target.alive:
+                target.send(ev, blob)
+                migrated += 1
+        self._broadcast({
+            "ev": "drained", "replica": self.replica,
+            "migrated_requests": migrated,
+            "stats": sched.stats.snapshot(engine),
+            "metrics": telemetry.dump_registry(
+                engine.telemetry.registry),
+        })
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.flush(timeout=max(1.0, budget - (time.monotonic() - t0)))
+        self._drained_evt.set()
+        self._shutdown.set()
+        # The accept loop may sit in a 250 ms timeout; exiting here is
+        # the point of a drain — everything worth saving already left.
+        os._exit(0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="tpu_inference engine-worker process (one dp "
+                    "replica behind the fleet router; README 'Process "
+                    "fleet'). Reads a JSON config envelope from stdin.")
+    ap.add_argument("--socket", required=True,
+                    help="unix socket path to serve the RPC on")
+    ap.add_argument("--replica", type=int, default=0)
+    ap.add_argument("--config", default=None,
+                    help="config envelope path (default: stdin)")
+    args = ap.parse_args()
+
+    if args.config:
+        with open(args.config) as f:
+            envelope = json.load(f)
+    else:
+        envelope = json.load(sys.stdin)
+
+    # Platform override BEFORE any computation: this image's
+    # sitecustomize points a fresh interpreter at the TPU tunnel, so the
+    # router ships its own resolved backend and the worker pins it via
+    # jax.config (the conftest/__main__ pattern — env vars are too late).
+    import jax
+
+    platform = envelope.get("platform")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            from tpu_inference.compat import set_cpu_device_count
+            set_cpu_device_count(max(1, int(envelope.get("cpu_devices",
+                                                         1))))
+
+    from tpu_inference.config import framework_config_from_dict
+
+    cfg = framework_config_from_dict(envelope["config"])
+    worker = EngineWorker(cfg, replica=args.replica,
+                          socket_path=args.socket,
+                          warmup=bool(envelope.get("warmup", True)))
+
+    def _sigterm(signum, frame):
+        # Signal-handler context: just flag; the drain thread does the
+        # blocking work (device sync + socket writes).
+        threading.Thread(target=worker.drain,
+                         args=(worker.cfg.server.fleet_migrate,),
+                         name="worker-drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    worker.serve()
+
+
+if __name__ == "__main__":
+    main()

@@ -429,6 +429,115 @@ def render_prometheus(groups: Iterable[Tuple[Mapping[str, str], Registry]]
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
+# ---------------------------------------------------------------------------
+# Registry transport (subprocess fleet, README "Process fleet"): an
+# engine-worker process dumps its registry as JSON-able samples over the
+# RPC channel; the router rebuilds concrete metrics from the dump and
+# renders them under the worker's stable replica="i" label. Counter and
+# histogram series from dead worker incarnations fold into a per-replica
+# CARRY so a restart never resets the fleet-level scrape (Prometheus
+# counters must be monotone per series or rate() misreads the reset).
+# ---------------------------------------------------------------------------
+
+
+def dump_registry(registry: Registry) -> List[Dict[str, Any]]:
+    """Serialize a registry's current samples (read-through metrics are
+    evaluated here, so the dump is self-contained)."""
+    out: List[Dict[str, Any]] = []
+    for m in registry.collect():
+        rec: Dict[str, Any] = {"name": m.name, "kind": m.kind,
+                               "help": m.help, "labels": dict(m.labels)}
+        if m.kind == "histogram":
+            rec["bounds"] = list(m.bounds)
+            rec["counts"] = list(m._counts)
+            rec["sum"] = m.sum
+        else:
+            rec["value"] = m.collect_value()
+        out.append(rec)
+    return out
+
+
+def registry_from_dump(samples: Sequence[Dict[str, Any]]) -> Registry:
+    """Rebuild a renderable Registry from :func:`dump_registry` output."""
+    r = Registry()
+    for rec in samples:
+        labels = rec.get("labels") or {}
+        if rec["kind"] == "histogram":
+            h = Histogram(rec["name"], rec.get("help", ""),
+                          buckets=rec.get("bounds") or SECONDS_BUCKETS,
+                          labels=labels)
+            counts = list(rec.get("counts") or [])
+            if len(counts) == len(h._counts):
+                h._counts = counts
+            h.sum = rec.get("sum", 0.0)
+            r.add(h)
+        else:
+            cls = Gauge if rec["kind"] == "gauge" else Counter
+            m = cls(rec["name"], rec.get("help", ""), labels=labels)
+            m.value = rec.get("value", 0)
+            r.add(m)
+    return r
+
+
+def _dump_key(rec: Dict[str, Any]) -> Tuple:
+    return (rec["name"], tuple(sorted((rec.get("labels") or {}).items())))
+
+
+def fold_dump_into_carry(carry: Dict[Tuple, Dict[str, Any]],
+                         dump: Sequence[Dict[str, Any]]) -> None:
+    """Accumulate a dead worker incarnation's MONOTONIC series (counters
+    + histograms; gauges are point-in-time and die with the process)
+    into ``carry``, in place."""
+    import copy
+    for rec in dump or ():
+        if rec["kind"] == "gauge":
+            continue
+        key = _dump_key(rec)
+        base = carry.get(key)
+        if base is None:
+            carry[key] = copy.deepcopy(rec)
+        elif rec["kind"] == "counter":
+            base["value"] = base.get("value", 0) + rec.get("value", 0)
+        elif (rec["kind"] == "histogram"
+              and base.get("bounds") == rec.get("bounds")):
+            base["counts"] = [a + b for a, b in zip(base["counts"],
+                                                    rec["counts"])]
+            base["sum"] = base.get("sum", 0.0) + rec.get("sum", 0.0)
+
+
+def apply_carry(carry: Dict[Tuple, Dict[str, Any]],
+                dump: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Live dump + carried prior-incarnation totals, non-destructively.
+    Carried series the fresh incarnation hasn't re-minted yet (lazy
+    labeled children like requests_finished{reason=...}) still render,
+    so a restart can never make a series vanish from the scrape."""
+    import copy
+    if not carry:
+        return list(dump or ())
+    out: List[Dict[str, Any]] = []
+    seen = set()
+    for rec in dump or ():
+        key = _dump_key(rec)
+        seen.add(key)
+        base = carry.get(key)
+        if base is None or rec["kind"] == "gauge":
+            out.append(rec)
+            continue
+        rec = copy.deepcopy(rec)
+        if rec["kind"] == "counter":
+            rec["value"] = rec.get("value", 0) + base.get("value", 0)
+        elif (rec["kind"] == "histogram"
+              and base.get("bounds") == rec.get("bounds")):
+            rec["counts"] = [a + b for a, b in zip(rec["counts"],
+                                                   base["counts"])]
+            rec["sum"] = rec.get("sum", 0.0) + base.get("sum", 0.0)
+        out.append(rec)
+    for key, rec in carry.items():
+        if key not in seen:
+            out.append(rec)
+    return out
+
+
 def telemetry_enabled() -> bool:
     return os.environ.get("TPU_INF_TELEMETRY", "1") != "0"
 
@@ -627,6 +736,24 @@ class EngineTelemetry:
                   "Resume prefills that restored KV pages from the "
                   "cache tiers instead of recomputing them all",
                   fn=lambda: engine.swap_in_resumes)
+        # KV page migration (README "Process fleet"): drain-time exports
+        # to / imports from sibling replicas. Structurally zero under
+        # the in-process fleet (kept exported so backend counter shapes
+        # match and dashboards need one query).
+        r.counter("tpu_inf_kv_migrate_out_pages_total",
+                  "KV pages exported at drain for migration to a "
+                  "sibling replica",
+                  fn=lambda: engine.migrate_out_pages)
+        r.counter("tpu_inf_kv_migrate_out_bytes_total",
+                  "Bytes exported at drain for KV migration",
+                  fn=lambda: engine.migrate_out_bytes)
+        r.counter("tpu_inf_kv_migrate_in_pages_total",
+                  "Migrated KV pages adopted into this replica's host "
+                  "tier",
+                  fn=lambda: engine.migrate_in_pages)
+        r.counter("tpu_inf_kv_migrate_in_bytes_total",
+                  "Bytes adopted into the host tier by KV migration",
+                  fn=lambda: engine.migrate_in_bytes)
         r.gauge("tpu_inf_model_params", "Model parameter count",
                 fn=lambda: engine.n_params)
         r.gauge("tpu_inf_active_sequences", "Bound decode slots",
