@@ -180,6 +180,12 @@ def start_server(args) -> tuple:
             # supervision knobs for the subprocess arms.
             "fleet": getattr(args, "fleet", "in-process"),
             "fleet_migrate": getattr(args, "fleet_migrate", True),
+            # P/D disaggregation (README "P/D disaggregation"): per-
+            # worker phase roles + shared-CPU prefill deprioritization
+            # for the --compare-pd arms.
+            "worker_roles": tuple(getattr(args, "worker_roles", ())
+                                  or ()),
+            "pd_prefill_nice": getattr(args, "pd_prefill_nice", 0),
             "worker_restart_max":
                 getattr(args, "worker_restart_max", 3),
             "worker_restart_backoff_s":
@@ -193,8 +199,11 @@ def start_server(args) -> tuple:
             if (args.draft_model
                 or getattr(args, "spec_mode", None) == "ngram") else 0),
         # Smoke lane: small prefill buckets so the CPU tier-1 run
-        # compiles in seconds, not minutes.
-        **({"prefill_buckets": (16, 32, 64)}
+        # compiles in seconds, not minutes (a lane can pin its own —
+        # compare-pd needs 256-token chunks so an in-engine prefill
+        # dispatch is a VISIBLE decode stall).
+        **({"prefill_buckets": (getattr(args, "prefill_buckets", None)
+                                or (16, 32, 64))}
            if getattr(args, "smoke", False) else {}))
     loop = asyncio.new_event_loop()
     ready = threading.Event()
@@ -365,6 +374,36 @@ def main() -> dict:
                         "recomputed tokens and swap-in-resumes")
     p.add_argument("--fleet-streams", type=int, default=6,
                    help="compare-fleet: concurrent streams per arm")
+    p.add_argument("--compare-pd", action="store_true",
+                   help="P/D disaggregation lane (README 'P/D "
+                        "disaggregation'): the pinned long-prompt burst "
+                        "through three dp=2 subprocess topologies — "
+                        "mixed, mixed+hybrid-prefill, and a 1-prefill+"
+                        "1-decode split with live KV handoff — each "
+                        "measured unloaded (decode streams only) and "
+                        "loaded (same streams under a CONTINUOUS "
+                        "10x-plus long-prompt prefill burst spanning "
+                        "every decode window), asserting byte-identical "
+                        "outputs across every arm and phase and "
+                        "recording decode TPOT p95 loaded/unloaded "
+                        "ratios, handoff counts, and the zero-recompute "
+                        "clean-handoff claim")
+    p.add_argument("--pd-streams", type=int, default=4,
+                   help="compare-pd: steady decode streams per phase")
+    p.add_argument("--pd-decode-tokens", type=int, default=192,
+                   help="compare-pd: generation budget per decode "
+                        "stream (the measured decode window)")
+    p.add_argument("--pd-load-prompts", type=int, default=64,
+                   help="compare-pd: cap on long prompts the loaded "
+                        "phase's continuous pressure generator issues "
+                        "(a runaway bound — the generator stops when "
+                        "the last stream finishes)")
+    p.add_argument("--pd-load-prompt-tokens", type=int, default=448,
+                   help="compare-pd: tokens per long prompt")
+    p.add_argument("--pd-prefill-nice", type=int, default=19,
+                   help="compare-pd: os.nice() for the pd arm's "
+                        "prefill worker (shared-CPU hosts; see the "
+                        "server CLI flag of the same name)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -376,12 +415,12 @@ def main() -> dict:
 
     if sum(map(bool, (args.compare_admission, args.compare_hybrid,
                       args.compare_ladder, args.compare_spec,
-                      args.compare_fleet))) > 1:
+                      args.compare_fleet, args.compare_pd))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
         p.error("--compare-admission/--compare-hybrid/--compare-ladder/"
-                "--compare-spec/--compare-fleet are mutually exclusive; "
-                "run them as separate invocations")
+                "--compare-spec/--compare-fleet/--compare-pd are "
+                "mutually exclusive; run them as separate invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -448,6 +487,25 @@ def main() -> dict:
             args.host_cache_pages = 64
             args.decode_steps_per_call = 4
             args.no_warmup = True
+        if args.compare_pd:
+            # dp=2 subprocess topologies, room for the 448-token long
+            # prompts (ctx 640 at page_size 16), host tier on. K=2
+            # flushes give the client-side gap measurement ~2-token
+            # resolution; no warmup (6 worker boots across 3 arms —
+            # each arm runs an UNMEASURED warm pass of the exact
+            # workload first, so lazy compiles never land in a measured
+            # phase).
+            args.dp = 2
+            args.page_size, args.max_pages_per_seq = 16, 40
+            args.num_pages = 512
+            args.host_cache_pages = 64
+            args.decode_steps_per_call = 2
+            args.no_warmup = True
+            # 256-token chunks: one in-engine prefill dispatch stalls
+            # decode by a full chunk wall (the interference this lane
+            # exists to show); the pd arm's decode engine never
+            # dispatches one.
+            args.prefill_buckets = (16, 64, 256)
         if args.out is None:
             args.out = ("benchmarks/results/replay_hybrid.json"
                         if args.compare_hybrid
@@ -457,6 +515,8 @@ def main() -> dict:
                         if args.compare_spec
                         else "benchmarks/results/replay_fleet.json"
                         if args.compare_fleet
+                        else "benchmarks/results/replay_pd.json"
+                        if args.compare_pd
                         else "benchmarks/results/replay_smoke.json")
 
     if args.platform != "auto":
@@ -499,6 +559,8 @@ def main() -> dict:
         return _compare_spec(args)
     if args.compare_fleet:
         return _compare_fleet(args)
+    if args.compare_pd:
+        return _compare_pd(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -1333,6 +1395,349 @@ def _compare_fleet(args) -> dict:
             and dm["resume_recomputed_tokens"]
             < dr["resume_recomputed_tokens"]),
     }
+    out = {"config": cfg_snapshot, **arms, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(arms)
+    return result
+
+
+# Long-prompt loads the pressure generator keeps in flight at once: 2
+# per mixed worker (its other 2 slots hold the decode streams), and on
+# the pd split 4 on the prefill worker — whose slots hold nothing else,
+# because a num_predict=1 load finishes at prefill-settle and never
+# reaches the decode tier.
+PD_LOADS_IN_FLIGHT = 4
+
+
+async def _pd_burst(port: int, model: str, n_streams: int,
+                    decode_tokens: int, pressure: bool,
+                    load_tokens: int, load_cap: int,
+                    load_tag: str = "L") -> tuple:
+    """The P/D lane's workload: ``n_streams`` steady greedy decode
+    streams plus — when ``pressure`` — a CONTINUOUS long-prompt prefill
+    burst: from the moment every stream has delivered its first chunk
+    until the last stream finishes, a generator keeps
+    PD_LOADS_IN_FLIGHT loads in flight (capped at ``load_cap`` total, a
+    runaway bound), so every stream's entire decode window runs under
+    sustained prefill pressure — no race between a one-shot volley and
+    the windows it must overlap. Returns (streams, loads, issued)."""
+    import aiohttp
+
+    url = f"http://127.0.0.1:{port}/api/generate"
+    timeout = aiohttp.ClientTimeout(total=1800)
+    first_chunk = [asyncio.Event() for _ in range(n_streams)]
+    streams_done = asyncio.Event()
+    n_done = [0]
+
+    async def stream(session, i: int) -> dict:
+        prompt = f"[s{i:02d}] steady decode"
+        payload = {"model": model, "prompt": prompt,
+                   "temperature": 0.0, "stream": True,
+                   "options": {"num_predict": decode_tokens}}
+        text, final = [], {}
+        async with session.post(url, json=payload) as resp:
+            resp.raise_for_status()
+            async for line in resp.content:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                tok = rec.get("response", "")
+                if tok:
+                    text.append(tok)
+                    first_chunk[i].set()
+                if rec.get("done"):
+                    final = rec
+                    break
+        n_done[0] += 1
+        if n_done[0] == n_streams:
+            streams_done.set()
+        return {"idx": i, "reply": "".join(text),
+                # Router-side decode window (the Ollama eval fields):
+                # first token -> finish, measured by the serving
+                # process — the stalls a prefill inflicts on decode
+                # land here, while the measuring CLIENT's own
+                # event-loop hiccups (this is a shared CPU) do not.
+                "eval_count": final.get("eval_count", 0),
+                "eval_duration_ns": final.get("eval_duration", 0),
+                "output_tokens": final.get("eval_count", 0)}
+
+    async def load(session, j: int) -> dict:
+        # One long prompt, ONE-token reply: pure prefill pressure —
+        # the request finishes at prefill-settle (its token comes out
+        # of the prefill dispatch), so on the pd split a load never
+        # occupies a decode-worker slot and on the mixed arms it adds
+        # no decode work, only the prefill interference this lane
+        # exists to measure. Content is deterministic and distinct per
+        # index (and per warm/measured pass via load_tag — a measured
+        # load must never hit the warm pass's prefix cache, or the
+        # burst stops being prefill work).
+        body = f"[{load_tag}{j:02d}] " + "the quick onyx tpu jumps "
+        prompt = (body * (load_tokens // len(body) + 1))[:load_tokens]
+        payload = {"model": model, "prompt": prompt,
+                   "temperature": 0.0, "stream": False,
+                   "options": {"num_predict": 1}}
+        t0 = time.perf_counter()
+        async with session.post(url, json=payload) as resp:
+            resp.raise_for_status()
+            rec = await resp.json()
+        return {"idx": j, "reply": rec.get("response", ""),
+                "e2e_s": round(time.perf_counter() - t0, 4)}
+
+    issued = [0]
+
+    async def pump(session) -> list:
+        await asyncio.gather(*[fc.wait() for fc in first_chunk])
+        results, pending = [], set()
+        waiter = asyncio.ensure_future(streams_done.wait())
+        while not streams_done.is_set() and issued[0] < load_cap:
+            while (len(pending) < PD_LOADS_IN_FLIGHT
+                   and issued[0] < load_cap):
+                pending.add(asyncio.ensure_future(
+                    load(session, issued[0])))
+                issued[0] += 1
+            done, pending = await asyncio.wait(
+                pending | {waiter},
+                return_when=asyncio.FIRST_COMPLETED)
+            pending.discard(waiter)
+            results.extend(d.result() for d in done if d is not waiter)
+        if pending:
+            # Stop ISSUING at streams-done; in-flight loads complete
+            # (the idle fleet drains them in milliseconds).
+            results.extend(await asyncio.gather(*pending))
+        if not waiter.done():
+            waiter.cancel()
+        return sorted(results, key=lambda r: r["idx"])
+
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        tasks = [stream(session, i) for i in range(n_streams)]
+        if pressure:
+            tasks.append(pump(session))
+        res = await asyncio.gather(*tasks)
+    return (res[:n_streams], (res[n_streams] if pressure else []),
+            issued[0])
+
+
+def _pd_tpot(streams: list) -> dict:
+    """Per-stream decode TPOT (the main replay summary's definition:
+    decode window over tokens-1, per request) reduced to p50/p95
+    across streams, from the server's own eval accounting. The
+    whole-window mean is the right estimator on a shared CPU: every
+    stall a prefill inflicts on a stream lands in its window SUM,
+    while measurement hiccups amortize over the stream's 100+
+    tokens."""
+    tpots = [s["eval_duration_ns"] / 1e9 / (s["eval_count"] - 1)
+             for s in streams if s["eval_count"] > 1]
+    return _percentiles(tpots, ps=(50, 95))
+
+
+def _pd_tpot_merged(passes: list) -> dict:
+    """Per-stream TPOT pooled across repeated passes of the same
+    workload (sum of windows over sum of token gaps, per stream index),
+    then p50/p95 across streams — the unloaded baseline runs twice and
+    merges, halving the single-pass scheduling noise a 1-core host
+    inflicts on a 1-2s window."""
+    dur: dict = {}
+    cnt: dict = {}
+    for streams in passes:
+        for s in streams:
+            if s["eval_count"] > 1:
+                dur[s["idx"]] = dur.get(s["idx"], 0) \
+                    + s["eval_duration_ns"] / 1e9
+                cnt[s["idx"]] = cnt.get(s["idx"], 0) \
+                    + s["eval_count"] - 1
+    tpots = [dur[i] / cnt[i] for i in sorted(dur) if cnt[i]]
+    return _percentiles(tpots, ps=(50, 95))
+
+
+def _pd_outputs_sha(streams: list) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for r in sorted(streams, key=lambda r: r["idx"]):
+        h.update(f"{r['idx']}:".encode())
+        h.update(r["reply"].encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+def _pd_arm(args, label: str, roles: tuple,
+            hybrid: bool = False) -> dict:
+    """Boot one dp=2 subprocess topology, run warm + unloaded +
+    loaded passes of the pinned workload, and summarize."""
+    print(f"[replay] pd arm: {label}", file=sys.stderr)
+    args.fleet = "subprocess"
+    args.worker_roles = roles
+    args.hybrid_prefill = hybrid
+    args.worker_restart_backoff_s = 0.1
+    args.worker_restart_max = 10
+    srv, port, stop = start_server(args)
+    group = srv.group
+    n, dt = args.pd_streams, args.pd_decode_tokens
+    nl, lt = args.pd_load_prompts, args.pd_load_prompt_tokens
+    try:
+        # Pin stream placement first: prefill each stream prompt
+        # SEQUENTIALLY so the rotating cold tie-break alternates
+        # workers deterministically (2+2 on the mixed arms) and the
+        # measured phases inherit that placement via prefix affinity —
+        # concurrent cold admission with stale load peeks can land
+        # 3+1, which skews the p95-across-streams baseline.
+        for i in range(n):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/generate",
+                data=json.dumps({"model": args.model,
+                                 "prompt": f"[s{i:02d}] steady decode",
+                                 "temperature": 0.0, "stream": False,
+                                 "options": {"num_predict": 4}}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=600).read()
+        # UNMEASURED warm pass of the exact loaded workload (distinct
+        # load content, a handful of loads): compiles every lazy graph
+        # this arm will touch — prefill buckets, chunked/hybrid prefill
+        # at real occupancy, decode, and (pd) the handoff export/adopt
+        # path — so measured phases time serving, not XLA.
+        asyncio.run(_pd_burst(port, args.model, n, dt, True, lt,
+                              load_cap=6, load_tag="W"))
+        # Unloaded baseline x2 (merged per stream: a single 1-2s pass
+        # on a 1-core host carries scheduling noise the merge halves).
+        base_a, _, _ = asyncio.run(
+            _pd_burst(port, args.model, n, dt, False, lt, 0))
+        base_b, _, _ = asyncio.run(
+            _pd_burst(port, args.model, n, dt, False, lt, 0))
+        loaded_streams, loads, issued = asyncio.run(
+            _pd_burst(port, args.model, n, dt, True, lt, nl))
+        after = json.loads(scrape_metrics(port, fmt="json")[0])
+        health = group.health_snapshot()
+    finally:
+        group.stop(drain=False)
+        stop()
+    sup = after.get("supervision") or {}
+    sha_base = _pd_outputs_sha(base_a)
+    sha_loaded = _pd_outputs_sha(loaded_streams)
+    tpot_base = _pd_tpot_merged([base_a, base_b])
+    tpot_loaded = _pd_tpot(loaded_streams)
+    return {
+        "label": label, "roles": list(roles) or ["mixed", "mixed"],
+        "hybrid_prefill": hybrid,
+        "streams": n, "decode_tokens": dt,
+        "loads_issued": issued, "loads_completed": len(loads),
+        "load_prompt_tokens": lt,
+        "output_tokens": sum(s["output_tokens"]
+                             for s in loaded_streams),
+        # Decode TPOT (per-stream window mean), per phase.
+        "decode_tpot_s_unloaded": tpot_base,
+        "decode_tpot_s_loaded": tpot_loaded,
+        "decode_tpot_p95_ratio": (
+            round(tpot_loaded["p95"] / tpot_base["p95"], 4)
+            if tpot_base["p95"] else None),
+        "load_e2e_s": _percentiles([r["e2e_s"] for r in loads],
+                                   ps=(50, 95)),
+        # Byte-identity: the same streams must read the same in both
+        # phases (warm cache is a placement detail) and across arms.
+        "outputs_sha256": sha_base,
+        "outputs_phases_identical": (
+            sha_base == sha_loaded == _pd_outputs_sha(base_b)),
+        "load_replies": [r["reply"] for r in loads],
+        "pd_handoffs": sup.get("pd_handoffs", 0),
+        "pd_adoptions": sup.get("pd_adoptions", 0),
+        "pd_handoff_recomputes": sup.get("pd_handoff_recomputes", 0),
+        "resume_recomputed_tokens": sup.get(
+            "resume_recomputed_tokens", 0),
+        "worker_restarts": sup.get("worker_restarts", 0),
+        "fleet_status": health.get("status"),
+    }
+
+
+def _compare_pd(args) -> dict:
+    """The P/D disaggregation artifact (README "P/D disaggregation"):
+    the pinned long-prompt burst through three dp=2 subprocess
+    topologies — mixed (every worker runs both phases), hybrid (mixed
+    + PR-4 fused prefill-decode steps), and pd (1 prefill + 1 decode
+    worker with live KV handoff). Each arm measures decode TPOT p95
+    unloaded (decode streams only) then loaded (same streams + a
+    prefill burst >= 10x the streams' own prefill tokens). The pd
+    split keeps decode cadence flat — prefill never enters the decode
+    engine, and on shared-CPU hosts the prefill tier is nice()d down
+    (pd_prefill_nice; on TPU the isolation is physical) — while mixed/
+    hybrid serialize prefill INTO the decode engine's dispatch stream,
+    an interference no priority can remove. Outputs must be
+    byte-identical across every arm and phase, and the pd arm's clean
+    handoffs must recompute zero tokens."""
+    cfg_snapshot = {k: v for k, v in vars(args).items()
+                    if not k.startswith("_")}
+    arms = {}
+    arms["mixed"] = _pd_arm(args, "mixed", ())
+    arms["hybrid"] = _pd_arm(args, "hybrid", (), hybrid=True)
+    arms["pd"] = _pd_arm(args, "pd", ("prefill", "decode"))
+    args.worker_roles, args.fleet = (), "in-process"
+
+    mixed, hybrid, pd = arms["mixed"], arms["hybrid"], arms["pd"]
+    shas = {a["outputs_sha256"] for a in arms.values()}
+    phases_ok = all(a["outputs_phases_identical"]
+                    for a in arms.values())
+    # A load's single greedy token is deterministic per index content,
+    # so the arms must agree on every load they have in common (each
+    # arm absorbs a different COUNT under pressure — the pd arm's
+    # nice()d prefill tier grinds slower by design).
+    n_common = min(a["loads_completed"] for a in arms.values())
+    loads_ok = n_common > 0 and len(
+        {tuple(a["load_replies"][:n_common])
+         for a in arms.values()}) == 1
+    for a in arms.values():
+        del a["load_replies"]
+    # Offered prefill tokens vs the streams' own prompts: every arm's
+    # generator ISSUED at least min_issued loads into its fleet while
+    # the streams decoded.
+    stream_prefill = args.pd_streams * 18      # "[sNN] steady decode"
+    min_issued = min(a["loads_issued"] for a in arms.values())
+    comparison = {
+        "prefill_load_ratio": round(
+            (stream_prefill
+             + min_issued * args.pd_load_prompt_tokens)
+            / stream_prefill, 1),
+        "loads_issued": {k: a["loads_issued"]
+                         for k, a in arms.items()},
+        "loads_completed": {k: a["loads_completed"]
+                            for k, a in arms.items()},
+        "decode_tpot_p95_unloaded_s": {
+            k: a["decode_tpot_s_unloaded"]["p95"]
+            for k, a in arms.items()},
+        "decode_tpot_p95_loaded_s": {
+            k: a["decode_tpot_s_loaded"]["p95"]
+            for k, a in arms.items()},
+        "decode_tpot_p95_ratio": {
+            k: a["decode_tpot_p95_ratio"] for k, a in arms.items()},
+        # The lane's headline: under the 10x+ prefill burst the pd
+        # arm's decode TPOT p95 holds within 10% of its own unloaded
+        # baseline; the in-engine topologies degrade.
+        "pd_tpot_flat": bool(pd["decode_tpot_p95_ratio"] is not None
+                             and pd["decode_tpot_p95_ratio"] <= 1.10),
+        "hybrid_degrades": bool(
+            hybrid["decode_tpot_p95_ratio"] is not None
+            and pd["decode_tpot_p95_ratio"] is not None
+            and hybrid["decode_tpot_p95_ratio"] >= 1.25
+            and hybrid["decode_tpot_p95_ratio"]
+            > pd["decode_tpot_p95_ratio"]),
+        "mixed_tpot_p95_ratio": mixed["decode_tpot_p95_ratio"],
+        "outputs_identical": bool(len(shas) == 1 and loads_ok
+                                  and phases_ok),
+        "pd_handoffs": pd["pd_handoffs"],
+        "pd_adoptions": pd["pd_adoptions"],
+        # Clean-handoff path: adoption restores the exported KV (incl.
+        # the partial final page) — nothing recomputes.
+        "pd_handoff_recomputes": pd["pd_handoff_recomputes"],
+        "pd_recomputed_tokens": pd["resume_recomputed_tokens"],
+        "pd_clean_handoffs": bool(pd["pd_handoffs"] > 0
+                                  and pd["pd_handoff_recomputes"] == 0
+                                  and pd["resume_recomputed_tokens"]
+                                  == 0),
+    }
+    comparison["pd_wins"] = bool(
+        comparison["outputs_identical"]
+        and comparison["pd_clean_handoffs"]
+        and comparison["pd_tpot_flat"]
+        and comparison["hybrid_degrades"])
     out = {"config": cfg_snapshot, **arms, "comparison": comparison}
     print(json.dumps(comparison, indent=1))
     _write_out(args.out, out)

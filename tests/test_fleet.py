@@ -16,10 +16,19 @@ Covers the subsystem at three levels:
   SIGTERM drain-and-migrate path (admission on the destination becomes
   a swap-in-resume), and metrics-label hygiene across restarts (stable
   ``replica="i"`` label, no counter resets, no duplicate series).
+- P/D disaggregation (README "P/D disaggregation"): live-sequence KV
+  handoff export/adopt at the engine level for every kv_quant mode
+  (including the partial final page the drain path would recompute),
+  the malformed-blob fallback to recompute-resume, and a second
+  module-scoped 1-prefill+1-decode fleet pinning handoff routing,
+  role observability, and a handoff racing a decode-worker ``kill -9``
+  (stale-blob fallback, byte-identical).
 """
 
 import hashlib
 import json
+import os
+import sys
 import threading
 import time
 
@@ -409,6 +418,349 @@ def test_metrics_label_stable_across_restart(fleet):
     # Fleet-side restart counter moved under the stable label.
     restarts = series(after, "tpu_inf_worker_restarts_total")
     assert restarts[(("replica", "0"),)] >= 1
+
+
+# ------------------------------------------- P/D disaggregation (live
+# KV handoff): engine-level export/adopt, then a real 1p+1d fleet.
+
+# 13 tokens: two KV pages at page_size=8, the second PARTIAL — the
+# case the drain-time migrate path recomputes and the live handoff
+# must move verbatim.
+PD_PROMPT = [5, 9, 2, 7, 3, 8, 1, 6, 4, 2, 9, 1, 7]
+
+
+def _run_sched(engine, seq, hook=None, timeout=180.0):
+    """One request through a real EngineScheduler; returns
+    (streamed tokens, finished seq, scheduler) after a hard stop."""
+    from tpu_inference.engine.scheduler import EngineScheduler
+
+    sched = EngineScheduler(engine)
+    if hook is not None:
+        sched.on_prefill_handoff = hook
+    sched.start()
+    toks, done, box = [], threading.Event(), {}
+    try:
+        sched.submit(seq, lambda s, t: toks.append(t),
+                     lambda s: (box.update(seq=s), done.set()))
+        assert done.wait(timeout), "request did not finish"
+    finally:
+        sched.stop(drain=False)
+    return toks, box["seq"], sched
+
+
+def _pd_engine(quant, role):
+    return InferenceEngine(
+        tiny_llama(vocab_size=512),
+        EngineConfig(**{**ENGINE_KW, "kv_quant": quant, "role": role}),
+        seed=0)
+
+
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_live_handoff_export_adopt_bit_exact(quant):
+    """Satellite: a LIVE (in-flight, not draining) sequence's KV
+    exports on a prefill-role engine — including the partial final
+    page — crosses the wire format, and adopts on a decode-role engine
+    with ZERO prefill dispatches and zero recomputed tokens; the
+    continued greedy stream is byte-identical to a mixed engine, for
+    every kv_quant layout."""
+    from tests._leak import assert_pool_clean
+
+    src = _pd_engine(quant, "prefill")
+    captured = {}
+
+    def hook(s):
+        digests, pages, ctx = src.export_sequence_kv_live(s)
+        if not pages:
+            return False
+        captured["blob"] = kvc.serialize_host_pages(pages)
+        captured["ctx"] = ctx
+        captured["digests"] = digests
+        return True
+
+    seq = Sequence(request_id=1, prompt_tokens=list(PD_PROMPT),
+                   max_new_tokens=24)
+    seq.handoff_after_prefill = True
+    toks_src, fin_src, _ = _run_sched(src, seq, hook)
+    # The prefill settled, streamed exactly the first token, and
+    # finished locally as a handoff.
+    assert fin_src.finish_reason == "handoff"
+    assert len(toks_src) == 1
+    assert src.handoffs_out == 1
+    # The export covers EVERY page holding ctx_len tokens — the final
+    # one partial (13 % 8 != 0) — while chain digests cover only the
+    # full pages (a chain digest is defined on full pages).
+    assert captured["ctx"] == len(PD_PROMPT)
+    pages = kvc.deserialize_host_pages(captured["blob"])
+    assert len(pages) == 2 and len(captured["digests"]) == 1
+
+    dst = _pd_engine(quant, "decode")
+    seq2 = Sequence(request_id=2, prompt_tokens=list(PD_PROMPT),
+                    max_new_tokens=24)
+    seq2.generated = list(toks_src)
+    seq2.resume_base = len(toks_src)
+    seq2.adopt_kv = (pages, captured["ctx"])
+    toks_dst, fin_dst, sched_dst = _run_sched(dst, seq2)
+    assert fin_dst.finish_reason == "length"
+    # Clean-handoff path: the adoption restored KV instead of
+    # prefilling — nothing recomputed on the decode side.
+    assert sched_dst.stats.prefills == 0
+    assert dst.adoptions_in == 1 and dst.swap_in_resumes == 1
+    assert fin_dst.cached_tokens == len(PD_PROMPT) + 1
+
+    mixed = _pd_engine(quant, "mixed")
+    want = mixed.generate([list(PD_PROMPT)], max_new_tokens=24)[0]
+    assert toks_src + toks_dst == want
+    assert_pool_clean(src)
+    assert_pool_clean(dst)
+
+
+def test_handoff_adopt_malformed_blob_recomputes():
+    """A handoff blob that doesn't match its ctx_len (truncated page
+    list) must NOT stick: adoption fails, the scheduler clears the
+    adoption state and recompute-resumes through the ordinary prefill
+    path — byte-identical, with the recompute visible in stats."""
+    from tests._leak import assert_pool_clean
+
+    src = _pd_engine("none", "prefill")
+    captured = {}
+
+    def hook(s):
+        _, pages, ctx = src.export_sequence_kv_live(s)
+        captured["pages"], captured["ctx"] = pages, ctx
+        return bool(pages)
+
+    seq = Sequence(request_id=3, prompt_tokens=list(PD_PROMPT),
+                   max_new_tokens=16)
+    seq.handoff_after_prefill = True
+    toks_src, _, _ = _run_sched(src, seq, hook)
+
+    dst = _pd_engine("none", "decode")
+    seq2 = Sequence(request_id=4, prompt_tokens=list(PD_PROMPT),
+                    max_new_tokens=16)
+    seq2.generated = list(toks_src)
+    seq2.resume_base = len(toks_src)
+    # Truncated: one page short of what ctx_len needs.
+    seq2.adopt_kv = (captured["pages"][:-1], captured["ctx"])
+    toks_dst, fin_dst, sched_dst = _run_sched(dst, seq2)
+    assert fin_dst.finish_reason == "length"
+    assert dst.adoptions_in == 0
+    assert dst.adopt_fallbacks == 1           # counted, not silent
+    assert sched_dst.stats.prefills == 1      # the recompute-resume
+    mixed = _pd_engine("none", "mixed")
+    want = mixed.generate([list(PD_PROMPT)], max_new_tokens=16)[0]
+    assert toks_src + toks_dst == want
+    assert_pool_clean(dst)
+
+
+@pytest.fixture(scope="module")
+def pd_fleet():
+    """1 prefill + 1 decode worker: the smallest disaggregated
+    topology (README "P/D disaggregation")."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(
+        _cfg(dp=2, worker_roles=("prefill", "decode")))
+    group.start()
+    yield group
+    group.stop(drain=False)
+
+
+def test_pd_fleet_handoff_byte_identity_and_surfaces(pd_fleet, oracle):
+    """Tentpole proof at process level: new prompts admit to the
+    prefill worker, settle, hand off, and decode on the decode worker
+    — outputs byte-identical to a mixed engine, zero handoff
+    recomputes, with roles/backlog/occupancy/handoff counters visible
+    in /healthz, stats, and the Prometheus scrape."""
+    _wait_states(pd_fleet)
+    handoffs0 = pd_fleet.pd_handoffs
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [4, 4, 4, 4]]
+    pend = [_submit(pd_fleet, 6000 + i, p, 16)
+            for i, p in enumerate(prompts)]
+    for (toks, done, box), p in zip(pend, prompts):
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length"
+        assert toks == oracle.generate([p], max_new_tokens=16)[0]
+    assert pd_fleet.pd_handoffs >= handoffs0 + len(prompts)
+    assert pd_fleet.pd_handoff_recomputes == 0
+
+    # stats_snapshot refreshes each worker's cached stats, so the
+    # supervision view's adoption sum is current.
+    sup = pd_fleet.stats_snapshot()["supervision"]
+    assert sup["roles"] == ["prefill", "decode"]
+    assert sup["pd_handoffs"] >= len(prompts)
+    assert sup["pd_adoptions"] >= len(prompts)
+    # The handoff-wall histogram rides supervision as a diffable phase
+    # snapshot (one observation per routed handoff).
+    assert sup["phases"]["pd_handoff_s"]["count"] >= len(prompts)
+    assert sup["phases"]["pd_handoff_s"]["p95"] is not None
+    hs = pd_fleet.health_snapshot()
+    roles = [r["role"] for r in hs["replicas"]]
+    assert roles == ["prefill", "decode"]
+    for r in hs["replicas"]:
+        assert "prefill_backlog" in r and "ladder_occupancy" in r
+    # The decode worker did the adopting; the prefill worker the
+    # handing-off.
+    assert hs["replicas"][0]["pd_handoffs"] >= len(prompts)
+    assert hs["replicas"][1]["pd_adoptions"] >= len(prompts)
+    pt = pd_fleet.prometheus_text()
+    assert 'tpu_inf_worker_role_info{replica="0",role="prefill"}' in pt
+    assert 'tpu_inf_worker_role_info{replica="1",role="decode"}' in pt
+    assert "tpu_inf_pd_handoffs_total" in pt
+    assert "tpu_inf_pd_handoff_seconds_bucket" in pt
+
+
+def test_pd_handoff_races_decode_restart(pd_fleet, oracle):
+    """Satellite: kill -9 the decode worker AFTER it adopted a handoff
+    and streamed tokens. The kept handoff blob is stale (decode
+    advanced past the export), so the failover falls back to
+    recompute-resume — on the prefill worker, since no decode worker
+    is routable — and the stream completes byte-identically; the
+    supervisor restarts the decode worker."""
+    _wait_states(pd_fleet)
+    recomputes0 = pd_fleet.pd_handoff_recomputes
+    prompt = [8, 1, 8, 2, 8, 3]
+    toks, done, box = _submit(pd_fleet, 7000, prompt, 40)
+    deadline = time.monotonic() + 60
+    # Wait until decode is well past the handoff point (1 token).
+    while len(toks) < 6 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(toks) >= 6
+    with pd_fleet._lock:
+        holder = pd_fleet._tracked[7000].worker.replica
+    assert holder == 1        # the decode worker owns the stream
+    pd_fleet.apply_chaos({"replica": 1, "kill": "kill9"})
+
+    fin = _finish(done, box)
+    assert fin.finish_reason == "length"
+    assert toks == oracle.generate([prompt], max_new_tokens=40)[0]
+    # The stale-export fallback fired: the blob was dropped, not
+    # adopted (adopting it would fork the stream).
+    assert pd_fleet.pd_handoff_recomputes > recomputes0
+    _wait_states(pd_fleet)
+    assert pd_fleet.health_snapshot()["replicas"][1]["restarts"] >= 1
+
+
+_WARMUP_COMPILE_COUNTER = """
+import logging, sys
+records = []
+handler = logging.Handler()
+handler.emit = lambda rec: records.append(rec.getMessage())
+import jax
+jax.config.update("jax_log_compiles", True)
+for n in ("jax._src.interpreters.pxla", "jax._src.dispatch"):
+    lg = logging.getLogger(n)
+    lg.addHandler(handler)
+    lg.setLevel(logging.DEBUG)
+from tpu_inference.config import EngineConfig, tiny_llama
+from tpu_inference.engine.engine import InferenceEngine
+kw = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
+          max_batch_size=2, prefill_buckets=(16,), host_cache_pages=32)
+engine = InferenceEngine(tiny_llama(vocab_size=512),
+                         EngineConfig(**kw, role=sys.argv[1]), seed=0)
+n0 = len(records)          # boot/param compiles, not warmup's
+engine.warmup()
+print("COMPILES", len(records) - n0)
+"""
+
+
+def test_role_specialized_warmup_shrinks_compile_set():
+    """Tentpole claim: a prefill-role warmup compiles only the prefill
+    side and a decode-role warmup only the decode side, so each
+    specialized role boots on a strictly smaller compile set than
+    mixed while the two together still cover it. Each warmup runs in a
+    FRESH python process: in-process jax shares a global pjit cache
+    across engines, so a second engine's identical graphs never
+    recompile and in-process counts compare nothing."""
+    import subprocess
+
+    def warmup_compiles(role):
+        out = subprocess.run(
+            [sys.executable, "-c", _WARMUP_COMPILE_COUNTER, role],
+            capture_output=True, text=True, timeout=240,
+            cwd=os.path.dirname(os.path.dirname(__file__)))
+        assert out.returncode == 0, out.stderr[-2000:]
+        return int(out.stdout.split("COMPILES")[1].strip())
+
+    n_mixed = warmup_compiles("mixed")
+    n_prefill = warmup_compiles("prefill")
+    n_decode = warmup_compiles("decode")
+    assert 0 < n_prefill < n_mixed
+    assert 0 < n_decode < n_mixed
+    # Specialization drops the OTHER phase's graphs, never its own:
+    # the two role sets together cover at least the mixed set (shared
+    # helper ops may double-count, so >=, not ==).
+    assert n_prefill + n_decode >= n_mixed
+
+
+def test_peek_fanout_deadline_and_cold_fallback():
+    """Satellite: candidate peeks fan out CONCURRENTLY with a short
+    deadline — one stalled worker no longer adds its full round-trip
+    to every admission; it scores with the cold fallback while the
+    fast sibling's real peek is used."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=2, route_peek_timeout_s=0.3))
+    fast = {"hbm": 3, "host": 1, "load": 2, "pressure": False,
+            "occupancy": 0.5, "backlog": 0, "role": "mixed"}
+
+    def fake_peek(h, digests, timeout=10.0):
+        if h.replica == 1:
+            time.sleep(5.0)       # a wedged worker's round-trip
+        return dict(fast)
+
+    group._peek = fake_peek
+    try:
+        t0 = time.monotonic()
+        peeks = group._peek_many(group.workers, [b"\x00" * 8])
+        dt = time.monotonic() - t0
+        assert dt < 2.0, f"fan-out waited on the straggler ({dt:.2f}s)"
+        assert peeks[0] == fast
+        assert peeks[1] == group._cold_peek(group.workers[1])
+        # Single candidate short-circuits the pool (no thread hop).
+        assert group._peek_many([group.workers[0]], []) == [fast]
+    finally:
+        group.stop(drain=False)
+
+
+def test_worker_roles_resolution_and_guards():
+    """Role-axis config contract: resolve_worker_roles expands/
+    validates, pd_worker_roles sizes the split, and the in-process
+    backend refuses phase roles (the handoff needs worker
+    processes)."""
+    from tpu_inference.config import resolve_worker_roles
+    from tpu_inference.engine.autosize import pd_worker_roles
+    from tpu_inference.server.http import build_engine_group
+
+    assert resolve_worker_roles(3, ()) == ("mixed",) * 3
+    assert resolve_worker_roles(2, (), default_role="prefill") == \
+        ("prefill", "prefill")
+    assert resolve_worker_roles(2, ("prefill", "decode")) == \
+        ("prefill", "decode")
+    with pytest.raises(ValueError, match="one role per dp replica"):
+        resolve_worker_roles(3, ("prefill", "decode"))
+    with pytest.raises(ValueError, match="unknown worker role"):
+        resolve_worker_roles(1, ("chonk",))
+
+    assert pd_worker_roles(4, "1:1") == ("prefill",) * 2 + ("decode",) * 2
+    assert pd_worker_roles(4, "1:3") == ("prefill",) + ("decode",) * 3
+    # auto with the BurstGPT-shaped default mix: prefill share =
+    # 512 / (512 + 4*128) = 0.5.
+    assert pd_worker_roles(4, "auto") == \
+        ("prefill",) * 2 + ("decode",) * 2
+    # Heavily decode-weighted observed mix: prefill floors at one.
+    assert pd_worker_roles(4, "auto", prompt_token_rate=10,
+                           decode_token_rate=1000) == \
+        ("prefill",) + ("decode",) * 3
+    with pytest.raises(ValueError, match="dp >= 2"):
+        pd_worker_roles(1, "auto")
+    with pytest.raises(ValueError, match="'auto' or 'P:D'"):
+        pd_worker_roles(2, "half")
+    with pytest.raises(ValueError, match=">= 1"):
+        pd_worker_roles(2, "0:2")
+
+    with pytest.raises(ValueError, match="subprocess"):
+        build_engine_group(_cfg(dp=2, fleet="in-process",
+                                worker_roles=("prefill", "decode")))
 
 
 def test_draining_worker_refuses_submit_routes_to_sibling(fleet, oracle):

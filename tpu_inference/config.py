@@ -507,6 +507,19 @@ class EngineConfig:
     # headroom keeps it off the steady-state path either way). 0 = off
     # (legacy admission gate only).
     ladder_admit_headroom_pages: int = 0
+    # Worker phase role (README "P/D disaggregation"): "mixed" runs both
+    # phases (the compatibility default — every pre-P/D topology);
+    # "prefill" serves prompt prefills only and HANDS each settled
+    # prefill off (KV pages incl. the partial final page + stream state)
+    # to a decode worker, so warmup compiles only the prefill buckets;
+    # "decode" resumes handed-off sequences and decodes at high
+    # occupancy with zero prefill interference, so warmup compiles only
+    # the decode ladder (and spec-verify) graphs. The role specializes
+    # WARMUP and scheduling intent, not capability — a degraded fleet
+    # can still run the other phase (lazy compile) so failover never
+    # strands a request. Per-worker roles come from
+    # ServerConfig.worker_roles; this field is what one engine sees.
+    role: str = "mixed"
 
     @property
     def max_context(self) -> int:
@@ -563,6 +576,33 @@ def validate_spec_config(spec_mode: str, num_speculative_tokens: int,
         raise ValueError(
             f"--ngram-window {ngram_window}: must be in [1, 8] "
             "(longest suffix n-gram matched against the history)")
+
+
+# Worker phase roles (README "P/D disaggregation").
+WORKER_ROLES = ("prefill", "decode", "mixed")
+
+
+def resolve_worker_roles(dp: int, worker_roles, default_role: str = "mixed"
+                         ) -> tuple:
+    """THE role-resolution rule, shared by the fleet router and the CLIs
+    so they cannot drift: expand ``worker_roles`` (one entry per dp
+    replica, or () = ``default_role`` everywhere) into a validated
+    dp-length tuple. Raises ValueError with a flag-spelling message on a
+    bad role name or a length mismatch; warns (returns anyway) are the
+    caller's business — a fleet of only-decode workers still serves,
+    it just prefills lazily."""
+    roles = tuple(worker_roles or ())
+    if not roles:
+        roles = (default_role,) * max(1, dp)
+    if len(roles) != max(1, dp):
+        raise ValueError(
+            f"--roles needs exactly one role per dp replica: got "
+            f"{len(roles)} for dp={dp}")
+    for r in roles:
+        if r not in WORKER_ROLES:
+            raise ValueError(f"unknown worker role {r!r}: one of "
+                             f"{WORKER_ROLES}")
+    return roles
 
 
 @dataclasses.dataclass(frozen=True)
@@ -671,6 +711,37 @@ class ServerConfig:
     # worker's host tier, so resubmission becomes a swap-in-resume.
     # False = the resubmission-only comparison arm (full re-prefill).
     fleet_migrate: bool = True
+    # --- P/D disaggregation (README "P/D disaggregation") ---
+    # Per-worker phase roles for the subprocess fleet, one entry per dp
+    # replica ("prefill" | "decode" | "mixed"). () = every worker runs
+    # EngineConfig.role (default "mixed" — the dp fallback with
+    # unchanged behavior). With phase-specialized roles the router
+    # admits new prompts to prefill-capable workers only and moves each
+    # settled prefill to a decode worker as a live KV handoff (no
+    # re-prefill, byte-identical under greedy). CLI: --role / --roles /
+    # --pd-ratio.
+    worker_roles: tuple[str, ...] = ()
+    # Fan-out deadline for the router's per-candidate peek RPCs: peeks
+    # are issued concurrently and any candidate that hasn't answered by
+    # this deadline scores with a cold fallback instead of adding its
+    # round-trip to the admission path.
+    route_peek_timeout_s: float = 2.0
+    # Decode-phase routing (handoffs + mid-stream resumes): page-
+    # equivalents of routing cost a FULLY-occupied decode ladder adds to
+    # a candidate's score — decode picks by ladder occupancy + load,
+    # minus host-warm pages (the least-loaded decode worker wins when
+    # occupancies tie).
+    route_occupancy_pages: float = 8.0
+    # os.nice() increment applied to prefill-ROLE worker processes at
+    # boot (0 = off). On a real TPU fleet the P/D isolation is physical
+    # (phases sit on different chips); on a shared-CPU host the worker
+    # processes still contend for cores, and deprioritizing the prefill
+    # tier keeps decode cadence flat under prefill bursts — the mixed/
+    # hybrid topologies CANNOT buy this with any priority, because
+    # their interference is in-engine dispatch serialization, not CPU
+    # share. Used by the --compare-pd replay lane; irrelevant (but
+    # harmless) when each worker owns its accelerator.
+    pd_prefill_nice: int = 0
 
 
 @dataclasses.dataclass
@@ -734,11 +805,14 @@ def framework_config_from_dict(d: dict) -> FrameworkConfig:
     for k in _TUPLE_FIELDS:
         if k in eng and eng[k] is not None:
             eng[k] = tuple(eng[k])
+    srv = dict(d.get("server") or {})
+    if srv.get("worker_roles") is not None:
+        srv["worker_roles"] = tuple(srv["worker_roles"])
     return FrameworkConfig(
         model=model_config_from_dict(d["model"]),
         engine=EngineConfig(**eng),
         parallel=ParallelConfig(**(d.get("parallel") or {})),
-        server=ServerConfig(**(d.get("server") or {})),
+        server=ServerConfig(**srv),
         checkpoint_path=d.get("checkpoint_path"),
         seed=d.get("seed", 0),
     )

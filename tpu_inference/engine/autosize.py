@@ -306,6 +306,54 @@ def parse_decode_ladder(spec: str, top: int) -> tuple:
     return validate_ladder(rungs, top)
 
 
+# Chip-seconds one decode token costs relative to one prefill token in
+# the pd-split heuristic: decode is memory-bound single-token dispatch
+# work (the whole weight stream per token) while prefill amortizes the
+# stream over the prompt, so a decode token "weighs" several prefill
+# tokens when dividing workers between the phases.
+PD_DECODE_COST_FACTOR = 4.0
+
+
+def pd_worker_roles(dp: int, spec: str,
+                    prompt_token_rate: Optional[float] = None,
+                    decode_token_rate: Optional[float] = None) -> tuple:
+    """Size the prefill:decode worker split for ``--pd-ratio`` (README
+    "P/D disaggregation"): returns a dp-length role tuple
+    ``("prefill",)*P + ("decode",)*D``.
+
+    ``spec`` is either an explicit ``"P:D"`` ratio (scaled to dp, each
+    side floored at one worker) or ``"auto"``: split by each phase's
+    share of chip-seconds, computed from the observed prompt/decode
+    token mix when the caller has one (``*_token_rate``, tokens per
+    second offered to each phase) and from the BurstGPT-shaped default
+    (512-token prompts, 128-token replies) otherwise, with decode
+    tokens weighted PD_DECODE_COST_FACTOR heavier per token.
+
+    Raises ValueError with flag-spelling messages (CLI callers turn
+    them into usage errors before any model loads)."""
+    if dp < 2:
+        raise ValueError(
+            f"--pd-ratio needs dp >= 2 (got dp={dp}): the split puts "
+            "prefill and decode on different workers")
+    if spec == "auto":
+        p_rate = float(prompt_token_rate) if prompt_token_rate else 512.0
+        d_rate = float(decode_token_rate) if decode_token_rate else 128.0
+        share = p_rate / (p_rate + PD_DECODE_COST_FACTOR * d_rate)
+    else:
+        try:
+            p_part, d_part = (int(x) for x in spec.split(":"))
+        except ValueError:
+            raise ValueError(
+                f"--pd-ratio {spec!r}: expected 'auto' or 'P:D' "
+                "(e.g. '1:1', '1:3')")
+        if p_part < 1 or d_part < 1:
+            raise ValueError(
+                f"--pd-ratio {spec!r}: both sides must be >= 1")
+        share = p_part / (p_part + d_part)
+    n_prefill = max(1, min(dp - 1, round(dp * share)))
+    return ("prefill",) * n_prefill + ("decode",) * (dp - n_prefill)
+
+
 def resolve_model_and_checkpoint(model: str,
                                  checkpoint: Optional[str] = None):
     """(model_cfg, checkpoint_path) from a preset name, an HF checkpoint

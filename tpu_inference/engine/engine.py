@@ -328,6 +328,16 @@ class Sequence:
     # capped at 8x spec_probe_every), so a stream that never echoes
     # pays a vanishing fraction of its rounds re-checking.
     spec_probe_interval: int = 0
+    # P/D disaggregation (README "P/D disaggregation"). Outbound: a
+    # prefill-role worker sets handoff_after_prefill so the scheduler
+    # emits the settled prefill (KV pages incl. the partial final page
+    # + stream state) as a live handoff instead of decoding it locally.
+    # Inbound: adopt_kv = (host_pages, ctx_len) carries a received
+    # handoff; admission restores the pages straight into fresh device
+    # pages and resumes DECODE — no prefill dispatch, zero recomputed
+    # tokens (engine.adopt_sequence).
+    handoff_after_prefill: bool = False
+    adopt_kv: Optional[tuple] = None
 
     @property
     def last_token(self) -> int:
@@ -445,6 +455,26 @@ class InferenceEngine:
         self.migrate_out_bytes = 0
         self.migrate_in_pages = 0
         self.migrate_in_bytes = 0
+        # P/D disaggregation (README "P/D disaggregation"): the worker
+        # phase role this engine serves (specializes warmup below), and
+        # the live-handoff churn — settled prefills exported to a decode
+        # worker, and handed-off sequences adopted here (KV restored,
+        # decode resumed, nothing recomputed).
+        from tpu_inference.config import WORKER_ROLES
+        if engine_cfg.role not in WORKER_ROLES:
+            raise ValueError(f"unknown engine role {engine_cfg.role!r}; "
+                             f"one of {WORKER_ROLES}")
+        self.role = engine_cfg.role
+        self.handoffs_out = 0
+        self.handoff_out_pages = 0
+        self.adoptions_in = 0
+        # Handoffs this worker RECEIVED but could not adopt (malformed/
+        # truncated blob, pool shortfall at admission) — they fell back
+        # to recompute-resume. Folded into the fleet's
+        # tpu_inf_pd_handoff_recomputes_total so the metric's contract
+        # ("every non-clean handoff") holds for worker-side failures
+        # too, not just the router-side stale-blob/no-adopter paths.
+        self.adopt_fallbacks = 0
         # Cross-thread migration imports (the worker's import-kv RPC
         # lands on an RPC thread; the host tier is engine-thread only):
         # queued here, applied by the scheduler loop before admission so
@@ -856,7 +886,18 @@ class InferenceEngine:
         """
         t0 = time.perf_counter()
         ecfg = self.engine_cfg
-        for p in self._prefill_batch_sizes:
+        # Role-specialized warmup (README "P/D disaggregation"): a
+        # prefill worker never dispatches the decode ladder and a decode
+        # worker never dispatches a prompt prefill (adoption restores KV
+        # without a forward), so each role compiles only its own phase's
+        # graphs — per-role warmup drops to a fraction of the mixed
+        # compile set. The OTHER phase still works (lazy compile) so a
+        # degraded fleet's fallback routing never strands a request.
+        warm_prefill = self.role != "decode"
+        warm_decode = self.role != "prefill"
+        prefill_batch_sizes = (self._prefill_batch_sizes if warm_prefill
+                               else ())
+        for p in prefill_batch_sizes:
             bt = jnp.zeros((p, self.max_pages), jnp.int32)
             one = jnp.ones((p,), jnp.int32)
             zero = jnp.zeros((p,), jnp.int32)
@@ -898,6 +939,9 @@ class InferenceEngine:
                     jnp.ones((b,), jnp.float32), jnp.zeros((b,), jnp.int32),
                     jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
 
+        if not warm_decode:
+            jax.block_until_ready(self.kv)
+            return time.perf_counter() - t0
         if self.spec_draft:
             b = ecfg.max_batch_size
             out = self._spec_jit(
@@ -959,7 +1003,7 @@ class InferenceEngine:
                         jnp.zeros((b,), jnp.int32),
                         jnp.full((b, PENALTY_WINDOW), -1, jnp.int32))
                     self.kv = out.kv
-        if ecfg.hybrid_prefill and not self.spec_enabled:
+        if ecfg.hybrid_prefill and not self.spec_enabled and warm_prefill:
             # One hybrid graph per REACHABLE prefill bucket per ladder
             # rung (the decode half dispatches at the current rung), so
             # the first long prompt under mixed traffic doesn't pay an
@@ -1431,6 +1475,21 @@ class InferenceEngine:
     # import of a sibling replica's export into this engine's host tier.
     # ------------------------------------------------------------------
 
+    def _tokens_in_kv(self, seq: Sequence, drop_last: bool = False
+                      ) -> List[int]:
+        """The tokens actually resident in the sequence's KV pages, in
+        page order: the prefill stream under the same max_context
+        truncation the prefill used, plus the generated suffix
+        (``drop_last`` excludes the just-sampled token the cache
+        publish runs before writing back). The ONE stream
+        reconstruction shared by _publish_to_cache, export_sequence_kv,
+        and export_sequence_kv_live — their chain digests must never
+        diverge."""
+        base = self._prefill_tokens(seq)[-(self.engine_cfg.max_context
+                                           - 1):]
+        gen = seq.generated[seq.resume_base:]
+        return base + (gen[:-1] if drop_last else gen)
+
     def export_sequence_kv(self, seq: Sequence
                            ) -> Tuple[List[bytes], List["kvc.HostKVPage"]]:
         """Drain-time migration export: (chain digests, host page
@@ -1448,10 +1507,7 @@ class InferenceEngine:
         if not seq.pages or seq.ctx_len <= 0:
             return [], []
         ecfg = self.engine_cfg
-        # Mirror _publish_to_cache's stream reconstruction: the tokens
-        # actually resident in KV, in page order.
-        base = self._prefill_tokens(seq)[-(ecfg.max_context - 1):]
-        in_kv = (base + seq.generated[seq.resume_base:])[:seq.ctx_len]
+        in_kv = self._tokens_in_kv(seq)[:seq.ctx_len]
         digests = _chain_hashes(in_kv, ecfg.page_size)
         n = min(len(digests), len(seq.pages))
         run = 0
@@ -1463,6 +1519,86 @@ class InferenceEngine:
         self.migrate_out_pages += len(host)
         self.migrate_out_bytes += sum(hp.nbytes for hp in host)
         return digests[:run], host
+
+    def export_sequence_kv_live(self, seq: Sequence
+                                ) -> Tuple[List[bytes],
+                                           List["kvc.HostKVPage"], int]:
+        """P/D handoff export (README "P/D disaggregation"): the settled
+        KV of a LIVE sequence — (full-page chain digests, host page
+        copies, ctx_len). Unlike the drain export, the page list covers
+        EVERY page holding the first ctx_len tokens, INCLUDING the
+        partial final page: the destination restores it verbatim (its
+        trailing rows are dead weight no reader past ctx_len touches)
+        and resumes decode with zero recomputed tokens, where the
+        drain/migrate path stops at the last full page and recomputes
+        the remainder. Digests still cover only the full pages (a chain
+        digest is defined on full pages) for host-tier import fallback.
+
+        Returns ([], [], 0) when the sequence has no exportable KV
+        (empty, or SWA-evicted pages punch holes in the run) — the
+        caller then keeps the sequence local instead of handing off.
+        Engine thread only; the offload's device_get orders after any
+        in-flight dispatch by data dependency."""
+        from tpu_inference.engine.prefix_cache import _chain_hashes
+        if not seq.pages or seq.ctx_len <= 0:
+            return [], [], 0
+        ecfg = self.engine_cfg
+        n_pages = -(-seq.ctx_len // ecfg.page_size)
+        pages = seq.pages[:n_pages]
+        if len(pages) < n_pages or any(p == 0 for p in pages):
+            return [], [], 0
+        in_kv = self._tokens_in_kv(seq)[:seq.ctx_len]
+        digests = _chain_hashes(in_kv, ecfg.page_size)
+        host = self._offload_pages(pages)
+        self.handoffs_out += 1
+        self.handoff_out_pages += len(host)
+        return digests[:seq.ctx_len // ecfg.page_size], host, seq.ctx_len
+
+    def adopt_sequence(self, seq: Sequence) -> int:
+        """P/D handoff adoption (engine thread, at admission): restore
+        the handoff's KV pages (seq.adopt_kv, incl. the partial final
+        page) straight into freshly allocated device pages, bind a slot,
+        and resume DECODE — no prefill dispatch runs, so nothing is
+        recomputed and greedy continuation is byte-identical to the
+        mixed topology by construction (same pool bytes, same last
+        token). Raises on a malformed blob or pool shortfall; the
+        scheduler's fallback then clears adopt_kv and recompute-resumes
+        through the ordinary prefill path instead."""
+        host_pages, ctx_len = seq.adopt_kv
+        ecfg = self.engine_cfg
+        expected = -(-ctx_len // ecfg.page_size)
+        if ctx_len <= 0 or len(host_pages) != expected:
+            raise ValueError(
+                f"handoff blob has {len(host_pages)} pages for "
+                f"ctx_len={ctx_len} (need {expected})")
+        slot = self.free_slots()[0]
+        seq.admit_idx = self._admit_counter
+        self._admit_counter += 1
+        fresh = self._allocate_reclaiming(len(host_pages))
+        try:
+            self._restore_batch(fresh, host_pages)
+        except BaseException:
+            self.allocator.free(fresh)
+            raise
+        seq.pages = fresh
+        seq.pages_version += 1
+        seq.ctx_len = ctx_len
+        seq.slot = slot
+        seq.adopt_kv = None
+        # The whole resume stream (prompt + the tokens the handoff
+        # replays) arrives as settled KV or recorded tokens — nothing
+        # recomputes. cached_tokens reports exactly that to the
+        # router's reused-vs-recomputed accounting.
+        seq.cached_tokens = min(ctx_len + seq.resume_base,
+                                ecfg.max_context - 1)
+        seq.host_restored_pages += len(host_pages)
+        now = time.perf_counter()
+        seq.prefill_start = seq.prefill_start or now
+        seq.first_token_time = now
+        self.adoptions_in += 1
+        self.swap_in_resumes += 1
+        self.slots[slot] = seq
+        return slot
 
     def request_import_host(self, entries) -> threading.Event:
         """Queue migrated (digest, HostKVPage) entries for adoption into
@@ -1911,9 +2047,8 @@ class InferenceEngine:
         reuses them instead of re-prefilling."""
         if self.prefix_cache is None or not seq.pages:
             return
-        # Same truncation the prefill used, so tokens align with pages.
-        base = self._prefill_tokens(seq)[-(self.engine_cfg.max_context - 1):]
-        in_kv = base + seq.generated[seq.resume_base:-1]
+        # drop_last: the just-sampled token isn't written back yet.
+        in_kv = self._tokens_in_kv(seq, drop_last=True)
         # Reuse the request's one hash pass (router or admission): only
         # the generated-suffix pages are hashed here. Resume streams may
         # have shifted the truncation window — they rehash.

@@ -40,6 +40,9 @@ class SchedulerStats:
 
     steps: int = 0
     prefills: int = 0
+    # P/D disaggregation (README "P/D disaggregation"): settled prefills
+    # handed off to a decode worker instead of decoded locally.
+    pd_handoffs: int = 0
     tokens_generated: int = 0
     tokens_prefix_cached: int = 0      # prompt tokens served from KV reuse
     requests_finished: int = 0
@@ -100,6 +103,14 @@ class SchedulerStats:
             # at drain / imported from a sibling replica's drain.
             "migrate_out_pages": engine.migrate_out_pages,
             "migrate_in_pages": engine.migrate_in_pages,
+            # P/D disaggregation (README "P/D disaggregation"): this
+            # worker's phase role, prefills handed off to decode
+            # workers, and handed-off sequences adopted here (KV
+            # restored + decode resumed, zero recompute).
+            "role": engine.role,
+            "pd_handoffs": self.pd_handoffs,
+            "pd_adoptions": engine.adoptions_in,
+            "pd_adopt_fallbacks": engine.adopt_fallbacks,
             # Hybrid prefill-decode stepping (README "Scheduling"):
             # whether chunks fuse into decode dispatches, and how many
             # fused dispatches have run.
@@ -205,6 +216,14 @@ class EngineScheduler:
         self.step_inflight_since: Optional[float] = None
         self.on_step_ok: Optional[Callable[[], None]] = None
         self.on_step_error: Optional[Callable[[BaseException], None]] = None
+        # P/D disaggregation hook (set by a prefill-role worker): called
+        # on the engine thread when a sequence flagged
+        # handoff_after_prefill settles its prefill (first token already
+        # delivered). Returns True when the handoff was emitted — the
+        # sequence then finishes locally with reason "handoff" and the
+        # router resumes it on a decode worker; False keeps it decoding
+        # here (mixed fallback, e.g. nothing exportable).
+        self.on_prefill_handoff: Optional[Callable[[Sequence], bool]] = None
 
     # ---------------------------------------------- supervision plumbing
 
@@ -384,6 +403,17 @@ class EngineScheduler:
             tel.queue_wait_s.observe(
                 max(0.0, seq.prefill_start - seq.enqueue_time))
         pending.on_token(seq, seq.generated[-1])
+        if (not seq.done and seq.handoff_after_prefill
+                and self.on_prefill_handoff is not None):
+            # P/D disaggregation: the prefill settled — emit the live
+            # handoff (KV pages + stream state) instead of decoding on
+            # this worker. The first token above already streamed; the
+            # router replays it in the decode worker's resume record.
+            if self.on_prefill_handoff(seq):
+                self.stats.pd_handoffs += 1
+                seq.done = True
+                seq.finish_reason = "handoff"
+                seq.finish_time = time.perf_counter()
         if seq.done:
             self._finish(seq)
 
@@ -445,6 +475,7 @@ class EngineScheduler:
                     self._step_incremental_prefill()
         batch: List[_Pending] = []
         start_chunked: Optional[_Pending] = None
+        start_adopt: Optional[_Pending] = None
         reserved = 0
         with self._lock:
             engine = self.engine
@@ -480,6 +511,18 @@ class EngineScheduler:
                         and engine._free_plus_evictable()
                         < reserved + need + headroom):
                     break
+                if pending.seq.adopt_kv is not None:
+                    # P/D handoff adoption: no prefill dispatch — the KV
+                    # restore runs solo below (before _needs_chunking,
+                    # whose prompt+generated stream length would
+                    # misroute an adoptable sequence into chunking).
+                    if batch:
+                        break     # admit the plain batch first
+                    self._waiting.popleft()
+                    self._callbacks[pending.seq.request_id] = pending
+                    start_adopt = pending
+                    reserved += need
+                    break
                 if self._needs_chunking(pending.seq):
                     if self._prefilling is not None:
                         break     # one incremental prefill at a time
@@ -505,11 +548,40 @@ class EngineScheduler:
         if self.engine.host_pool is not None:
             with self._lock:
                 head = self._waiting[0] if self._waiting else None
-            if head is not None and not head.seq.done:
+            if (head is not None and not head.seq.done
+                    and head.seq.adopt_kv is None):
+                # (Adoptable heads skip the prefetch: their KV arrives
+                # with the handoff blob, not from the host tier.)
                 try:
                     self.engine.prefetch_host_hits(head.seq)
                 except Exception as exc:  # noqa: BLE001 — keep loop alive
                     self._log_step_error("host_prefetch", exc, [head.seq])
+        if start_adopt is not None:
+            seq = start_adopt.seq
+            try:
+                self.step_inflight_since = time.monotonic()
+                self.engine.adopt_sequence(seq)
+            except Exception as exc:  # noqa: BLE001 — keep the loop alive
+                # Malformed blob / pool shortfall: fall back to an
+                # ordinary recompute-resume (prompt + replayed tokens
+                # re-prefill; byte-identical under greedy) by clearing
+                # the adoption state and requeueing at the head.
+                self._log_step_error("handoff_adopt", exc, [seq])
+                self.engine.adopt_fallbacks += 1
+                seq.adopt_kv = None
+                with self._lock:
+                    self._callbacks.pop(seq.request_id, None)
+                    self._waiting.appendleft(start_adopt)
+                return admitted
+            finally:
+                self.step_inflight_since = None
+            self._note_ok()
+            # No token delivery and no prefill counters: every token in
+            # seq.generated was already streamed (the handoff's replay
+            # record), and no prefill dispatch ran.
+            if seq.done:              # cancelled while queued, raced
+                self._finish(seq)
+            return admitted + 1
         if start_chunked is not None:
             seq = start_chunked.seq
             try:

@@ -99,6 +99,43 @@ def main() -> None:
                    help="subprocess fleet: disable drain-time KV page "
                         "migration (resubmissions re-prefill from "
                         "scratch — the benchmark comparison arm)")
+    p.add_argument("--role", default="mixed",
+                   choices=("prefill", "decode", "mixed"),
+                   help="uniform worker phase role (README 'P/D "
+                        "disaggregation'): 'prefill' workers serve "
+                        "prompt prefills and hand each settled prefill "
+                        "(KV pages + stream state) off to a decode "
+                        "worker — no re-prefill, byte-identical under "
+                        "greedy; 'decode' workers adopt handoffs and "
+                        "decode at high occupancy with zero prefill "
+                        "interference; 'mixed' (default) runs both "
+                        "phases on every worker, unchanged from "
+                        "pre-P/D behavior. Needs --fleet subprocess "
+                        "when not 'mixed'")
+    p.add_argument("--roles", default=None,
+                   help="per-worker phase roles, comma-separated, one "
+                        "per dp replica (e.g. 'prefill,decode,decode') "
+                        "— overrides --role; needs --fleet subprocess")
+    p.add_argument("--pd-ratio", default=None,
+                   help="size the prefill:decode worker split over dp: "
+                        "'P:D' (e.g. '1:3') or 'auto' (split by each "
+                        "phase's chip-seconds share from the expected "
+                        "prompt/decode token mix — engine/autosize.py "
+                        "pd_worker_roles); overrides --role, mutually "
+                        "exclusive with --roles; needs --fleet "
+                        "subprocess and dp >= 2")
+    p.add_argument("--pd-prompt-rate", type=float, default=None,
+                   help="with --pd-ratio auto: observed prompt tokens/s "
+                        "offered to the fleet (default: the BurstGPT-"
+                        "shaped 512-token-prompt mix)")
+    p.add_argument("--pd-decode-rate", type=float, default=None,
+                   help="with --pd-ratio auto: observed decode tokens/s "
+                        "(default: 128-token replies)")
+    p.add_argument("--pd-prefill-nice", type=int, default=0,
+                   help="os.nice() increment for prefill-role worker "
+                        "processes (shared-CPU hosts: keeps decode "
+                        "cadence flat under prefill bursts; no-op on "
+                        "per-chip deployments or at 0)")
     p.add_argument("--attn-backend", default="auto",
                    choices=("auto", "dense", "pallas"),
                    help="decode attention: Pallas paged kernel (TPU) or "
@@ -311,6 +348,41 @@ def main() -> None:
                 "(workers boot their own params; use --spec-mode ngram "
                 "or the in-process fleet)")
 
+    # P/D disaggregation (README "P/D disaggregation"): resolve the
+    # per-worker role tuple from --roles > --pd-ratio > --role before
+    # any model loads, so a bad split is a usage error in milliseconds.
+    if args.roles and args.pd_ratio:
+        p.error("--roles and --pd-ratio both name the worker split; "
+                "pick one")
+    from tpu_inference.config import resolve_worker_roles
+
+    worker_roles: tuple = ()
+    try:
+        if args.roles:
+            worker_roles = resolve_worker_roles(
+                args.dp, tuple(r.strip() for r in args.roles.split(",")))
+        elif args.pd_ratio:
+            from tpu_inference.engine.autosize import pd_worker_roles
+
+            worker_roles = pd_worker_roles(
+                args.dp, args.pd_ratio,
+                prompt_token_rate=args.pd_prompt_rate,
+                decode_token_rate=args.pd_decode_rate)
+        elif args.role != "mixed":
+            worker_roles = resolve_worker_roles(
+                args.dp, (), default_role=args.role)
+    except ValueError as e:
+        p.error(str(e))
+    if any(r != "mixed" for r in worker_roles):
+        if args.fleet != "subprocess":
+            p.error("--role/--roles/--pd-ratio need --fleet subprocess "
+                    "(the live KV handoff moves pages between worker "
+                    "processes)")
+        import sys
+
+        print(f"[pd] worker roles: {list(worker_roles)}",
+              file=sys.stderr)
+
     from tpu_inference.engine.autosize import resolve_sizing_args
 
     max_batch_size, num_pages = resolve_sizing_args(args)
@@ -361,6 +433,8 @@ def main() -> None:
                               route_host_hit_weight=(
                                   args.route_host_hit_weight),
                               fleet=args.fleet,
+                              worker_roles=worker_roles,
+                              pd_prefill_nice=args.pd_prefill_nice,
                               worker_restart_max=args.worker_restart_max,
                               drain_timeout_s=args.drain_timeout_s,
                               fleet_migrate=not args.no_fleet_migrate,

@@ -221,7 +221,8 @@ class _Tracked:
 
     __slots__ = ("template", "on_token", "on_finish", "worker", "client",
                  "generation", "attempts", "tokens", "seq_local",
-                 "resume_stream_len", "t_submit")
+                 "resume_stream_len", "t_submit", "handoff_blob",
+                 "handoff_meta")
 
     def __init__(self, template: Sequence, on_token, on_finish):
         self.template = template
@@ -240,6 +241,14 @@ class _Tracked:
         # replayed generated), for the migrated-vs-recomputed accounting.
         self.resume_stream_len = 0
         self.t_submit = time.perf_counter()
+        # P/D handoff state (README "P/D disaggregation"): the prefill
+        # worker's live KV export (wire blob + {ctx_len, n_generated}).
+        # Kept across retries — valid whenever the router's token record
+        # still matches n_generated, so a decode-worker death right
+        # after a handoff can re-adopt elsewhere; once decode advanced
+        # past the export, resubmission falls back to recompute-resume.
+        self.handoff_blob: Optional[bytes] = None
+        self.handoff_meta: Optional[dict] = None
 
 
 class _EngineInfo:
@@ -269,6 +278,24 @@ class ProcessEngineGroup:
         self.server_cfg = cfg.server
         self.engine_cfg = cfg.engine
         self.dp = max(1, pcfg.dp)
+        # Worker phase roles (README "P/D disaggregation"): one per
+        # replica, "mixed" everywhere unless ServerConfig.worker_roles /
+        # EngineConfig.role say otherwise. pd_enabled gates the phase-
+        # aware routing below; an all-mixed fleet behaves exactly as
+        # before.
+        from tpu_inference.config import resolve_worker_roles
+        self.roles = resolve_worker_roles(self.dp,
+                                          cfg.server.worker_roles,
+                                          default_role=cfg.engine.role)
+        self.pd_enabled = any(r != "mixed" for r in self.roles)
+        if self.pd_enabled and (
+                all(r == "decode" for r in self.roles)
+                or all(r == "prefill" for r in self.roles)):
+            telemetry.log_event(
+                "pd_roles_one_sided", level="warning",
+                roles=list(self.roles),
+                note="a P/D split needs both phases; this fleet will "
+                     "serve via the fallback pools (lazy compiles)")
         self.workers = [WorkerHandle(i) for i in range(self.dp)]
         self._sock_dir = tempfile.mkdtemp(prefix="tpuinf-fleet-")
         self._started = False
@@ -295,6 +322,18 @@ class ProcessEngineGroup:
         self.resume_resubmits = 0       # resume-replay resubmissions
         self.resume_recomputed_tokens = 0
         self.resume_reused_tokens = 0
+        # P/D disaggregation counters: handoff events received, and
+        # handoffs whose resubmission had to recompute (stale blob /
+        # no adopter) instead of adopting cleanly.
+        self.pd_handoffs = 0
+        self.pd_handoff_recomputes = 0
+        # Fan-out pool for the concurrent candidate peeks. Created
+        # eagerly (threads only spawn on first submit): lazy creation
+        # under concurrent HTTP submits would race and leak the losing
+        # executor's threads.
+        from concurrent.futures import ThreadPoolExecutor
+        self._peek_pool = ThreadPoolExecutor(
+            max_workers=max(4, self.dp), thread_name_prefix="fleet-peek")
         self._rr = 0
         self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0,
                               "host_hit_pages": 0}
@@ -353,7 +392,26 @@ class ProcessEngineGroup:
                   "Tokens served from cache tiers (incl. migrated "
                   "pages) during fleet resubmission resumes",
                   fn=lambda: self.resume_reused_tokens)
+        r.counter("tpu_inf_pd_handoffs_total",
+                  "Prefill->decode live KV handoffs routed (README "
+                  "'P/D disaggregation')",
+                  fn=lambda: self.pd_handoffs)
+        r.counter("tpu_inf_pd_handoff_recomputes_total",
+                  "Handoffs that fell back to recompute-resume (stale "
+                  "export, no adopter, or a worker-side adoption "
+                  "failure) instead of a clean adoption",
+                  fn=self._pd_recomputes_total)
+        self._pd_handoff_s_hist = r.histogram(
+            "tpu_inf_pd_handoff_seconds",
+            "Prefill->decode handoff wall: worker-side KV export + "
+            "router-side routing/dispatch until the decode worker "
+            "accepted the resume")
         for h in self.workers:
+            r.gauge("tpu_inf_worker_role_info",
+                    "Worker phase role (constant 1; the role is the "
+                    "label)",
+                    fn=lambda: 1.0, replica=str(h.replica),
+                    role=self.roles[h.replica])
             r.gauge("tpu_inf_replica_routable",
                     "1 when the worker accepts traffic",
                     fn=lambda hh=h: float(hh.routable),
@@ -370,7 +428,7 @@ class ProcessEngineGroup:
 
     # ----------------------------------------------------------- spawn
 
-    def _envelope(self) -> dict:
+    def _envelope(self, replica: int) -> dict:
         import jax
 
         pcfg = self.cfg.parallel
@@ -379,6 +437,14 @@ class ProcessEngineGroup:
             "platform": jax.default_backend(),
             "cpu_devices": max(1, pcfg.tp * pcfg.sp),
             "warmup": self.cfg.server.warmup,
+            # Per-worker phase role: the one envelope field that differs
+            # between replicas (README "P/D disaggregation").
+            "role": self.roles[replica],
+            # Shared-CPU hosts: deprioritize the prefill tier so decode
+            # cadence stays flat under prefill bursts (ServerConfig.
+            # pd_prefill_nice; no-op at 0 or on per-chip deployments).
+            "nice": (self.cfg.server.pd_prefill_nice
+                     if self.roles[replica] == "prefill" else 0),
         }
 
     def _spawn(self, h: WorkerHandle) -> None:
@@ -399,7 +465,8 @@ class ProcessEngineGroup:
             stdin=subprocess.PIPE, env=env)
         try:
             assert proc.stdin is not None
-            proc.stdin.write(json.dumps(self._envelope()).encode())
+            proc.stdin.write(json.dumps(
+                self._envelope(h.replica)).encode())
             proc.stdin.close()
             client = WorkerClient(h.socket_path, proc)
             client.on_event = lambda c, obj, blob, hh=h: self._on_event(
@@ -458,6 +525,9 @@ class ProcessEngineGroup:
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         self._stopping = True
+        if self._peek_pool is not None:
+            self._peek_pool.shutdown(wait=False)
+            self._peek_pool = None
         self._monitor_stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
@@ -621,13 +691,78 @@ class ProcessEngineGroup:
             seq.prefix_digests = _chain_hashes(prompt, ecfg.page_size)
         return seq.prefix_digests[:cap], prompt_pages
 
-    def _peek(self, h: WorkerHandle, digests: List[bytes]) -> dict:
+    def _pd_recomputes_total(self) -> int:
+        """Every non-clean handoff, both ends: router-side fallbacks
+        (stale export, no adopter) plus worker-side adoption failures
+        (malformed blob, pool shortfall) from the workers' cached
+        stats — the ONE number tpu_inf_pd_handoff_recomputes_total and
+        the supervision view report."""
+        return self.pd_handoff_recomputes + sum(
+            (h.last_stats or {}).get("pd_adopt_fallbacks", 0)
+            for h in self.workers)
+
+    def _cold_peek(self, h: WorkerHandle) -> dict:
+        """Scoring fallback for a worker that can't answer a peek in
+        time: no warmth, router-side load estimate, no pressure."""
+        return {"hbm": 0, "host": 0, "load": self._fleet_load(h),
+                "pressure": False, "occupancy": 0.0, "backlog": 0,
+                "role": self.roles[h.replica]}
+
+    def _peek(self, h: WorkerHandle, digests: List[bytes],
+              timeout: float = 10.0) -> dict:
+        client = h.client
+        if client is None:
+            return self._cold_peek(h)
         try:
-            return h.client.rpc("peek", timeout=10.0,
-                                digests=[d.hex() for d in digests])
+            return client.rpc("peek", timeout=timeout,
+                              digests=[d.hex() for d in digests])
         except (WorkerGone, TimeoutError, RuntimeError):
-            return {"hbm": 0, "host": 0, "load": self._fleet_load(h),
-                    "pressure": False}
+            return self._cold_peek(h)
+
+    def _peek_many(self, cands: List[WorkerHandle],
+                   digests: List[bytes]) -> List[dict]:
+        """Concurrent candidate peeks with a short fan-out deadline
+        (ServerConfig.route_peek_timeout_s): the serial loop used to add
+        one slow worker's full round-trip to EVERY admission; now the
+        peeks fly together and any straggler scores with the cold
+        fallback while its late reply is discarded (the RPC layer's own
+        timeout reaps it)."""
+        pool = self._peek_pool
+        if len(cands) == 1 or self._stopping or pool is None:
+            return [self._peek(h, digests) for h in cands]
+        from concurrent.futures import wait as _futures_wait
+        deadline = self.server_cfg.route_peek_timeout_s
+        # The RPC itself is clamped near the fan-out deadline: a wedged
+        # worker's straggler threads otherwise block 10s each and can
+        # saturate the small pool, cold-scoring HEALTHY candidates too.
+        try:
+            futs = [pool.submit(self._peek, h, digests, deadline + 0.5)
+                    for h in cands]
+        except RuntimeError:        # pool shut down by a racing stop()
+            return [self._peek(h, digests) for h in cands]
+        _futures_wait(futs, timeout=deadline)
+        return [f.result() if f.done() else self._cold_peek(h)
+                for h, f in zip(cands, futs)]
+
+    def _phase_pool(self, phase: Optional[str]) -> List[WorkerHandle]:
+        """Routable workers eligible for one phase (README "P/D
+        disaggregation"): new prompts ("prefill") avoid decode-role
+        workers, resumes/handoffs ("decode") avoid prefill-role workers.
+        An empty phase pool falls back to every routable worker so a
+        degraded fleet still serves (the off-role worker lazy-compiles
+        the other phase's graphs)."""
+        routable = self._routable()
+        if not self.pd_enabled or phase is None:
+            return routable
+        exclude = "decode" if phase == "prefill" else "prefill"
+        return ([h for h in routable
+                 if self.roles[h.replica] != exclude] or routable)
+
+    @staticmethod
+    def _entry_phase(entry: "_Tracked") -> str:
+        """Routing phase for a resubmission: a stream with tokens is
+        decode work; a zero-delivery retry re-enters as a prompt."""
+        return "decode" if entry.tokens else "prefill"
 
     def _rotate(self, ties: list):
         if len(ties) == 1:
@@ -637,19 +772,47 @@ class ProcessEngineGroup:
         return ties[idx]
 
     def _pick(self, cands: List[WorkerHandle],
-              seq: Optional[Sequence] = None
+              seq: Optional[Sequence] = None,
+              phase: Optional[str] = None
               ) -> Tuple[WorkerHandle, Tuple[int, int], int]:
         """Choose a worker; returns (handle, (hbm, host) peeked pages,
-        load at decision time). Same three-temperature scoring formula
-        as EngineGroup._pick (replicas.py — the in-process fleet is the
-        documented contract), with worker state fetched over the peek
-        RPC instead of read off a scheduler object."""
+        load at decision time). Candidate peeks fan out concurrently
+        (_peek_many). For prefill work (and the mixed fleet) the score
+        is the same three-temperature formula as EngineGroup._pick
+        (replicas.py — the in-process fleet is the documented contract):
+        queue depth + prompt pages minus the prefix peek. For
+        ``phase="decode"`` under a P/D split the score flips to the
+        decode side's costs — ladder occupancy + load, minus host-warm
+        pages (a handoff lands on the least-loaded decode worker, warmth
+        breaking ties):
+
+            route_load_pages * load
+              + route_occupancy_pages * ladder_occupancy
+              - route_hit_weight * hbm - route_host_hit_weight * host
+              (+ a pressure penalty)
+        """
         cfg = self.server_cfg
         digests: List[bytes] = []
         prompt_pages = 0
         if seq is not None and cfg.routing == "prefix_affinity":
             digests, prompt_pages = self._digests_for(seq)
-        peeks = [self._peek(h, digests) for h in cands]
+        peeks = self._peek_many(cands, digests)
+        if phase == "decode" and self.pd_enabled:
+            scored = []
+            for h, p in zip(cands, peeks):
+                occ = float(p.get("occupancy") or 0.0)
+                score = (cfg.route_load_pages * p["load"]
+                         + cfg.route_occupancy_pages * occ
+                         - cfg.route_hit_weight * p["hbm"]
+                         - cfg.route_host_hit_weight * p["host"])
+                if p["pressure"]:
+                    score += cfg.route_occupancy_pages + 1
+                scored.append(((score, p["pressure"], p["load"]),
+                               h, (p["hbm"], p["host"]), p["load"]))
+            best = min(key for key, _, _, _ in scored)
+            return self._rotate([(h, hit, load)
+                                 for key, h, hit, load in scored
+                                 if key == best])
         if digests and any(p["hbm"] + p["host"] for p in peeks):
             scored = []
             for h, p in zip(cands, peeks):
@@ -674,18 +837,23 @@ class ProcessEngineGroup:
 
     def submit(self, seq: Sequence, on_token: Callable,
                on_finish: Callable) -> None:
-        routable = self._routable()
-        if not routable:
+        # New prompts are prefill work: under a P/D split they go to the
+        # prefill tier only (README "P/D disaggregation"). ONE snapshot
+        # of the routable set — a worker dying between an emptiness
+        # check and a second _routable() read must not hand _pick an
+        # empty pool.
+        pool = self._phase_pool("prefill")
+        if not pool:
             with self._lock:
                 self.requests_unavailable += 1
             raise FleetUnavailable("no routable worker",
                                    self.server_cfg.retry_after_s)
-        h, hit, load = self._pick(routable, seq)
+        h, hit, load = self._pick(pool, seq)
         cap = self.server_cfg.admission_queue_depth
         if cap > 0 and load >= cap:
             # Affinity saturated a warm worker: least-loaded fallback
             # before shedding, exactly like EngineGroup.submit.
-            h2, _, load2 = self._pick(routable)
+            h2, _, load2 = self._pick(pool)
             if load2 >= cap:
                 with self._lock:
                     self.requests_shed += 1
@@ -751,8 +919,27 @@ class ProcessEngineGroup:
             "attempt": entry.attempts,
             "generated": gen_tokens,
         }
+        blob = b""
+        meta = entry.handoff_meta
+        if meta is not None:
+            if (entry.handoff_blob
+                    and len(gen_tokens) == meta["n_generated"]):
+                # Live handoff resume: the worker adopts the exported KV
+                # (incl. the partial final page) and continues decode
+                # with zero recomputed tokens.
+                payload["handoff"] = {"ctx_len": meta["ctx_len"]}
+                blob = entry.handoff_blob
+            else:
+                # Decode advanced past the export (the blob was dropped
+                # at the first post-handoff token, or the length no
+                # longer matches — e.g. the adopter died mid-stream):
+                # fall back to recompute-resume from the router's token
+                # record, byte-identical under greedy.
+                entry.handoff_blob = entry.handoff_meta = None
+                with self._lock:
+                    self.pd_handoff_recomputes += 1
         try:
-            h.client.rpc("submit", timeout=60.0, seq=payload)
+            h.client.rpc("submit", timeout=60.0, seq=payload, blob=blob)
             return True
         except TimeoutError:
             # The RPC may still be QUEUED behind a busy reader thread:
@@ -781,10 +968,13 @@ class ProcessEngineGroup:
                     # again here would run the request twice.
                     return
                 entry.worker = entry.client = None
-        others = [h for h in self._routable() if h is not exclude]
-        pool = others or self._routable()
+        phase = self._entry_phase(entry)
+        pool = [h for h in self._phase_pool(phase) if h is not exclude]
+        if not pool:
+            pool = ([h for h in self._routable() if h is not exclude]
+                    or self._routable())
         if pool:
-            h, hit, _ = self._pick(pool, entry.template)
+            h, hit, _ = self._pick(pool, entry.template, phase=phase)
             if self._dispatch(entry, h, hit):
                 return
         rid = entry.template.request_id
@@ -822,12 +1012,14 @@ class ProcessEngineGroup:
     def _on_event(self, h: WorkerHandle, client: WorkerClient,
                   obj: dict, blob: bytes) -> None:
         ev = obj.get("ev")
-        if self._stopping and ev in ("migrate", "drained"):
+        if self._stopping and ev in ("migrate", "drained", "handoff"):
             return      # teardown: no re-routing onto closing workers
         if ev == "token":
             self._on_token(h, client, obj)
         elif ev == "finish":
             self._on_finish(h, client, obj)
+        elif ev == "handoff":
+            self._on_handoff(h, client, obj, blob)
         elif ev == "migrate":
             self._on_migrate(h, client, obj, blob)
         elif ev == "drained":
@@ -848,6 +1040,16 @@ class ProcessEngineGroup:
                 return
             tok = int(obj["t"])
             entry.tokens.append(tok)
+            meta = entry.handoff_meta
+            if (entry.handoff_blob is not None and meta is not None
+                    and len(entry.tokens) > meta["n_generated"]):
+                # The adopter streamed past the export: the blob can
+                # never be dispatched again (a re-adoption would fork
+                # the stream) — drop it now rather than pinning
+                # megabytes of dead KV for the stream's lifetime. The
+                # small meta stays so a later failover still counts as
+                # a handoff recompute in _dispatch.
+                entry.handoff_blob = None
             sl = entry.seq_local
             sl.generated.append(tok)
             if sl.first_token_time == 0.0:
@@ -865,7 +1067,8 @@ class ProcessEngineGroup:
                          and not entry.tokens
                          and entry.attempts
                          < self.server_cfg.failover_max_retries)
-            pool = ([w for w in self._routable() if w is not h]
+            # Zero-delivery retries replay from the prompt: prefill work.
+            pool = ([w for w in self._phase_pool("prefill") if w is not h]
                     or self._routable()) if retryable else []
             if pool:
                 entry.attempts += 1
@@ -906,6 +1109,63 @@ class ProcessEngineGroup:
                 sl.first_token_time - float(obj["prefill_s"]))
         entry.on_finish(sl)
 
+    def _on_handoff(self, h, client, obj, blob) -> None:
+        """A prefill worker settled a prompt's prefill and exported the
+        LIVE sequence (README "P/D disaggregation"): KV pages including
+        the partial final page, plus the stream state the router already
+        tracks. Route it to the least-loaded decode worker and resume
+        there as an adoption — no re-prefill, zero recomputed tokens on
+        the clean path; every failure mode degrades to the existing
+        recompute-resume machinery (byte-identical under greedy)."""
+        rid = obj["rid"]
+        t0 = time.perf_counter()
+        with self._lock:
+            entry = self._entry_for(rid, h, client)
+            if entry is None:
+                return
+            entry.generation += 1
+            # DETACH under the lock (the _on_migrate claim pattern): a
+            # racing worker-down failover must not double-resubmit.
+            entry.worker = entry.client = None
+            entry.attempts += 1
+            self.pd_handoffs += 1
+        n_gen = int(obj.get("n_generated", 0))
+        entry.handoff_meta = {"ctx_len": int(obj.get("ctx_len", 0)),
+                              "n_generated": n_gen}
+        entry.handoff_blob = blob or None
+        if n_gen != len(entry.tokens):
+            # Out of sync with the export (events are FIFO per
+            # connection, so this should not happen): recompute-resume.
+            telemetry.log_event(
+                "handoff_token_mismatch", level="warning",
+                request_id=entry.template.trace_id or str(rid),
+                worker_generated=n_gen,
+                router_streamed=len(entry.tokens))
+            entry.handoff_blob = entry.handoff_meta = None
+            with self._lock:
+                self.pd_handoff_recomputes += 1
+        pool = [w for w in self._phase_pool("decode") if w is not h]
+        if not pool:
+            pool = ([w for w in self._routable() if w is not h]
+                    or self._routable())
+        if not pool:
+            self._retry_or_fail(entry)     # already claimed above
+            return
+        dest, hit, _ = self._pick(pool, entry.template, phase="decode")
+        telemetry.log_event(
+            "request_handoff", level="info",
+            request_id=entry.template.trace_id or str(rid),
+            source=h.replica, dest=dest.replica,
+            ctx_len=entry.handoff_meta["ctx_len"]
+            if entry.handoff_meta else 0,
+            streamed=len(entry.tokens))
+        if self._dispatch(entry, dest, hit):
+            self._pd_handoff_s_hist.observe(
+                float(obj.get("export_s") or 0.0)
+                + time.perf_counter() - t0)
+        else:
+            self._retry_or_fail(entry, exclude=dest)
+
     def _on_migrate(self, h, client, obj, blob) -> None:
         """A draining worker exported one in-flight request: import its
         KV pages into a destination worker's host tier and resubmit with
@@ -934,14 +1194,16 @@ class ProcessEngineGroup:
                 request_id=entry.template.trace_id or str(rid),
                 worker_generated=n_gen, router_streamed=len(entry.tokens))
         digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
-        others = [w for w in self._routable() if w is not h]
+        phase = self._entry_phase(entry)
+        others = ([w for w in self._phase_pool(phase) if w is not h]
+                  or [w for w in self._routable() if w is not h])
         if not others:
             # No exclude: this entry is already claimed (detached) by
             # the block above and no dispatch was attempted — the guard
             # in _retry_or_fail only applies after a failed dispatch.
             self._retry_or_fail(entry)
             return
-        dest, hit, _ = self._pick(others, entry.template)
+        dest, hit, _ = self._pick(others, entry.template, phase=phase)
         if (blob and digests and self.server_cfg.fleet_migrate
                 and dest.client is not None):
             try:
@@ -999,7 +1261,9 @@ class ProcessEngineGroup:
                 self.retries_attempted += 1
                 self.failovers += 1
         for entry in victims:
-            others = [w for w in self._routable() if w is not h]
+            phase = self._entry_phase(entry)
+            others = ([w for w in self._phase_pool(phase) if w is not h]
+                      or [w for w in self._routable() if w is not h])
             if not others:
                 rid = entry.template.request_id
                 with self._lock:
@@ -1009,7 +1273,7 @@ class ProcessEngineGroup:
                 ghost.finish_time = time.perf_counter()
                 entry.on_finish(ghost)
                 continue
-            dest, hit, _ = self._pick(others, entry.template)
+            dest, hit, _ = self._pick(others, entry.template, phase=phase)
             telemetry.log_event(
                 "request_failover", level="warning",
                 request_id=(entry.template.trace_id
@@ -1109,6 +1373,17 @@ class ProcessEngineGroup:
                 # Process-fleet extras (README "Process fleet").
                 "fleet": "subprocess",
                 "worker_restarts": sum(h.restarts for h in self.workers),
+                # P/D disaggregation (README "P/D disaggregation").
+                "roles": list(self.roles),
+                "pd_handoffs": self.pd_handoffs,
+                "pd_handoff_recomputes": self._pd_recomputes_total(),
+                "pd_adoptions": sum(d.get("pd_adoptions", 0)
+                                    for d in stats),
+                # Router-side handoff wall as a diffable phase snapshot
+                # (the engine "phases" shape): a handoff stall shows up
+                # here without log-diving.
+                "phases": {"pd_handoff_s":
+                           self._pd_handoff_s_hist.phase_snapshot()},
                 "migrations": self.migrations,
                 "migrated_pages": self.migrated_pages,
                 "migrated_bytes": self.migrated_bytes,
@@ -1133,6 +1408,7 @@ class ProcessEngineGroup:
             d = {
                 "state": ("healthy" if h.state == UP else h.state),
                 "worker_state": h.state,
+                "role": self.roles[h.replica],
                 "pid": h.pid,
                 "uptime_s": (round(time.time() - h.started_unix, 3)
                              if h.started_unix and h.state == UP
@@ -1143,7 +1419,9 @@ class ProcessEngineGroup:
             }
             for k in ("pool_pressure", "under_pressure", "preemptions",
                       "load", "draining", "host_cache",
-                      "swap_in_resumes"):
+                      "swap_in_resumes", "prefill_backlog",
+                      "ladder_occupancy", "pd_handoffs", "pd_adoptions",
+                      "pd_adopt_fallbacks"):
                 if k in hz:
                     d[k] = hz[k]
             replicas.append(d)

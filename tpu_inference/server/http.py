@@ -88,6 +88,13 @@ def build_engine_group(cfg: FrameworkConfig, load_params=None,
     if cfg.server.fleet != "in-process":
         raise ValueError(f"unknown fleet backend {cfg.server.fleet!r}; "
                          "one of ('in-process', 'subprocess')")
+    if (any(r != "mixed" for r in cfg.server.worker_roles)
+            or cfg.engine.role != "mixed"):
+        raise ValueError(
+            "P/D worker roles (--role/--roles/--pd-ratio) need "
+            "--fleet subprocess: the live KV handoff moves pages "
+            "between worker PROCESSES (README 'P/D disaggregation'); "
+            "the in-process fleet serves every replica mixed")
     pcfg = cfg.parallel
     if pcfg.dp <= 1:
         meshes = [build_mesh(pcfg) if pcfg.n_devices > 1 else None]

@@ -834,8 +834,10 @@ def aggregate_replica_stats(per: List[dict], supervision: dict) -> dict:
                       and not isinstance(base, bool) else 0) + v
     # Replica 0's health dict would masquerade as the fleet's;
     # per-replica health lives under "replicas", fleet under
-    # "supervision".
+    # "supervision". Same for the phase role — a P/D fleet's replicas
+    # differ by design, and supervision carries the full role list.
     agg.pop("health", None)
+    agg.pop("role", None)
     # Fleet phase histograms = element-wise bucket merge across
     # replicas (replica 0's copy would otherwise masquerade as the
     # fleet's); per-replica views stay under "replicas".
