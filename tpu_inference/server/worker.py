@@ -165,6 +165,12 @@ class EngineWorker:
         self.cfg = cfg
         self.replica = replica
         self.socket_path = socket_path
+        # Phase role (README "P/D disaggregation"): the router ships a
+        # per-worker role in the envelope (main() folds it into
+        # cfg.engine.role before construction). "prefill" workers hand
+        # each settled prefill off instead of decoding it; "decode"
+        # workers adopt handoffs; "mixed" is the pre-P/D behavior.
+        self.role = cfg.engine.role
         self.do_warmup = warmup
         self.warmup_s = 0.0
         self.started_unix = time.time()
@@ -209,6 +215,8 @@ class EngineWorker:
         self.engine = InferenceEngine(cfg.model, cfg.engine, params=params,
                                       seed=cfg.seed, mesh=mesh)
         self.sched = EngineScheduler(self.engine)
+        if self.role == "prefill":
+            self.sched.on_prefill_handoff = self._emit_handoff
         if self.do_warmup:
             self.warmup_s = self.engine.warmup()
         self.sched.start()
@@ -295,11 +303,45 @@ class EngineWorker:
 
     # ------------------------------------------------------------ verbs
 
+    def _emit_handoff(self, seq) -> bool:
+        """Scheduler hook (engine thread, prefill role): export the
+        settled live sequence and push it to the submitting router
+        connection as a ``handoff`` event — the router imports/adopts it
+        on a decode worker and the stream continues there. Returns False
+        (sequence keeps decoding locally, the mixed fallback) when the
+        connection is gone or nothing is exportable (e.g. SWA-evicted
+        pages)."""
+        from tpu_inference import telemetry
+        from tpu_inference.engine import kv_cache as kvc
+        conn = self._req_conn.get(seq.request_id)
+        if conn is None or not conn.alive or self.draining:
+            return False
+        t0 = time.perf_counter()
+        try:
+            digests, pages, ctx_len = \
+                self.engine.export_sequence_kv_live(seq)
+        except Exception as e:  # noqa: BLE001 — fall back to local decode
+            telemetry.log_event("handoff_export_failed", level="warning",
+                                request_id=seq.trace_id
+                                or str(seq.request_id), error=str(e))
+            return False
+        if not pages:
+            return False
+        blob = kvc.serialize_host_pages(pages)
+        self._req_conn.pop(seq.request_id, None)
+        conn.send({"ev": "handoff", "rid": seq.request_id,
+                   "n_generated": len(seq.generated),
+                   "ctx_len": ctx_len,
+                   "export_s": round(time.perf_counter() - t0, 6),
+                   "digests": [d.hex() for d in digests]}, blob)
+        return True
+
     def _verb_hello(self, conn, obj, blob) -> dict:
         e = self.engine
         return {
             "pid": os.getpid(),
             "replica": self.replica,
+            "role": self.role,
             "uptime_s": round(time.time() - self.started_unix, 3),
             "warmup_s": round(self.warmup_s, 3),
             "n_params": e.n_params,
@@ -341,6 +383,28 @@ class EngineWorker:
             # import make it a swap-in-resume) and decode continues.
             seq.generated = list(generated)
             seq.resume_base = len(generated)
+        handoff = s.get("handoff")
+        if handoff and blob and generated:
+            # P/D handoff resume (README "P/D disaggregation"): the blob
+            # carries the prefill worker's settled KV pages (incl. the
+            # partial final page); admission adopts them directly — no
+            # re-prefill, zero recomputed tokens. A malformed blob falls
+            # back to the recompute-resume path above at adoption time.
+            from tpu_inference.engine import kv_cache as kvc
+            try:
+                pages = kvc.deserialize_host_pages(blob)
+            except Exception:  # noqa: BLE001 — recompute-resume fallback
+                pages = []
+            if pages:
+                seq.adopt_kv = (pages, int(handoff.get("ctx_len", 0)))
+            else:
+                self.engine.adopt_fallbacks += 1
+        if self.role == "prefill" and seq.adopt_kv is None:
+            # Prefill-role workers hand every prefill they settle off to
+            # the decode tier (adoptions skip _prefill_done, so an
+            # adopted fallback landing here decodes locally instead of
+            # bouncing forever).
+            seq.handoff_after_prefill = True
         rid = seq.request_id
         self._req_conn[rid] = conn
 
@@ -349,6 +413,11 @@ class EngineWorker:
 
         def on_finish(sq) -> None:
             self._req_conn.pop(rid, None)
+            if sq.finish_reason == "handoff":
+                # The handoff event already left on this connection and
+                # IS the request's continuation — a finish frame here
+                # would terminate the client stream mid-generation.
+                return
             fin = sq.finish_time or time.perf_counter()
             first = sq.first_token_time or fin
             start = sq.prefill_start or first
@@ -382,7 +451,18 @@ class EngineWorker:
         if pc is not None and digests:
             hbm, host = pc.peek_digests_tiered(digests)
         return {"hbm": hbm, "host": host, "load": self.sched.load,
-                "pressure": bool(self.engine.under_pressure)}
+                "pressure": bool(self.engine.under_pressure),
+                # P/D routing inputs (README "P/D disaggregation"):
+                # phase role, prefill backlog depth (queued requests),
+                # and decode ladder occupancy (bound lanes / top rung).
+                "role": self.role,
+                "backlog": len(self.sched._waiting),
+                "occupancy": self._ladder_occupancy()}
+
+    def _ladder_occupancy(self) -> float:
+        e = self.engine
+        return round(sum(s is not None for s in e.slots)
+                     / max(e.ladder[-1], 1), 4)
 
     def _verb_stats(self, conn, obj, blob) -> dict:
         return {"stats": self.sched.stats.snapshot(self.engine)}
@@ -403,6 +483,15 @@ class EngineWorker:
             "under_pressure": e.under_pressure,
             "preemptions": e.preemptions_total,
             "swap_in_resumes": e.swap_in_resumes,
+            # P/D disaggregation: phase role + the two numbers a
+            # handoff stall shows up in (backlog on the prefill side,
+            # ladder occupancy on the decode side).
+            "role": self.role,
+            "prefill_backlog": len(self.sched._waiting),
+            "ladder_occupancy": self._ladder_occupancy(),
+            "pd_handoffs": self.sched.stats.pd_handoffs,
+            "pd_adoptions": e.adoptions_in,
+            "pd_adopt_fallbacks": e.adopt_fallbacks,
         }
         if e.host_pool is not None:
             out["host_cache"] = {
@@ -614,6 +703,29 @@ def main() -> None:
     from tpu_inference.config import framework_config_from_dict
 
     cfg = framework_config_from_dict(envelope["config"])
+    role = envelope.get("role")
+    if role:
+        # Per-worker phase role (README "P/D disaggregation"): the
+        # router resolves ServerConfig.worker_roles and ships THIS
+        # worker's entry, folded into the engine config so warmup and
+        # the handoff hook specialize.
+        import dataclasses
+
+        cfg.engine = dataclasses.replace(cfg.engine, role=role)
+    nice = int(envelope.get("nice") or 0)
+    if nice and hasattr(os, "nice"):
+        # Shared-CPU hosts (README "P/D disaggregation"): the prefill
+        # tier self-deprioritizes so decode workers keep their cadence
+        # under prefill bursts — on per-chip deployments the isolation
+        # is physical and this is a no-op. A refused increment (e.g. a
+        # negative value without CAP_SYS_NICE) must NOT crash the
+        # worker into a restart loop — priority is an optimization,
+        # not a correctness requirement.
+        try:
+            os.nice(nice)
+        except OSError as e:
+            print(f"[worker {args.replica}] os.nice({nice}) refused: "
+                  f"{e}; serving at current priority", file=sys.stderr)
     worker = EngineWorker(cfg, replica=args.replica,
                           socket_path=args.socket,
                           warmup=bool(envelope.get("warmup", True)))
