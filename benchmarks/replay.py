@@ -169,6 +169,14 @@ def start_server(args) -> tuple:
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
         host_cache_pages=getattr(args, "host_cache_pages", 0),
         admission=getattr(args, "admission", "reserve"),
+        # Rolling SLO targets (README "Observability"): feed the
+        # windowed quantile gauges + breach counters the artifact and
+        # the autoscaler read.
+        slo_ttft_ms=getattr(args, "slo_ttft_ms", 0.0),
+        slo_tpot_ms=getattr(args, "slo_tpot_ms", 0.0),
+        # Debug surfaces on: the bench scrapes /debug/trace for the
+        # Chrome-trace artifact (local bench server, never production).
+        enable_debug=True,
         server_overrides={
             "admission_queue_depth":
                 getattr(args, "admission_queue_depth", 0),
@@ -404,6 +412,19 @@ def main() -> dict:
                    help="compare-pd: os.nice() for the pd arm's "
                         "prefill worker (shared-CPU hosts; see the "
                         "server CLI flag of the same name)")
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="rolling SLO target for TTFT (ms): feeds "
+                        "tpu_inf_slo_*_seconds gauges + breach "
+                        "counters; 0 = no target (gauges still export)")
+    p.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                   help="rolling SLO target for TPOT (ms); 0 = none")
+    p.add_argument("--trace-artifact", default=None,
+                   help="with --compare-pd: write the pd arm's "
+                        "recent-request ring as Chrome trace-event "
+                        "JSON (GET /debug/trace?format=chrome) to this "
+                        "path — one pid per replica, router as pid 0, "
+                        "loadable in Perfetto (default with --smoke: "
+                        "replay_pd_trace.json next to --out)")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--out", default=None, help="write summary JSON here")
     p.add_argument("--smoke", action="store_true",
@@ -496,6 +517,14 @@ def main() -> dict:
             # workload first, so lazy compiles never land in a measured
             # phase).
             args.dp = 2
+            # SLO targets sized to the CPU lane's loaded-phase latency
+            # so the breach counters exercise for real (the quantile
+            # gauges export regardless; magnitudes are recorded, not
+            # graded live).
+            if not args.slo_ttft_ms:
+                args.slo_ttft_ms = 2000.0
+            if not args.slo_tpot_ms:
+                args.slo_tpot_ms = 200.0
             args.page_size, args.max_pages_per_seq = 16, 40
             args.num_pages = 512
             args.host_cache_pages = 64
@@ -518,6 +547,9 @@ def main() -> dict:
                         else "benchmarks/results/replay_pd.json"
                         if args.compare_pd
                         else "benchmarks/results/replay_smoke.json")
+        if args.compare_pd and args.trace_artifact is None:
+            args.trace_artifact = os.path.join(
+                os.path.dirname(args.out) or ".", "replay_pd_trace.json")
 
     if args.platform != "auto":
         # Before any jax computation (env vars are read too early in
@@ -645,6 +677,12 @@ def run_replay(args) -> dict:
             "shed_rate": summary["shed_rate"],
         }
         summary["phase_breakdown"] = phase_breakdown(before, after)
+        # Rolling SLO gauges (README "Observability"): the fleet's
+        # exact windowed quantiles + breach counts at scrape time
+        # (windows dropped — the artifact carries the numbers).
+        if after.get("slo"):
+            summary["slo"] = {k: v for k, v in after["slo"].items()
+                              if not k.endswith("_window")}
         # Speculative-decoding lane (README "Speculative decoding"):
         # mode/γ/acceptance from the server's own counters when spec is
         # on (absent otherwise).
@@ -1403,6 +1441,54 @@ def _compare_fleet(args) -> dict:
     return result
 
 
+def _grade_handoff_traces(chrome: dict) -> dict:
+    """Grade a Chrome-trace export for the P/D acceptance claim: at
+    least one handed-off request whose spans appear under ONE trace id
+    across THREE pids (router + prefill worker + decode worker), with
+    the handoff export/adopt spans adjacent to and non-overlapping with
+    the prefill/decode spans. Same-process comparisons are exact; the
+    one cross-process gap (export end -> adopt start) allows a 5 ms
+    wall-clock anchor tolerance."""
+    by_trace: dict = {}
+    for e in chrome.get("traceEvents", ()):
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    total = clean = 0
+    example = None
+    for tid, evs in by_trace.items():
+        spans = {}
+        for e in sorted(evs, key=lambda e: e["ts"]):
+            spans.setdefault(e["name"], e)
+        need = ("prefill", "handoff_export", "handoff_adopt", "decode")
+        if not all(k in spans for k in need):
+            continue
+        pids = {e["pid"] for e in evs}
+        if len(pids) < 3:
+            continue
+        total += 1
+
+        def end(e):
+            return e["ts"] + e["dur"]
+
+        pf, ex = spans["prefill"], spans["handoff_export"]
+        ad, de = spans["handoff_adopt"], spans["decode"]
+        ok = (pf["pid"] == ex["pid"] and ad["pid"] == de["pid"]
+              and ex["pid"] != ad["pid"]
+              and end(pf) <= ex["ts"] + 1          # same process: exact
+              and end(ex) <= ad["ts"] + 5000       # cross-process: 5 ms
+              and end(ad) <= de["ts"] + 1)
+        if ok:
+            clean += 1
+            example = example or tid
+    return {"handoff_traces_3pid": total,
+            "handoff_traces_clean": clean,
+            "adjacency_ok": total > 0 and clean == total,
+            "example_trace_id": example}
+
+
 # Long-prompt loads the pressure generator keeps in flight at once: 2
 # per mixed worker (its other 2 slots hold the decode streams), and on
 # the pd split 4 on the prefill worker — whose slots hold nothing else,
@@ -1437,6 +1523,8 @@ async def _pd_burst(port: int, model: str, n_streams: int,
                    "temperature": 0.0, "stream": True,
                    "options": {"num_predict": decode_tokens}}
         text, final = [], {}
+        t0 = time.perf_counter()
+        ttft = None
         async with session.post(url, json=payload) as resp:
             resp.raise_for_status()
             async for line in resp.content:
@@ -1445,6 +1533,8 @@ async def _pd_burst(port: int, model: str, n_streams: int,
                 rec = json.loads(line)
                 tok = rec.get("response", "")
                 if tok:
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
                     text.append(tok)
                     first_chunk[i].set()
                 if rec.get("done"):
@@ -1454,6 +1544,7 @@ async def _pd_burst(port: int, model: str, n_streams: int,
         if n_done[0] == n_streams:
             streams_done.set()
         return {"idx": i, "reply": "".join(text),
+                "ttft_s": round(ttft, 6) if ttft is not None else None,
                 # Router-side decode window (the Ollama eval fields):
                 # first token -> finish, measured by the serving
                 # process — the stalls a prefill inflicts on decode
@@ -1482,8 +1573,12 @@ async def _pd_burst(port: int, model: str, n_streams: int,
         async with session.post(url, json=payload) as resp:
             resp.raise_for_status()
             rec = await resp.json()
+        e2e = time.perf_counter() - t0
+        # A num_predict=1 unary reply: the whole response IS the first
+        # token, so e2e stands in for TTFT in the SLO comparison pool.
         return {"idx": j, "reply": rec.get("response", ""),
-                "e2e_s": round(time.perf_counter() - t0, 4)}
+                "ttft_s": round(e2e, 6),
+                "e2e_s": round(e2e, 4)}
 
     issued = [0]
 
@@ -1576,6 +1671,17 @@ def _pd_arm(args, label: str, roles: tuple,
     group = srv.group
     n, dt = args.pd_streams, args.pd_decode_tokens
     nl, lt = args.pd_load_prompts, args.pd_load_prompt_tokens
+    # Every client-measured TTFT this arm's server sees, across every
+    # phase (pin requests, warm pass, baselines, loaded) — the SAME
+    # population the workers' rolling SLO windows observed, so the
+    # gauge-vs-replay comparison is apples to apples.
+    client_ttfts: list = []
+
+    def _collect(streams, loads=()):
+        client_ttfts.extend(r["ttft_s"] for r in list(streams) + list(loads)
+                            if r.get("ttft_s") is not None)
+
+    chrome_trace = None
     try:
         # Pin stream placement first: prefill each stream prompt
         # SEQUENTIALLY so the rotating cold tie-break alternates
@@ -1591,24 +1697,41 @@ def _pd_arm(args, label: str, roles: tuple,
                                  "temperature": 0.0, "stream": False,
                                  "options": {"num_predict": 4}}).encode(),
                 headers={"Content-Type": "application/json"})
+            t_pin = time.perf_counter()
             urllib.request.urlopen(req, timeout=600).read()
+            # Unary 4-token replies: e2e ~= TTFT at this size; close
+            # enough for the pooled p95 of a ~100-request population.
+            client_ttfts.append(round(time.perf_counter() - t_pin, 6))
         # UNMEASURED warm pass of the exact loaded workload (distinct
         # load content, a handful of loads): compiles every lazy graph
         # this arm will touch — prefill buckets, chunked/hybrid prefill
         # at real occupancy, decode, and (pd) the handoff export/adopt
         # path — so measured phases time serving, not XLA.
-        asyncio.run(_pd_burst(port, args.model, n, dt, True, lt,
-                              load_cap=6, load_tag="W"))
+        warm_s, warm_l, _ = asyncio.run(
+            _pd_burst(port, args.model, n, dt, True, lt,
+                      load_cap=6, load_tag="W"))
+        _collect(warm_s, warm_l)
         # Unloaded baseline x2 (merged per stream: a single 1-2s pass
         # on a 1-core host carries scheduling noise the merge halves).
         base_a, _, _ = asyncio.run(
             _pd_burst(port, args.model, n, dt, False, lt, 0))
         base_b, _, _ = asyncio.run(
             _pd_burst(port, args.model, n, dt, False, lt, 0))
+        _collect(base_a)
+        _collect(base_b)
         loaded_streams, loads, issued = asyncio.run(
             _pd_burst(port, args.model, n, dt, True, lt, nl))
+        _collect(loaded_streams, loads)
         after = json.loads(scrape_metrics(port, fmt="json")[0])
         health = group.health_snapshot()
+        if label == "pd" and getattr(args, "trace_artifact", None):
+            # The Chrome-trace artifact (README "Observability"): the
+            # recent-request ring over real HTTP — handed-off requests
+            # show spans from three pids under one trace id.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/trace?format=chrome",
+                    timeout=60) as r:
+                chrome_trace = json.loads(r.read().decode())
     finally:
         group.stop(drain=False)
         stop()
@@ -1617,7 +1740,26 @@ def _pd_arm(args, label: str, roles: tuple,
     sha_loaded = _pd_outputs_sha(loaded_streams)
     tpot_base = _pd_tpot_merged([base_a, base_b])
     tpot_loaded = _pd_tpot(loaded_streams)
+    # SLO-gauge tracking: the fleet's pooled rolling-window TTFT p95
+    # (scraped off the live servers) vs the same population's
+    # client-measured p95, computed with the ring's own estimator so
+    # the comparison isolates measurement-point skew (HTTP overhead),
+    # not estimator choice.
+    from tpu_inference import telemetry as _tm
+
+    slo = {k: v for k, v in (after.get("slo") or {}).items()
+           if not k.endswith("_window")}
+    client_p95 = _tm.pooled_quantile([client_ttfts], 0.95)
+    client_p95 = round(client_p95, 6) if client_p95 is not None else None
+    gauge_p95 = slo.get("ttft_p95_s")
+    ratio = (round(gauge_p95 / client_p95, 4)
+             if gauge_p95 and client_p95 else None)
     return {
+        "slo": slo,
+        "client_ttft_p95_s": client_p95,
+        "client_ttft_requests": len(client_ttfts),
+        "slo_ttft_p95_tracking_ratio": ratio,
+        "_chrome_trace": chrome_trace,
         "label": label, "roles": list(roles) or ["mixed", "mixed"],
         "hybrid_prefill": hybrid,
         "streams": n, "decode_tokens": dt,
@@ -1671,6 +1813,32 @@ def _compare_pd(args) -> dict:
     arms["hybrid"] = _pd_arm(args, "hybrid", (), hybrid=True)
     arms["pd"] = _pd_arm(args, "pd", ("prefill", "decode"))
     args.worker_roles, args.fleet = (), "in-process"
+
+    # Chrome-trace artifact (README "Observability"): the pd arm's
+    # recent-request ring, graded for the one-trace-three-pids
+    # handoff claim and the SLO-gauge tracking claim, then written as
+    # pure Chrome trace-event JSON (grading rides in otherData so the
+    # file stays Perfetto-loadable).
+    chrome = arms["pd"].pop("_chrome_trace", None)
+    for a in arms.values():
+        a.pop("_chrome_trace", None)
+    trace_grading = None
+    if chrome is not None:
+        trace_grading = _grade_handoff_traces(chrome)
+        trace_grading["slo"] = dict(arms["pd"]["slo"])
+        trace_grading["client_ttft_p95_s"] = \
+            arms["pd"]["client_ttft_p95_s"]
+        trace_grading["slo_ttft_p95_tracking_ratio"] = \
+            arms["pd"]["slo_ttft_p95_tracking_ratio"]
+        trace_grading["slo_tracks_within_10pct"] = bool(
+            arms["pd"]["slo_ttft_p95_tracking_ratio"] is not None
+            and abs(arms["pd"]["slo_ttft_p95_tracking_ratio"] - 1.0)
+            <= 0.10)
+        chrome.setdefault("otherData", {}).update(trace_grading)
+        if getattr(args, "trace_artifact", None):
+            _write_out(args.trace_artifact, chrome)
+            print(f"[replay] chrome trace artifact -> "
+                  f"{args.trace_artifact}", file=sys.stderr)
 
     mixed, hybrid, pd = arms["mixed"], arms["hybrid"], arms["pd"]
     shas = {a["outputs_sha256"] for a in arms.values()}
@@ -1732,6 +1900,15 @@ def _compare_pd(args) -> dict:
                                   and pd["pd_handoff_recomputes"] == 0
                                   and pd["resume_recomputed_tokens"]
                                   == 0),
+        # Distributed tracing + SLO gauges (README "Observability"):
+        # the pd arm's cross-process trace grading and the rolling
+        # TTFT-p95 gauge vs the replay's own measurement.
+        "trace": trace_grading,
+        "slo_breaches": {k: {"ttft": (a["slo"] or {}).get(
+                                 "ttft_breaches"),
+                             "tpot": (a["slo"] or {}).get(
+                                 "tpot_breaches")}
+                         for k, a in arms.items()},
     }
     comparison["pd_wins"] = bool(
         comparison["outputs_identical"]

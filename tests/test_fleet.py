@@ -400,6 +400,11 @@ def test_metrics_label_stable_across_restart(fleet):
     tok_before = series(before, "tpu_inf_tokens_generated_total")
     replicas = {dict(labels).get("replica") for labels in tok_before}
     assert replicas == {"0", "1"}
+    # build_info: one info series per replica + one fleet-level, all
+    # value 1 with config-pure labels.
+    binfo_before = series(before, "tpu_inf_build_info")
+    assert len(binfo_before) == 3
+    assert all(v == 1.0 for v in binfo_before.values())
 
     # Restart worker 0 gracefully (drain carries the final dump).
     fleet.apply_chaos({"replica": 0, "kill": "sigterm"})
@@ -418,6 +423,12 @@ def test_metrics_label_stable_across_restart(fleet):
     # Fleet-side restart counter moved under the stable label.
     restarts = series(after, "tpu_inf_worker_restarts_total")
     assert restarts[(("replica", "0"),)] >= 1
+    # build_info label stability: the restarted worker re-minted the
+    # IDENTICAL labelset (values are pure config), so the series set is
+    # unchanged — no new series, none vanished, still all value 1.
+    binfo_after = series(after, "tpu_inf_build_info")
+    assert set(binfo_after) == set(binfo_before)
+    assert all(v == 1.0 for v in binfo_after.values())
 
 
 # ------------------------------------------- P/D disaggregation (live
@@ -638,6 +649,183 @@ def test_pd_handoff_races_decode_restart(pd_fleet, oracle):
     assert pd_fleet.pd_handoff_recomputes > recomputes0
     _wait_states(pd_fleet)
     assert pd_fleet.health_snapshot()["replicas"][1]["restarts"] >= 1
+
+
+def test_handoff_trace_id_in_worker_logs(oracle, tmp_path):
+    """Trace-id satellite, pinned at the OS level: the id a client
+    sends appears in BOTH workers' structured logs for a handed-off
+    request — the prefill worker's request_finish (reason "handoff")
+    and the decode worker's terminal request_finish. The fleet spawns
+    with fd 2 redirected to a file (workers inherit it for life) and
+    TPU_INF_LOG=info, so the assertion reads the workers' REAL stderr
+    stream, not an in-process shim."""
+    import os
+
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    log_path = tmp_path / "workers.stderr"
+    log_fd = os.open(str(log_path), os.O_CREAT | os.O_WRONLY, 0o600)
+    saved = os.dup(2)
+    prior = os.environ.get("TPU_INF_LOG")
+    os.environ["TPU_INF_LOG"] = "info"
+    try:
+        os.dup2(log_fd, 2)
+        try:
+            group = ProcessEngineGroup(
+                _cfg(dp=2, worker_roles=("prefill", "decode")))
+            group.start()
+        finally:
+            os.dup2(saved, 2)
+    finally:
+        os.close(saved)
+        os.close(log_fd)
+        if prior is None:
+            os.environ.pop("TPU_INF_LOG", None)
+        else:
+            os.environ["TPU_INF_LOG"] = prior
+    tid = "cli-e2e-7f3a"
+    try:
+        _wait_states(group)
+        toks, done, box = [], threading.Event(), {}
+        seq = Sequence(request_id=8000, prompt_tokens=list(PD_PROMPT),
+                       max_new_tokens=12, trace_id=tid)
+        group.submit(seq, lambda s, t: toks.append(t),
+                     lambda s: (box.update(seq=s), done.set()))
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length"
+        assert toks == oracle.generate([list(PD_PROMPT)],
+                                       max_new_tokens=12)[0]
+        deadline = time.monotonic() + 30
+        reasons = set()
+        while time.monotonic() < deadline:
+            lines = [l for l in log_path.read_text().splitlines()
+                     if '"request_finish"' in l and tid in l]
+            reasons = {json.loads(l)["reason"] for l in lines}
+            if {"handoff", "length"} <= reasons:
+                break
+            time.sleep(0.1)
+        assert {"handoff", "length"} <= reasons, \
+            log_path.read_text()[-2000:]
+        for line in lines:
+            assert json.loads(line)["request_id"] == tid
+        # /debug/requests on both workers: one timeline per side, both
+        # under the client's id.
+        recent = [t for t in group.recent_snapshot(50)
+                  if t["trace_id"] == tid]
+        assert {t["finish_reason"] for t in recent} \
+            == {"handoff", "length"}
+    finally:
+        group.stop(drain=False)
+
+
+def test_handoff_span_tree_three_processes(pd_fleet, oracle):
+    """Tentpole, end to end across three OS processes: the router
+    assembles ONE span tree under the client's trace id with router +
+    prefill-worker + decode-worker spans, the handoff export/adopt
+    spans adjacent and non-overlapping with prefill/decode."""
+    _wait_states(pd_fleet)
+    tid = "cli-span-9b1c"
+    toks, done, box = [], threading.Event(), {}
+    seq = Sequence(request_id=8200, prompt_tokens=list(PD_PROMPT),
+                   max_new_tokens=12, trace_id=tid)
+    pd_fleet.submit(seq, lambda s, t: toks.append(t),
+                    lambda s: (box.update(seq=s), done.set()))
+    fin = _finish(done, box)
+    assert fin.finish_reason == "length"
+    assert toks == oracle.generate([list(PD_PROMPT)],
+                                   max_new_tokens=12)[0]
+
+    # The assembled span tree: one trace id, three processes.
+    snap = pd_fleet.trace_snapshot(tid)
+    assert snap is not None
+    assert snap["replicas"] == [-1, 0, 1]
+    spans = {s["name"]: s for s in snap["spans"]}
+    for name in ("request", "route", "handoff", "prefill",
+                 "handoff_export", "handoff_adopt", "decode"):
+        assert name in spans, (name, sorted(spans))
+    assert spans["prefill"]["replica"] == 0
+    assert spans["handoff_export"]["replica"] == 0
+    assert spans["handoff_adopt"]["replica"] == 1
+    assert spans["decode"]["replica"] == 1
+    assert snap["tree"]["name"] == "request"
+
+    def end(s):
+        return s["ts"] + s["dur"]
+
+    # Adjacent + non-overlapping: prefill -> export (same process,
+    # exact) -> adopt (cross-process, 5 ms anchor tolerance) -> decode
+    # (same process, exact by construction).
+    assert end(spans["prefill"]) <= spans["handoff_export"]["ts"] + 1e-6
+    assert end(spans["handoff_export"]) \
+        <= spans["handoff_adopt"]["ts"] + 5e-3
+    assert end(spans["handoff_adopt"]) <= spans["decode"]["ts"] + 1e-6
+
+    # The pull path agrees with the event-frame assembly: the decode
+    # worker's trace verb serves its half of the same trace.
+    h1 = pd_fleet.workers[1]
+    pulled = h1.client.rpc("trace", timeout=10.0, trace=tid)["spans"]
+    assert {"handoff_adopt", "decode"} <= {s["name"] for s in pulled}
+
+
+def test_pd_fleet_scrape_catalog_slo_and_build_info(pd_fleet):
+    """Satellite: a LIVE dp=2 P/D fleet's aggregated scrape parses
+    under the strict exposition parser, has no duplicate series across
+    fleet aggregation, and carries the new slo / build_info series with
+    correct types — per replica AND fleet-level."""
+    from tests import _prom
+
+    _wait_states(pd_fleet)
+    # Traffic so the SLO windows hold data, then refresh the cached
+    # worker stats the fleet-level pooled gauges read.
+    toks, done, box = _submit(pd_fleet, 8100, [3, 1, 4, 1, 5], 8)
+    _finish(done, box)
+    pd_fleet._refresh_caches()
+
+    meta, samples = _prom.parse(pd_fleet.prometheus_text())
+    seen = set()
+    for name, labels, _ in samples:
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, f"duplicate series {key}"
+        seen.add(key)
+
+    assert meta["tpu_inf_slo_ttft_seconds"]["type"] == "gauge"
+    assert meta["tpu_inf_slo_tpot_seconds"]["type"] == "gauge"
+    assert meta["tpu_inf_slo_breaches_total"]["type"] == "counter"
+    assert meta["tpu_inf_build_info"]["type"] == "gauge"
+
+    def rows(name):
+        return [(labels, v) for n, labels, v in samples if n == name]
+
+    slo = rows("tpu_inf_slo_ttft_seconds")
+    # 2 quantiles x (2 replicas + 1 fleet-pooled).
+    assert len(slo) == 6
+    assert {l.get("q") for l, _ in slo} == {"0.5", "0.95"}
+    fleet_p95 = next(v for l, v in slo
+                     if "replica" not in l and l["q"] == "0.95")
+    assert fleet_p95 > 0                      # pooled window has data
+    binfo = rows("tpu_inf_build_info")
+    assert len(binfo) == 3                    # 2 replicas + fleet
+    for labels, v in binfo:
+        assert v == 1.0
+        assert labels["fleet"] == "subprocess"
+        assert set(labels) >= {"version", "backend", "kv_quant",
+                               "spec_mode", "routing"}
+    assert len(rows("tpu_inf_slo_breaches_total")) == 6  # 2 kinds x 3
+
+
+def test_worker_profile_rpc_captures_trace(pd_fleet, tmp_path):
+    """Satellite surface: the profile RPC verb runs jax.profiler on a
+    live worker (serving continues) and returns the trace dir under the
+    operator's profile_dir."""
+    import os
+
+    _wait_states(pd_fleet)
+    r = pd_fleet.capture_profile(1, seconds=0.3)
+    assert r["replica"] == 1 and r["seconds"] == 0.3
+    assert r["dir"].endswith("replica1")
+    assert os.path.isdir(r["dir"])
+    # jax wrote a plugins/profile capture under the dir.
+    assert any(os.scandir(r["dir"]))
 
 
 _WARMUP_COMPILE_COUNTER = """

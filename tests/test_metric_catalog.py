@@ -1,0 +1,69 @@
+"""Metric-catalog drift gate (README "Observability").
+
+Every ``tpu_inf_*`` series name constructed anywhere in
+``tpu_inference/`` must appear in the README's observability catalog,
+and every name the README documents must still exist in code — so the
+catalog can never silently rot in either direction when a PR adds or
+removes metrics. Names are string literals by construction (the
+telemetry layer takes the name as the first positional argument), so a
+plain literal grep is exhaustive.
+"""
+
+import pathlib
+import re
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# Metric names appear in code only as double-quoted string literals
+# (registry.counter("tpu_inf_...", ...) and friends). Help texts and
+# CLI help that MENTION a metric by name are fine: they must name a
+# real metric, which is exactly what the reverse check enforces.
+_CODE_RE = re.compile(r'"(tpu_inf_[a-z0-9_]+)"')
+# README mentions names bare, in label-annotated forms
+# (tpu_inf_foo{bar=...}), and occasionally with exposition suffixes.
+_DOC_RE = re.compile(r"tpu_inf_[a-z0-9_]+")
+_EXPOSITION_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _code_names() -> set:
+    names = set()
+    for path in (ROOT / "tpu_inference").rglob("*.py"):
+        names |= set(_CODE_RE.findall(path.read_text()))
+    return names
+
+
+def _doc_names() -> set:
+    names = set()
+    for raw in _DOC_RE.findall((ROOT / "README.md").read_text()):
+        for suffix in _EXPOSITION_SUFFIXES:
+            if raw.endswith(suffix) and raw[: -len(suffix)].count("_") > 2:
+                raw = raw[: -len(suffix)]
+                break
+        names.add(raw)
+    return names
+
+
+def test_every_code_metric_is_documented():
+    code, doc = _code_names(), _doc_names()
+    assert code, "grep found no metrics — the pattern rotted"
+    missing = sorted(code - doc)
+    assert not missing, (
+        "metrics constructed in tpu_inference/ but absent from the "
+        f"README observability catalog: {missing}")
+
+
+def test_every_documented_metric_exists_in_code():
+    code, doc = _code_names(), _doc_names()
+    stale = sorted(n for n in doc - code)
+    assert not stale, (
+        "metrics documented in README but no longer constructed "
+        f"anywhere in tpu_inference/: {stale}")
+
+
+def test_catalog_covers_this_prs_series():
+    """The series this PR introduces are present on both sides (a
+    tripwire for the greps themselves going blind)."""
+    code, doc = _code_names(), _doc_names()
+    for name in ("tpu_inf_slo_ttft_seconds", "tpu_inf_slo_tpot_seconds",
+                 "tpu_inf_slo_breaches_total", "tpu_inf_build_info"):
+        assert name in code and name in doc, name

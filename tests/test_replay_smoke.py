@@ -348,6 +348,32 @@ def test_replay_smoke_compare_pd(tmp_path, monkeypatch):
     # The loaded phase offered >= 10x the unloaded phase's prefill.
     assert cmp["prefill_load_ratio"] >= 10.0
 
+    # Distributed tracing (README "Observability"): the lane committed
+    # a Chrome trace-event artifact next to --out, and THIS run's pd
+    # arm produced >= 1 handed-off request whose spans appear under one
+    # trace id across router + prefill worker + decode worker pids,
+    # export/adopt adjacent and non-overlapping with prefill/decode.
+    trace_path = tmp_path / "replay_pd_trace.json"
+    assert trace_path.exists()
+    chrome = json.loads(trace_path.read_text())
+    assert isinstance(chrome["traceEvents"], list) and chrome["traceEvents"]
+    assert all({"name", "ph", "pid"} <= set(e)
+               for e in chrome["traceEvents"])
+    grading = chrome["otherData"]
+    assert grading["handoff_traces_3pid"] >= 1
+    assert grading["handoff_traces_clean"] >= 1
+    assert grading["adjacency_ok"], grading
+    assert cmp["trace"]["handoff_traces_3pid"] >= 1
+    # Rolling SLO gauges tracked the replay: real targets were set, the
+    # windowed p95 exists, and the gauge-vs-client ratio is recorded
+    # (the within-10% magnitude is graded on the committed artifact —
+    # a loaded CI box skews client-side timing).
+    slo = art["pd"]["slo"]
+    assert slo["ttft_target_s"] == 2.0 and slo["tpot_target_s"] == 0.2
+    assert slo["ttft_p95_s"] is not None and slo["ttft_p95_s"] > 0
+    assert art["pd"]["client_ttft_p95_s"] > 0
+    assert art["pd"]["slo_ttft_p95_tracking_ratio"] is not None
+
     # The committed artifact carries the acceptance magnitudes: decode
     # TPOT p95 flat (within 10% of the arm's own unloaded baseline)
     # under the burst on the pd split, degrading on hybrid.
@@ -361,6 +387,44 @@ def test_replay_smoke_compare_pd(tmp_path, monkeypatch):
     assert c["decode_tpot_p95_ratio"]["hybrid"] >= 1.25
     assert (c["decode_tpot_p95_ratio"]["hybrid"]
             > c["decode_tpot_p95_ratio"]["pd"])
+
+
+def test_committed_pd_trace_artifact():
+    """The committed Chrome-trace artifact
+    (benchmarks/results/replay_pd_trace.json, from the --compare-pd
+    lane) is valid trace-event JSON carrying the acceptance claims: a
+    handed-off request's spans under ONE trace id across three pids
+    (router=0, prefill worker, decode worker) with export/adopt
+    adjacent and non-overlapping with prefill/decode, and the rolling
+    SLO TTFT p95 gauge tracking the replay-measured p95 within 10%."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    chrome = json.loads(open(os.path.join(
+        root, "benchmarks", "results", "replay_pd_trace.json")).read())
+    evs = chrome["traceEvents"]
+    assert isinstance(evs, list) and len(evs) > 10
+    x = [e for e in evs if e.get("ph") == "X"]
+    assert all({"name", "ts", "dur", "pid", "tid", "args"} <= set(e)
+               for e in x)
+    # One handed-off request spanning three pids, verified from the
+    # raw events (not just the recorded grading).
+    by_trace = {}
+    for e in x:
+        tid = e["args"].get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, []).append(e)
+    three_pid = [
+        tid for tid, es in by_trace.items()
+        if len({e["pid"] for e in es}) >= 3
+        and {"handoff_export", "handoff_adopt", "prefill",
+             "decode"} <= {e["name"] for e in es}]
+    assert three_pid, "no handed-off request spans three pids"
+    assert 0 in {e["pid"] for e in by_trace[three_pid[0]]}  # the router
+    g = chrome["otherData"]
+    assert g["handoff_traces_3pid"] >= 1 and g["adjacency_ok"]
+    # SLO tracking: gauge p95 within 10% of the replay-measured p95.
+    assert g["slo_tracks_within_10pct"], g
+    assert abs(g["slo_ttft_p95_tracking_ratio"] - 1.0) <= 0.10
+    assert g["slo"]["ttft_breaches"] >= 1      # targets actually bound
 
 
 def test_replay_smoke_compare_tiering(tmp_path, monkeypatch):

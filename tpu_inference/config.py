@@ -507,6 +507,15 @@ class EngineConfig:
     # headroom keeps it off the steady-state path either way). 0 = off
     # (legacy admission gate only).
     ladder_admit_headroom_pages: int = 0
+    # Rolling SLO targets (README "Observability": SLO gauges; CLI
+    # --slo-ttft-ms / --slo-tpot-ms). Each finished request's TTFT and
+    # TPOT feed exact windowed quantile gauges
+    # (tpu_inf_slo_ttft_seconds{q=...} / tpu_inf_slo_tpot_seconds{q=...})
+    # regardless; with a non-zero target, requests past it additionally
+    # count into tpu_inf_slo_breaches_total{slo=...} — the signal an
+    # SLO-driven autoscaler scales on. 0 = no target.
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
     # Worker phase role (README "P/D disaggregation"): "mixed" runs both
     # phases (the compatibility default — every pre-P/D topology);
     # "prefill" serves prompt prefills only and HANDS each settled

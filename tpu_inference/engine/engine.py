@@ -338,6 +338,18 @@ class Sequence:
     # tokens (engine.adopt_sequence).
     handoff_after_prefill: bool = False
     adopt_kv: Optional[tuple] = None
+    # Set by adopt_sequence: this attempt resumed from a live KV
+    # handoff (no prefill dispatch ran) — the tracing layer emits a
+    # handoff_adopt span in place of the prefill span, and the SLO
+    # tracker skips its TTFT (the client's first token streamed from
+    # the prefill worker, not here).
+    adopted: bool = False
+    # Per-request speculative-round exposure (ngram/draft modes):
+    # rounds this sequence proposed in and positions accepted —
+    # surfaced as attrs on the request's decode span so a trace shows
+    # where speculation paid off without a span per round.
+    spec_rounds: int = 0
+    spec_accepted_toks: int = 0
 
     @property
     def last_token(self) -> int:
@@ -1354,28 +1366,56 @@ class InferenceEngine:
         telemetry. Engine thread only (reads the live pool)."""
         t0 = time.perf_counter()
         out = kvc.offload_pages(self.kv, pages)
+        t1 = time.perf_counter()
+        if out and self.host_pool is not None:
+            # Pool accounting is part of the tier's stats surface (like
+            # offloaded/restored totals) — NOT gated on telemetry.
+            self.host_pool.note_swap_wall("out", t1 - t0)
         tel = self.telemetry
         if tel.enabled and out:
-            tel.kv_swap_s.observe(time.perf_counter() - t0)
+            tel.kv_swap_s.observe(t1 - t0)
             tel.kv_offload_pages.inc(len(out))
-            tel.kv_offload_bytes.inc(sum(hp.nbytes for hp in out))
+            nbytes = sum(hp.nbytes for hp in out)
+            tel.kv_offload_bytes.inc(nbytes)
+            # Swap-out spans have no single owning request (eviction
+            # batches mix victims): they land in the recorder's
+            # maintenance lane of the Chrome timeline instead.
+            tel.recorder.add_maintenance("kv_swap_out", t0, t1,
+                                         pages=len(out), bytes=nbytes)
         return out
 
     def _restore_batch(self, fresh: List[int],
-                       entries: List["kvc.HostKVPage"]) -> None:
+                       entries: List["kvc.HostKVPage"],
+                       trace_id: str = "") -> None:
         """Scatter host page copies into freshly allocated device pages
         (async dispatch — a following prefill chains behind it on
-        device) and record swap telemetry."""
+        device) and record swap telemetry. ``trace_id`` attributes the
+        swap-in span to the request that triggered it (empty = a
+        maintenance-lane span)."""
         t0 = time.perf_counter()
         self.kv = kvc.restore_pages(self.kv, fresh, entries)
+        t1 = time.perf_counter()
+        if self.host_pool is not None:
+            # Pool accounting is part of the tier's stats surface —
+            # NOT gated on telemetry (offloaded/restored totals aren't).
+            self.host_pool.note_swap_wall("in", t1 - t0)
         tel = self.telemetry
         if tel.enabled:
-            tel.kv_swap_s.observe(time.perf_counter() - t0)
+            tel.kv_swap_s.observe(t1 - t0)
             tel.kv_restore_pages.inc(len(fresh))
-            tel.kv_restore_bytes.inc(sum(e.nbytes for e in entries))
+            nbytes = sum(e.nbytes for e in entries)
+            tel.kv_restore_bytes.inc(nbytes)
+            if trace_id:
+                tel.recorder.add("kv_swap_in", trace_id, t0, t1,
+                                 pages=len(fresh), bytes=nbytes)
+            else:
+                tel.recorder.add_maintenance("kv_swap_in", t0, t1,
+                                             pages=len(fresh),
+                                             bytes=nbytes)
 
     def _restore_host_entries(self, pages: List[Optional[int]],
-                              host_entries) -> List[int]:
+                              host_entries,
+                              trace_id: str = "") -> List[int]:
         """Fill the host-tier slots of a tiered lookup result: allocate
         fresh device pages, swap the host copies in, and publish the
         restored digests back into the HBM tier (promote). On
@@ -1392,7 +1432,8 @@ class InferenceEngine:
             self.prefix_cache.readmit_host(
                 [(d, e) for _, d, e in host_entries])
             raise
-        self._restore_batch(fresh, [e for _, _, e in host_entries])
+        self._restore_batch(fresh, [e for _, _, e in host_entries],
+                            trace_id=trace_id)
         out = list(pages)
         for (i, digest, _), page in zip(host_entries, fresh):
             out[i] = page
@@ -1462,7 +1503,8 @@ class InferenceEngine:
             self.prefix_cache.readmit_host(taken[free:])
             taken = taken[:free]
         fresh = self.allocator.allocate(len(taken))
-        self._restore_batch(fresh, [e for _, e in taken])
+        self._restore_batch(fresh, [e for _, e in taken],
+                            trace_id=seq.trace_id or str(seq.request_id))
         for (digest, _), page in zip(taken, fresh):
             self.prefix_cache.adopt(digest, page)
         if complete:
@@ -1576,7 +1618,9 @@ class InferenceEngine:
         self._admit_counter += 1
         fresh = self._allocate_reclaiming(len(host_pages))
         try:
-            self._restore_batch(fresh, host_pages)
+            self._restore_batch(fresh, host_pages,
+                                trace_id=seq.trace_id
+                                or str(seq.request_id))
         except BaseException:
             self.allocator.free(fresh)
             raise
@@ -1595,6 +1639,7 @@ class InferenceEngine:
         now = time.perf_counter()
         seq.prefill_start = seq.prefill_start or now
         seq.first_token_time = now
+        seq.adopted = True
         self.adoptions_in += 1
         self.swap_in_resumes += 1
         self.slots[slot] = seq
@@ -1720,7 +1765,9 @@ class InferenceEngine:
             pages, host_entries, seq.cached_tokens = self.prefix_cache.lookup(
                 prompt, max_tokens=len(prompt) - 1,
                 digests=self._seq_digests(seq, prompt))
-            shared = self._restore_host_entries(pages, host_entries)
+            shared = self._restore_host_entries(
+                pages, host_entries,
+                trace_id=seq.trace_id or str(seq.request_id))
             n_restored = len(host_entries)
         n_new = kvc.pages_needed(len(prompt), ecfg.page_size) - len(shared)
         try:
@@ -1849,6 +1896,13 @@ class InferenceEngine:
                 self.telemetry.decode_stall_during_prefill_s.observe(
                     time.perf_counter() - t0)
             seq.dispatch_wall_s += dt
+            # Per-chunk trace span (README "Observability" span schema):
+            # children of the request's prefill span, so a long prompt's
+            # chunk cadence is visible on the trace timeline.
+            self.telemetry.recorder.add(
+                "prefill_chunk", seq.trace_id or str(seq.request_id),
+                t0, t0 + dt, parent="prefill",
+                offset=int(offset), tokens=int(st["chunk_tokens"]))
         return offset + st["chunk_tokens"], tok
 
     def _prefill_chunked(self, seq: Sequence, prompt: List[int]) -> None:
@@ -3080,6 +3134,9 @@ class InferenceEngine:
             self.spec_accepted += accepted
             if drafted > 0:
                 self.telemetry.spec_accept_rate.observe(accepted / drafted)
+                # Per-request spec exposure for the decode trace span.
+                seq.spec_rounds += 1
+                seq.spec_accepted_toks += accepted
             if got:
                 result[seq.request_id] = got
         if self.telemetry.enabled:
@@ -3132,6 +3189,9 @@ class InferenceEngine:
         alpha = ecfg.spec_ewma_alpha
         seq.spec_accept_ewma += alpha * (rate - seq.spec_accept_ewma)
         self.telemetry.spec_accept_rate.observe(rate)
+        # Per-request spec exposure for the decode trace span.
+        seq.spec_rounds += 1
+        seq.spec_accepted_toks += accepted
         thr = ecfg.spec_throttle_below
         if thr > 0 and seq.spec_accept_ewma < thr:
             if seq.spec_gamma != 0:

@@ -452,6 +452,19 @@ class HostPagePool:
         self.offload_bytes_total = 0
         self.restore_bytes_total = 0
         self.import_bytes_total = 0
+        # Cumulative host wall spent in device<->host swap batches
+        # (engine-reported), per direction — the tier's total swap cost
+        # without histogram math, surfaced in /healthz host_cache.
+        self.swap_out_s_total = 0.0
+        self.swap_in_s_total = 0.0
+
+    def note_swap_wall(self, direction: str, seconds: float) -> None:
+        """Accumulate one swap batch's host wall ("out" = demote
+        device->host, "in" = promote host->device)."""
+        if direction == "out":
+            self.swap_out_s_total += seconds
+        else:
+            self.swap_in_s_total += seconds
 
     def can_hold(self, n: int = 1) -> bool:
         return self.used + n <= self.capacity

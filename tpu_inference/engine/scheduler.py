@@ -162,6 +162,12 @@ class SchedulerStats:
                 "fallback_rounds": engine.spec_fallback_rounds,
                 "throttles": engine.spec_throttles_total,
             }
+        # Rolling SLO view (README "Observability": SLO gauges): exact
+        # windowed TTFT/TPOT quantiles + breach counts, with the raw
+        # ring values so fleet aggregation can pool EXACT quantiles
+        # across replicas. Absent when TPU_INF_TELEMETRY=0.
+        if engine.telemetry.slo is not None:
+            out["slo"] = engine.telemetry.slo.snapshot()
         # Step-phase histograms (telemetry.py): dispatch wall, bubble,
         # queue-wait, per-request phases — cumulative buckets + estimated
         # percentiles, diffable across scrapes (benchmarks commit the
@@ -558,6 +564,7 @@ class EngineScheduler:
                     self._log_step_error("host_prefetch", exc, [head.seq])
         if start_adopt is not None:
             seq = start_adopt.seq
+            t_adopt = time.perf_counter()
             try:
                 self.step_inflight_since = time.monotonic()
                 self.engine.adopt_sequence(seq)
@@ -576,6 +583,16 @@ class EngineScheduler:
             finally:
                 self.step_inflight_since = None
             self._note_ok()
+            # Trace span: the adoption (KV restore, no prefill) stands
+            # in for the prefill span on this worker — adjacent to the
+            # prefill worker's handoff_export on the assembled
+            # timeline. Ends exactly at first_token_time (set by
+            # adopt_sequence), which is where the decode span begins,
+            # so the two spans abut without overlapping.
+            self.engine.telemetry.recorder.add(
+                "handoff_adopt", seq.trace_id or str(seq.request_id),
+                t_adopt, seq.first_token_time or time.perf_counter(),
+                ctx_len=seq.ctx_len, pages=len(seq.pages))
             # No token delivery and no prefill counters: every token in
             # seq.generated was already streamed (the handoff's replay
             # record), and no prefill dispatch ran.
@@ -723,6 +740,7 @@ class EngineScheduler:
             tel.decode_phase_s.observe(max(0.0, fin - first))
             tel.ttft_s.observe(max(0.0, first - enq))
             tel.e2e_s.observe(max(0.0, fin - enq))
+        self._observe_trace(seq, enq, start, first, fin)
         telemetry.log_event(
             "request_finish", level="info",
             request_id=seq.trace_id or str(seq.request_id),
@@ -738,6 +756,66 @@ class EngineScheduler:
             prefill_s=round(max(0.0, first - start), 6),
             decode_s=round(max(0.0, fin - first), 6),
             e2e_s=round(max(0.0, fin - enq), 6))
+
+    def _observe_trace(self, seq: Sequence, enq: float, start: float,
+                       first: float, fin: float) -> None:
+        """Emit the request's phase spans (README "Observability" span
+        schema) and fold its TTFT/TPOT into the rolling SLO window.
+
+        Span rules: queue_wait covers enqueue -> prefill start
+        (admission included); prefill covers prefill start -> first
+        token (per-chunk children were recorded by the engine; an
+        ADOPTED sequence's handoff_adopt span, recorded at admission,
+        stands in instead); decode covers first token -> finish and is
+        skipped on a "handoff" finish (no decode ran on the prefill
+        worker — the handoff_export span follows instead, recorded by
+        the worker's handoff hook). Sealing moves the trace into the
+        recorder's recent ring, where the worker's finish event, the
+        trace RPC verb, and /debug/trace read it."""
+        tel = self.engine.telemetry
+        rec = tel.recorder
+        tid = seq.trace_id or str(seq.request_id)
+        if rec.enabled and seq.enqueue_time:
+            rec.add("queue_wait", tid, enq, max(enq, start),
+                    admission=self.engine.admission)
+            if not seq.adopted:
+                rec.add("prefill", tid, start, max(start, first),
+                        cached_tokens=seq.cached_tokens,
+                        host_restored_pages=seq.host_restored_pages,
+                        attempt=seq.attempt)
+            if seq.finish_reason != "handoff":
+                attrs = {"output_tokens": len(seq.generated),
+                         "reason": seq.finish_reason,
+                         "preemptions": seq.preemptions}
+                if seq.spec_rounds:
+                    attrs["spec_rounds"] = seq.spec_rounds
+                    attrs["spec_accepted_tokens"] = seq.spec_accepted_toks
+                rec.add("decode", tid, first, max(first, fin), **attrs)
+        rec.seal(tid)
+        # Rolling SLO window: TTFT only for a FRESH first attempt —
+        # attempt 0 and no resume (a resume/adoption's or a failover
+        # resubmission's local first-token gap is not what the client
+        # waited: the first attempt's latency precedes it, and
+        # understating TTFT exactly while the fleet is failing is what
+        # an SLO autoscaler must not do); TPOT only where real decode
+        # steps ran here.
+        slo = tel.slo
+        if slo is None or not seq.enqueue_time:
+            return
+        ttft = (max(0.0, first - enq)
+                if not seq.resume_base and seq.attempt == 0
+                and seq.first_token_time
+                and seq.finish_reason != "error" else None)
+        decoded = len(seq.generated) - seq.resume_base
+        # Inter-token gaps in (first, fin]: on an ADOPTED sequence
+        # `first` is the adoption instant, so all `decoded` local
+        # tokens were produced after it; elsewhere the first token IS
+        # `first` and only decoded-1 gaps follow.
+        gaps = decoded if seq.adopted else decoded - 1
+        tpot = (max(0.0, fin - first) / gaps
+                if gaps > 0 and seq.finish_reason != "handoff"
+                else None)
+        slo.observe(ttft, tpot)
 
     def recent_snapshot(self, n: int) -> List[dict]:
         """Thread-safe copy of the last ``n`` request timelines (the deque

@@ -294,6 +294,17 @@ def main() -> None:
     p.add_argument("--chaos-step-wedge-s", type=float, default=0.0,
                    help="engine fault injection: each dispatch sleeps "
                         "this long first (exercises the step watchdog)")
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="rolling SLO target for time-to-first-token "
+                        "(ms): requests past it count into "
+                        "tpu_inf_slo_breaches_total{slo=\"ttft\"}; the "
+                        "windowed p50/p95 gauges export regardless. "
+                        "0 = no target")
+    p.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                   help="rolling SLO target for time-per-output-token "
+                        "(ms): breaches count into "
+                        "tpu_inf_slo_breaches_total{slo=\"tpot\"}; "
+                        "0 = no target")
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--debug", action="store_true",
                    help="expose the unauthenticated /debug/* endpoints "
@@ -461,6 +472,8 @@ def main() -> None:
                           ladder_admit_headroom_pages=(
                               args.ladder_admit_headroom_pages),
                           host_cache_pages=host_cache_pages,
+                          slo_ttft_ms=args.slo_ttft_ms,
+                          slo_tpot_ms=args.slo_tpot_ms,
                           num_pages=num_pages, page_size=args.page_size,
                           max_pages_per_seq=args.max_pages_per_seq,
                           decode_pipeline_depth=args.decode_pipeline_depth,

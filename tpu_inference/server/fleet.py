@@ -209,6 +209,11 @@ class WorkerHandle:
         # detection can both report one death.
         self.carry: Dict[tuple, dict] = {}
         self.folded_incarnation = 0
+        # SLO breach totals from dead incarnations: the fleet-level
+        # tpu_inf_slo_breaches_total sums live worker counts on top of
+        # this, so a worker restart never makes the fleet counter
+        # decrease (Prometheus rate() reads any dip as a reset).
+        self.slo_breach_carry = {"ttft": 0, "tpot": 0}
 
     @property
     def routable(self) -> bool:
@@ -334,6 +339,15 @@ class ProcessEngineGroup:
         from concurrent.futures import ThreadPoolExecutor
         self._peek_pool = ThreadPoolExecutor(
             max_workers=max(4, self.dp), thread_name_prefix="fleet-peek")
+        # Cross-process trace assembly (README "Observability"): the
+        # router's own spans (request root, route, handoff, migrate)
+        # record here, and worker-exported spans — riding finish/
+        # handoff-spans/migrate event frames, already tagged with their
+        # source replica and unix-anchored — fold in via ingest(), so
+        # one recorder holds each request's full cross-process span
+        # set. /debug/trace reads it; the trace RPC verb is the pull
+        # fallback for traces this router never saw finish.
+        self._recorder = telemetry.SpanRecorder(replica=-1)
         self._rr = 0
         self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0,
                               "host_hit_pages": 0}
@@ -406,6 +420,27 @@ class ProcessEngineGroup:
             "Prefill->decode handoff wall: worker-side KV export + "
             "router-side routing/dispatch until the decode worker "
             "accepted the resume")
+        # Fleet-level rolling SLO gauges: EXACT quantiles pooled across
+        # every worker's ring (per-replica p95s do not compose by
+        # max/mean), from the cached worker stats the monitor refreshes
+        # ~1/s; breach totals add the dead-incarnation carry so a
+        # worker restart never makes the fleet counter decrease.
+        # Per-replica series render from the workers' own registries
+        # under replica="i" labels.
+        telemetry.register_fleet_slo(
+            r, self._pooled_slo_quantile,
+            lambda k: sum(h.slo_breach_carry[k]
+                          + (((h.last_stats or {}).get("slo") or {})
+                             .get(f"{k}_breaches", 0))
+                          for h in self.workers))
+        import jax
+        telemetry.emit_build_info(
+            r, backend=jax.default_backend(), fleet="subprocess",
+            kv_quant=self.engine_cfg.kv_quant,
+            spec_mode=(self.engine_cfg.spec_mode
+                       if self.engine_cfg.num_speculative_tokens > 0
+                       else "off"),
+            routing=self.server_cfg.routing)
         for h in self.workers:
             r.gauge("tpu_inf_worker_role_info",
                     "Worker phase role (constant 1; the role is the "
@@ -425,6 +460,24 @@ class ProcessEngineGroup:
                       "across incarnations)",
                       fn=lambda hh=h: hh.restarts,
                       replica=str(h.replica))
+
+    def _pooled_slo_quantile(self, which: str, q: float) -> float:
+        windows = [(((h.last_stats or {}).get("slo") or {})
+                    .get(f"{which}_window")) or []
+                   for h in self.workers]
+        v = telemetry.pooled_quantile(windows, q)
+        return float("nan") if v is None else v
+
+    def _fleet_slo(self) -> dict:
+        out = telemetry.pooled_slo(
+            [(h.last_stats or {}).get("slo") for h in self.workers])
+        # Dead-incarnation carry keeps the fleet totals monotone
+        # across worker restarts (same stance as the metrics carry).
+        out["ttft_breaches"] += sum(h.slo_breach_carry["ttft"]
+                                    for h in self.workers)
+        out["tpot_breaches"] += sum(h.slo_breach_carry["tpot"]
+                                    for h in self.workers)
+        return out
 
     # ----------------------------------------------------------- spawn
 
@@ -558,6 +611,7 @@ class ProcessEngineGroup:
             leftovers = list(self._tracked.values())
             self._tracked.clear()
         for entry in leftovers:
+            self._finish_trace(entry, "shutdown")
             ghost = entry.seq_local
             ghost.done, ghost.finish_reason = True, "shutdown"
             ghost.finish_time = time.perf_counter()
@@ -659,6 +713,16 @@ class ProcessEngineGroup:
             h.folded_incarnation = h.incarnation
             telemetry.fold_dump_into_carry(h.carry, h.last_metrics)
             h.last_metrics = []
+            # Fold the dead incarnation's SLO breach totals, then zero
+            # the cached copy — keeping both would double-count until
+            # the fresh incarnation's first stats refresh.
+            slo = (h.last_stats or {}).get("slo") or {}
+            h.slo_breach_carry["ttft"] += slo.get("ttft_breaches", 0)
+            h.slo_breach_carry["tpot"] += slo.get("tpot_breaches", 0)
+            if slo:
+                h.last_stats = {**h.last_stats,
+                                "slo": {**slo, "ttft_breaches": 0,
+                                        "tpot_breaches": 0}}
         telemetry.log_event("worker_down", level="warning",
                             replica=h.replica, reason=reason)
         self._schedule_restart(h)
@@ -837,6 +901,16 @@ class ProcessEngineGroup:
 
     def submit(self, seq: Sequence, on_token: Callable,
                on_finish: Callable) -> None:
+        # Trace-id propagation (README "Observability"): HTTP ingress
+        # mints or propagates X-Request-Id; every OTHER ingress (bench
+        # harnesses, tests driving the group directly) used to submit
+        # with trace_id="" and worker-side logs/spans fell back to the
+        # engine-internal str(request_id) — un-joinable across the
+        # processes a handoff spans. Mint here so the id exists BEFORE
+        # the clone/dispatch below ships it to the first worker.
+        if not seq.trace_id:
+            import uuid
+            seq.trace_id = uuid.uuid4().hex[:16]
         # New prompts are prefill work: under a P/D split they go to the
         # prefill tier only (README "P/D disaggregation"). ONE snapshot
         # of the routable set — a worker dying between an emptiness
@@ -848,7 +922,11 @@ class ProcessEngineGroup:
                 self.requests_unavailable += 1
             raise FleetUnavailable("no routable worker",
                                    self.server_cfg.retry_after_s)
+        t_route = time.perf_counter()
         h, hit, load = self._pick(pool, seq)
+        self._recorder.add(
+            "route", seq.trace_id, t_route, time.perf_counter(),
+            dest=h.replica, hbm_hit=hit[0], host_hit=hit[1], load=load)
         cap = self.server_cfg.admission_queue_depth
         if cap > 0 and load >= cap:
             # Affinity saturated a warm worker: least-loaded fallback
@@ -857,6 +935,10 @@ class ProcessEngineGroup:
             if load2 >= cap:
                 with self._lock:
                     self.requests_shed += 1
+                # A shed IS terminal: seal the route span so sustained
+                # overload can't fill the recorder's open table and
+                # evict a LIVE request's trace.
+                self._recorder.seal(seq.trace_id)
                 raise FleetSaturated(
                     f"admission queue cap reached ({load2} >= {cap} on "
                     "the least-loaded worker)",
@@ -980,6 +1062,7 @@ class ProcessEngineGroup:
         rid = entry.template.request_id
         with self._lock:
             self._tracked.pop(rid, None)
+        self._finish_trace(entry, "unavailable")
         ghost = entry.seq_local
         ghost.done, ghost.finish_reason = True, "unavailable"
         ghost.finish_time = time.perf_counter()
@@ -1020,6 +1103,11 @@ class ProcessEngineGroup:
             self._on_finish(h, client, obj)
         elif ev == "handoff":
             self._on_handoff(h, client, obj, blob)
+        elif ev == "spans":
+            # A prefill worker's sealed handoff-side spans (the handoff
+            # frame itself left before the worker sealed its trace).
+            self._recorder.ingest(obj.get("trace") or "",
+                                  obj.get("spans") or ())
         elif ev == "migrate":
             self._on_migrate(h, client, obj, blob)
         elif ev == "drained":
@@ -1056,9 +1144,28 @@ class ProcessEngineGroup:
                 sl.first_token_time = time.perf_counter()
         entry.on_token(sl, tok)
 
+    def _finish_trace(self, entry: _Tracked, reason: str) -> None:
+        """Terminal end of a tracked request: emit the router's root
+        span (submit -> terminal, every attempt/handoff inside it) and
+        seal the assembled cross-process trace into the recent ring —
+        the /debug/trace and Chrome-export source."""
+        rec = self._recorder
+        if not rec.enabled:
+            return
+        t = entry.template
+        tid = t.trace_id or str(t.request_id)
+        rec.add("request", tid, entry.t_submit, time.perf_counter(),
+                parent="", reason=reason, attempts=entry.attempts,
+                output_tokens=len(entry.tokens))
+        rec.seal(tid)
+
     def _on_finish(self, h, client, obj) -> None:
         rid = obj["rid"]
         reason = obj.get("reason", "stop")
+        # Worker-side spans ride the finish frame; fold them in before
+        # the terminal path below seals the trace.
+        self._recorder.ingest(obj.get("trace") or "",
+                              obj.get("spans") or ())
         with self._lock:
             entry = self._entry_for(rid, h, client)
             if entry is None:
@@ -1094,6 +1201,7 @@ class ProcessEngineGroup:
                 return
             self._retry_or_fail(entry, exclude=hh)
             return
+        self._finish_trace(entry, reason)
         sl = entry.seq_local
         sl.done = True
         sl.finish_reason = reason
@@ -1163,6 +1271,14 @@ class ProcessEngineGroup:
             self._pd_handoff_s_hist.observe(
                 float(obj.get("export_s") or 0.0)
                 + time.perf_counter() - t0)
+            # Router-side handoff span: routing + dispatch until the
+            # decode worker accepted the resume (the worker-side export
+            # span precedes it on the assembled timeline).
+            self._recorder.add(
+                "handoff", entry.template.trace_id or str(rid),
+                t0, time.perf_counter(), source=h.replica,
+                dest=dest.replica, export_s=obj.get("export_s"),
+                streamed=len(entry.tokens))
         else:
             self._retry_or_fail(entry, exclude=dest)
 
@@ -1171,6 +1287,12 @@ class ProcessEngineGroup:
         KV pages into a destination worker's host tier and resubmit with
         the router's token record — the swap-in-resume path."""
         rid = obj["rid"]
+        t_mig = time.perf_counter()
+        # The draining worker's in-flight spans (chunks, swaps, the
+        # drain_export) ride the migrate event — fold them in so the
+        # trace survives the process that recorded them.
+        self._recorder.ingest(obj.get("trace") or "",
+                              obj.get("spans") or ())
         with self._lock:
             entry = self._entry_for(rid, h, client)
             if entry is None:
@@ -1223,7 +1345,13 @@ class ProcessEngineGroup:
             request_id=entry.template.trace_id or str(rid),
             source=h.replica, dest=dest.replica,
             pages=len(digests), streamed=len(entry.tokens))
-        if not self._dispatch(entry, dest, hit):
+        if self._dispatch(entry, dest, hit):
+            self._recorder.add(
+                "migrate", entry.template.trace_id or str(rid),
+                t_mig, time.perf_counter(), source=h.replica,
+                dest=dest.replica, pages=len(digests),
+                streamed=len(entry.tokens))
+        else:
             self._retry_or_fail(entry, exclude=dest)
 
     def _on_drained(self, h, client, obj) -> None:
@@ -1268,6 +1396,7 @@ class ProcessEngineGroup:
                 rid = entry.template.request_id
                 with self._lock:
                     self._tracked.pop(rid, None)
+                self._finish_trace(entry, "unavailable")
                 ghost = entry.seq_local
                 ghost.done, ghost.finish_reason = True, "unavailable"
                 ghost.finish_time = time.perf_counter()
@@ -1421,7 +1550,7 @@ class ProcessEngineGroup:
                       "load", "draining", "host_cache",
                       "swap_in_resumes", "prefill_backlog",
                       "ladder_occupancy", "pd_handoffs", "pd_adoptions",
-                      "pd_adopt_fallbacks"):
+                      "pd_adopt_fallbacks", "slo"):
                 if k in hz:
                     d[k] = hz[k]
             replicas.append(d)
@@ -1437,6 +1566,9 @@ class ProcessEngineGroup:
             "fleet": "subprocess",
             "routing": self.server_cfg.routing,
             "replicas": replicas,
+            # Fleet-aggregated rolling SLO view: EXACT quantiles pooled
+            # across worker windows (the autoscaler's input signal).
+            "slo": self._fleet_slo(),
             "supervision": self.supervision_counters(),
         }
 
@@ -1499,3 +1631,65 @@ class ProcessEngineGroup:
                 pass
         items.sort(key=lambda t: t.get("finished_unix", 0.0))
         return items[-n:]
+
+    # -------------------------------------------- tracing + profiling
+
+    def _pid_names(self) -> dict:
+        return {0: "router",
+                **{h.replica + 1:
+                   f"replica {h.replica} ({self.roles[h.replica]})"
+                   for h in self.workers}}
+
+    def trace_snapshot(self, trace_id: str) -> Optional[dict]:
+        """One request's assembled cross-process span tree (GET
+        /debug/trace?id=). The router's recorder holds the event-frame
+        assembly; a miss falls back to the workers' trace pull verb
+        (e.g. the router restarted mid-request)."""
+        spans = self._recorder.get_trace(trace_id)
+        if spans is None:
+            pulled: List[dict] = []
+            for h in self.workers:
+                if h.state != UP or h.client is None:
+                    continue
+                try:
+                    pulled.extend(h.client.rpc(
+                        "trace", timeout=10.0, trace=trace_id)["spans"])
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    pass
+            spans = pulled or None
+        if not spans:
+            return None
+        return telemetry.assemble_trace(trace_id, spans)
+
+    def trace_chrome(self, n: int = 128) -> dict:
+        """The recent-request ring as Chrome trace-event JSON (GET
+        /debug/trace?format=chrome): one pid per replica, router as
+        pid 0, loadable in Perfetto."""
+        maintenance: List[dict] = []
+        for h in self.workers:
+            if h.state != UP or h.client is None:
+                continue
+            try:
+                maintenance.extend(h.client.rpc(
+                    "trace", timeout=10.0, n=0)["maintenance"])
+            except (WorkerGone, TimeoutError, RuntimeError):
+                pass
+        return telemetry.spans_to_chrome(
+            self._recorder.recent_traces(n), self._pid_names(),
+            maintenance=maintenance,
+            other_data={"fleet": "subprocess",
+                        "roles": list(self.roles),
+                        "spans_dropped": self._recorder.spans_dropped})
+
+    def capture_profile(self, replica: int, seconds: float) -> dict:
+        """POST /debug/profile {"seconds": N, "replica": i}: forward a
+        jax.profiler capture to one live worker over the profile RPC;
+        the worker writes the trace dir (under the operator-configured
+        profile_dir) and returns its path."""
+        h = self.workers[int(replica)]
+        if h.state != UP or h.client is None:
+            raise ValueError(f"worker {replica} not serving "
+                             f"(state={h.state})")
+        r = h.client.rpc("profile", timeout=float(seconds) + 120.0,
+                         seconds=float(seconds))
+        return {k: v for k, v in r.items() if k not in ("id", "ok")}

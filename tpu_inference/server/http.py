@@ -184,6 +184,7 @@ class InferenceServer:
         app.router.add_get("/metrics", self.handle_metrics)
         if self.cfg.server.enable_debug:
             app.router.add_get("/debug/requests", self.handle_debug_requests)
+            app.router.add_get("/debug/trace", self.handle_debug_trace)
             app.router.add_post("/debug/profile", self.handle_profile)
             app.router.add_post("/debug/chaos", self.handle_chaos)
         app.on_startup.append(self._on_startup)
@@ -412,13 +413,48 @@ class InferenceServer:
         return web.json_response(
             await asyncio.to_thread(self.group.recent_snapshot, n))
 
-    async def handle_profile(self, request: web.Request) -> web.Response:
-        """Start/stop a jax.profiler trace (TensorBoard / Perfetto).
+    async def handle_debug_trace(self, request: web.Request
+                                 ) -> web.Response:
+        """Distributed request traces (README "Observability").
 
-        POST {"action": "start"} then {"action": "stop"} after driving
-        load; inspect with tensorboard --logdir or ui.perfetto.dev.
-        Traces always land in ServerConfig.profile_dir — the client
-        cannot choose a filesystem path.
+        ``GET /debug/trace?id=<trace_id>`` returns one request's
+        assembled cross-process span tree (router + every worker that
+        served an attempt/handoff under one trace id);
+        ``GET /debug/trace?format=chrome`` renders the recent-request
+        ring as Chrome trace-event JSON — one pid per replica, router
+        as pid 0 — loadable at ui.perfetto.dev or chrome://tracing."""
+        if request.query.get("format") == "chrome":
+            try:
+                n = int(request.query.get("n", 128))
+            except ValueError:
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": "'n' must be an integer"}),
+                    content_type="application/json")
+            return web.json_response(
+                await asyncio.to_thread(self.group.trace_chrome, n))
+        tid = (request.query.get("id") or "").strip()
+        if not tid:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "pass ?id=<trace_id> or ?format=chrome"}),
+                content_type="application/json")
+        snap = await asyncio.to_thread(self.group.trace_snapshot, tid)
+        if snap is None:
+            raise web.HTTPNotFound(text=json.dumps(
+                {"error": f"no trace {tid!r} in the recent ring"}),
+                content_type="application/json")
+        return web.json_response(snap)
+
+    async def handle_profile(self, request: web.Request) -> web.Response:
+        """On-demand jax.profiler capture (TensorBoard / Perfetto).
+
+        POST {"seconds": N, "replica": i} captures a device profile on
+        the chosen replica for N seconds while it keeps serving (the
+        subprocess fleet forwards over the profile RPC; the worker
+        writes the trace dir and returns its path). The legacy
+        {"action": "start"} / {"action": "stop"} pair still profiles
+        this process. Traces always land under
+        ServerConfig.profile_dir — the client cannot choose a
+        filesystem path.
         """
         import jax
 
@@ -429,6 +465,23 @@ class InferenceServer:
             raise web.HTTPBadRequest(text=json.dumps(
                 {"error": "body must be a JSON object"}),
                 content_type="application/json")
+        if body.get("seconds") is not None:
+            try:
+                seconds = float(body["seconds"])
+                replica = int(body.get("replica", 0))
+                if not (0 < seconds <= 60):
+                    raise ValueError("'seconds' must be in (0, 60]")
+                if not (0 <= replica < len(self.group.engines)):
+                    raise ValueError(f"no replica {replica}")
+            except (TypeError, ValueError) as e:
+                raise web.HTTPBadRequest(text=json.dumps(
+                    {"error": str(e)}), content_type="application/json")
+            try:
+                result = await asyncio.to_thread(
+                    self.group.capture_profile, replica, seconds)
+            except Exception as e:  # noqa: BLE001 — worker-side failure
+                return web.json_response({"error": str(e)}, status=503)
+            return web.json_response({"status": "captured", **result})
         action = body.get("action")
         if action == "start":
             trace_dir = self.cfg.server.profile_dir

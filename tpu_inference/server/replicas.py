@@ -179,6 +179,7 @@ class _Tracked:
     delivered: int = 0                  # tokens forwarded to the caller
     attempts: int = 0                   # failover resubmissions so far
     generation: int = 0                 # bumped to orphan stale callbacks
+    t_submit: float = 0.0               # perf_counter at submit (root span)
 
 
 class EngineGroup:
@@ -231,6 +232,15 @@ class EngineGroup:
                              for _ in engines]
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
+        # Cross-replica trace assembly (README "Observability"): each
+        # engine's recorder holds its replica's spans (stamped with the
+        # replica index here); the group's own recorder holds the
+        # router-side spans (request root, route) — /debug/trace reads
+        # them together. Same shape as the subprocess router, minus the
+        # transport (everything is in-process).
+        self._recorder = telemetry.SpanRecorder(replica=-1)
+        for i, e in enumerate(self.engines):
+            e.telemetry.recorder.replica = i
         # Fleet-level Prometheus registry: supervision counters (no
         # replica label — they are fleet decisions) + per-replica health
         # gauges. Rendered together with each engine's registry (under
@@ -277,6 +287,44 @@ class EngineGroup:
             r.counter("tpu_inf_replica_wedges_total",
                       "Step-watchdog firings (wedged dispatches)",
                       fn=lambda h=health: h.wedges, replica=str(i))
+        # Fleet-level rolling SLO gauges: EXACT quantiles pooled across
+        # every replica's window (the per-replica series render from
+        # each engine's own registry under replica="i" labels).
+        telemetry.register_fleet_slo(
+            r, self._pooled_slo_quantile,
+            lambda k: sum(getattr(e.telemetry.slo, f"{k}_breaches", 0)
+                          for e in self.engines
+                          if e.telemetry.slo is not None))
+        # Dashboard-join info gauge, on the fleet registry AND every
+        # replica registry (label values are pure config: identical
+        # across replicas and restarts).
+        import jax
+        ecfg = self.engines[0].engine_cfg
+        kw = dict(backend=jax.default_backend(),
+                  fleet=self.server_cfg.fleet,
+                  kv_quant=ecfg.kv_quant,
+                  spec_mode=(self.engines[0].spec_mode
+                             if self.engines[0].spec_enabled else "off"),
+                  routing=self.server_cfg.routing)
+        telemetry.emit_build_info(r, **kw)
+        for e in self.engines:
+            if e.telemetry.enabled:
+                telemetry.emit_build_info(e.telemetry.registry, **kw)
+
+    def _pooled_slo_quantile(self, which: str, q: float) -> float:
+        windows = []
+        for e in self.engines:
+            slo = e.telemetry.slo
+            if slo is not None:
+                ring = slo.ttft if which == "ttft" else slo.tpot
+                windows.append(ring.values())
+        v = telemetry.pooled_quantile(windows, q)
+        return float("nan") if v is None else v
+
+    def _fleet_slo(self) -> dict:
+        return telemetry.pooled_slo(
+            [e.telemetry.slo.snapshot() for e in self.engines
+             if e.telemetry.slo is not None])
 
     @property
     def engine(self) -> InferenceEngine:
@@ -502,13 +550,24 @@ class EngineGroup:
         these to 503/429 with Retry-After. Scheduler-level rejections
         (queue_full, too_large) still arrive via on_finish.
         """
+        # Trace-id propagation: mint when the ingress didn't (direct
+        # group submits from benchmarks/tests) so logs and spans are
+        # joinable under one id on every path.
+        if not seq.trace_id:
+            import uuid
+            seq.trace_id = uuid.uuid4().hex[:16]
         routable = self._routable()
         if not routable:
             with self._lock:
                 self.requests_unavailable += 1
             raise FleetUnavailable(
                 "all replicas quarantined", self._retry_after())
+        t_route = time.perf_counter()
         sched, hit_pages = self._pick(routable, seq)
+        self._recorder.add(
+            "route", seq.trace_id, t_route, time.perf_counter(),
+            dest=self.schedulers.index(sched),
+            hbm_hit=hit_pages[0], host_hit=hit_pages[1])
         cap = self.server_cfg.admission_queue_depth
         if cap > 0 and sched.load >= cap:
             # The affinity pick can saturate a warm replica while a cold
@@ -521,11 +580,16 @@ class EngineGroup:
             if sched.load >= cap:
                 with self._lock:
                     self.requests_shed += 1
+                # A shed IS terminal: seal the route span so sustained
+                # overload can't fill the recorder's open table and
+                # evict a LIVE request's trace.
+                self._recorder.seal(seq.trace_id)
                 raise FleetSaturated(
                     f"admission queue cap reached ({sched.load} >= {cap} "
                     "on the least-loaded replica)", self._retry_after())
         entry = _Tracked(template=_clone_request(seq), on_token=on_token,
-                         on_finish=on_finish, sched=sched)
+                         on_finish=on_finish, sched=sched,
+                         t_submit=time.perf_counter())
         with self._lock:
             self._tracked[seq.request_id] = entry
         self._dispatch(entry, seq, sched, hit_pages)
@@ -612,7 +676,22 @@ class EngineGroup:
         if target is not None:
             self._dispatch(entry, _clone_request(entry.template), *target)
             return
+        self._finish_trace(entry, seq.finish_reason)
         entry.on_finish(seq)
+
+    def _finish_trace(self, entry: _Tracked, reason: str) -> None:
+        """Terminal end of a tracked request: the router-side root span
+        (submit -> terminal) + seal, mirroring the subprocess router.
+        The engine-side recorders sealed their phase spans at the
+        scheduler's finish; /debug/trace joins the two."""
+        t = entry.template
+        tid = t.trace_id or str(t.request_id)
+        self._recorder.add("request", tid, entry.t_submit or
+                           time.perf_counter(), time.perf_counter(),
+                           parent="", reason=reason,
+                           attempts=entry.attempts,
+                           output_tokens=entry.delivered)
+        self._recorder.seal(tid)
 
     def _failover_stranded(self, sched: EngineScheduler) -> None:
         """A replica was quarantined by the watchdog mid-dispatch: its
@@ -657,6 +736,7 @@ class EngineGroup:
                 ghost.finish_reason = ("unavailable" if target is None
                                        else "error")
                 ghost.finish_time = time.perf_counter()
+                self._finish_trace(entry, ghost.finish_reason)
                 entry.on_finish(ghost)
 
     def cancel(self, request_id: int) -> None:
@@ -688,6 +768,9 @@ class EngineGroup:
             # and the cached pages the router counted on — the numbers
             # that say whether conversations are actually sticking.
             d["routing"] = dict(self._route_stats[i])
+            # Rolling SLO view (quantiles + breach counts).
+            if e.telemetry.slo is not None:
+                d["slo"] = e.telemetry.slo.snapshot(include_window=False)
             # Tiered KV cache view: host-tier residency + swap churn
             # (absent when the tier is disabled on this replica).
             if e.host_pool is not None:
@@ -711,6 +794,9 @@ class EngineGroup:
             "status": status,
             "routing": self.server_cfg.routing,
             "replicas": replicas,
+            # Fleet-aggregated rolling SLO view (pooled exact
+            # quantiles; the autoscaler's input signal).
+            "slo": self._fleet_slo(),
             "supervision": self.supervision_counters(),
         }
 
@@ -749,6 +835,47 @@ class EngineGroup:
             items.extend(s.recent_snapshot(n))
         items.sort(key=lambda t: t.get("finished_unix", 0.0))
         return items[-n:]
+
+    # -------------------------------------------- tracing + profiling
+
+    def _trace_spans(self, trace_id: str) -> List[dict]:
+        spans = self._recorder.get_trace(trace_id) or []
+        for e in self.engines:
+            spans.extend(e.telemetry.recorder.get_trace(trace_id) or ())
+        return spans
+
+    def trace_snapshot(self, trace_id: str) -> Optional[dict]:
+        """One request's assembled span tree (GET /debug/trace?id=):
+        router-side spans + every replica recorder's spans for the
+        trace, joined in place (no transport in-process)."""
+        spans = self._trace_spans(trace_id)
+        if not spans:
+            return None
+        return telemetry.assemble_trace(trace_id, spans)
+
+    def trace_chrome(self, n: int = 128) -> dict:
+        """The recent-request ring as Chrome trace-event JSON (GET
+        /debug/trace?format=chrome), one pid per replica + pid 0 for
+        the group's routing spans — loadable in Perfetto."""
+        traces = {tid: self._trace_spans(tid)
+                  for tid in self._recorder.recent_traces(n)}
+        maintenance: List[dict] = []
+        for e in self.engines:
+            maintenance.extend(e.telemetry.recorder.maintenance_spans())
+        return telemetry.spans_to_chrome(
+            traces,
+            {0: "router", **{i + 1: f"replica {i}"
+                             for i in range(len(self.engines))}},
+            maintenance=maintenance,
+            other_data={"fleet": self.server_cfg.fleet,
+                        "spans_dropped": self._recorder.spans_dropped})
+
+    def capture_profile(self, replica: int, seconds: float) -> dict:
+        """POST /debug/profile {"seconds": N}: run a jax.profiler
+        capture in this process (all in-process replicas share one jax
+        runtime, so the replica argument only names the trace dir)."""
+        return telemetry.capture_jax_profile(
+            self.server_cfg.profile_dir, replica, seconds)
 
     def stats_snapshot(self) -> dict:
         """Aggregate counters + per-replica breakdown."""
@@ -821,6 +948,11 @@ def aggregate_replica_stats(per: List[dict], supervision: dict) -> dict:
     shape regardless of --fleet."""
     if len(per) == 1:
         out = dict(per[0])
+        if isinstance(out.get("slo"), dict):
+            # Same window-stripping as the dp>1 path (a copy — the
+            # caller may cache the original, windows included).
+            out["slo"] = {k: v for k, v in out["slo"].items()
+                          if not k.endswith("_window")}
         out["supervision"] = supervision
         return out
     agg = dict(per[0])
@@ -838,6 +970,19 @@ def aggregate_replica_stats(per: List[dict], supervision: dict) -> dict:
     # differ by design, and supervision carries the full role list.
     agg.pop("health", None)
     agg.pop("role", None)
+    # Rolling SLO: fleet quantiles must POOL the replicas' raw windows
+    # (summing or averaging per-replica quantiles fabricates numbers).
+    # After pooling, the ~512-entry windows are stripped from the
+    # per-replica views COPIES (never the caller's dicts — the
+    # subprocess router caches them, windows included, for its pooled
+    # gauges): they exist for this aggregation, not for every scrape
+    # to carry kilobytes of raw floats.
+    if any("slo" in d for d in per):
+        agg["slo"] = telemetry.pooled_slo([d.get("slo") for d in per])
+        per = [({**d, "slo": {k: v for k, v in d["slo"].items()
+                              if not k.endswith("_window")}}
+                if isinstance(d.get("slo"), dict) else d)
+               for d in per]
     # Fleet phase histograms = element-wise bucket merge across
     # replicas (replica 0's copy would otherwise masquerade as the
     # fleet's); per-replica views stay under "replicas".

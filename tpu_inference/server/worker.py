@@ -215,6 +215,22 @@ class EngineWorker:
         self.engine = InferenceEngine(cfg.model, cfg.engine, params=params,
                                       seed=cfg.seed, mesh=mesh)
         self.sched = EngineScheduler(self.engine)
+        # Tracing + dashboard-join series: spans this worker records
+        # carry its stable replica index, and the registry emits the
+        # build_info gauge with config-pure labels (identical across
+        # restarts, so the router's carry never sees a label change).
+        import jax as _jax
+
+        from tpu_inference import telemetry as _tm
+        self.engine.telemetry.recorder.replica = self.replica
+        _tm.emit_build_info(
+            self.engine.telemetry.registry,
+            backend=_jax.default_backend(),
+            fleet=cfg.server.fleet,
+            kv_quant=cfg.engine.kv_quant,
+            spec_mode=(self.engine.spec_mode if self.engine.spec_enabled
+                       else "off"),
+            routing=cfg.server.routing)
         if self.role == "prefill":
             self.sched.on_prefill_handoff = self._emit_handoff
         if self.do_warmup:
@@ -274,7 +290,7 @@ class EngineWorker:
     # waits, scheduler drains) run on their own thread so the reader
     # stays responsive — the router's routing peeks must never stall
     # behind a migration import or an embed batch on the same worker.
-    _SLOW_VERBS = ("import_kv", "embed", "shutdown")
+    _SLOW_VERBS = ("import_kv", "embed", "shutdown", "profile")
 
     def handle(self, conn: _Conn, obj: Dict[str, Any],
                blob: bytes) -> None:
@@ -328,6 +344,13 @@ class EngineWorker:
         if not pages:
             return False
         blob = kvc.serialize_host_pages(pages)
+        # Trace span: the live KV export — adjacent to (never
+        # overlapping) this worker's prefill span and the decode
+        # worker's handoff_adopt on the assembled timeline.
+        self.engine.telemetry.recorder.add(
+            "handoff_export", seq.trace_id or str(seq.request_id),
+            t0, time.perf_counter(), pages=len(pages), bytes=len(blob),
+            ctx_len=ctx_len)
         self._req_conn.pop(seq.request_id, None)
         conn.send({"ev": "handoff", "rid": seq.request_id,
                    "n_generated": len(seq.generated),
@@ -413,10 +436,17 @@ class EngineWorker:
 
         def on_finish(sq) -> None:
             self._req_conn.pop(rid, None)
+            tid = sq.trace_id or str(rid)
+            spans = self.engine.telemetry.recorder.export_recent(tid)
             if sq.finish_reason == "handoff":
                 # The handoff event already left on this connection and
                 # IS the request's continuation — a finish frame here
-                # would terminate the client stream mid-generation.
+                # would terminate the client stream mid-generation. The
+                # prefill-side spans (sealed just now, AFTER the
+                # handoff frame) ship on their own event instead.
+                if spans:
+                    conn.send({"ev": "spans", "rid": rid, "trace": tid,
+                               "spans": spans})
                 return
             fin = sq.finish_time or time.perf_counter()
             first = sq.first_token_time or fin
@@ -431,6 +461,10 @@ class EngineWorker:
                 "resume_base": sq.resume_base,
                 "prefill_s": round(max(0.0, first - start), 6),
                 "decode_s": round(max(0.0, fin - first), 6),
+                # Completed spans ride the finish frame back to the
+                # router's trace assembly (README "Observability").
+                "trace": tid,
+                "spans": spans,
             })
 
         self.sched.submit(seq, on_token, on_finish)
@@ -493,6 +527,10 @@ class EngineWorker:
             "pd_adoptions": e.adoptions_in,
             "pd_adopt_fallbacks": e.adopt_fallbacks,
         }
+        # Rolling SLO view (quantiles + breaches; windows stay in the
+        # stats snapshot — healthz is the human-sized surface).
+        if e.telemetry.slo is not None:
+            out["slo"] = e.telemetry.slo.snapshot(include_window=False)
         if e.host_pool is not None:
             out["host_cache"] = {
                 "capacity_pages": e.host_pool.capacity,
@@ -502,12 +540,43 @@ class EngineWorker:
                 "imported": e.host_pool.imported_total,
                 "evicted": e.host_pool.evicted_total,
                 "swap_in_resumes": e.swap_in_resumes,
+                "swap_out_s_total": round(
+                    e.host_pool.swap_out_s_total, 6),
+                "swap_in_s_total": round(
+                    e.host_pool.swap_in_s_total, 6),
             }
         return out
 
     def _verb_recent(self, conn, obj, blob) -> dict:
         return {"recent": self.sched.recent_snapshot(
             int(obj.get("n", 50)))}
+
+    def _verb_trace(self, conn, obj, blob) -> dict:
+        """Pull-based span access (README "Observability"): one trace's
+        spans by id, or the recent finished-trace ring — the router's
+        fallback when its own assembly missed event frames (e.g. it
+        restarted mid-request)."""
+        # NB: the trace id rides under "trace" — "id" is the RPC
+        # correlation id on every frame.
+        rec = self.engine.telemetry.recorder
+        tid = obj.get("trace")
+        if tid:
+            return {"spans": rec.get_trace(str(tid)) or []}
+        return {"traces": rec.recent_traces(int(obj.get("n", 64))),
+                "maintenance": rec.maintenance_spans()}
+
+    def _verb_profile(self, conn, obj, blob) -> dict:
+        """On-demand jax.profiler capture (README "Observability"):
+        trace this worker's device+host activity for ``seconds`` and
+        return the trace directory (TensorBoard / Perfetto-loadable).
+        Serving continues while the profiler runs — that is the point:
+        the capture shows the live fleet's dispatch stream. Runs on a
+        slow-verb thread; the path is always under the operator's
+        profile_dir, never client-chosen."""
+        from tpu_inference import telemetry
+        return telemetry.capture_jax_profile(
+            self.cfg.server.profile_dir, self.replica,
+            float(obj.get("seconds", 3.0)))
 
     def _verb_chaos(self, conn, obj, blob) -> dict:
         e = self.engine
@@ -634,16 +703,27 @@ class EngineWorker:
             seq = pending.seq
             if seq.done:
                 continue
+            tid = seq.trace_id or str(seq.request_id)
             digests, host_pages = [], []
+            t_exp = time.perf_counter()
             if (migrate and seq.pages
                     and time.monotonic() - t0 < budget):
                 try:
                     digests, host_pages = engine.export_sequence_kv(seq)
                 except Exception:  # noqa: BLE001
                     digests, host_pages = [], []
+            if host_pages:
+                engine.telemetry.recorder.add(
+                    "drain_export", tid, t_exp, time.perf_counter(),
+                    pages=len(host_pages))
             ev = {"ev": "migrate", "rid": seq.request_id,
                   "n_generated": len(seq.generated),
-                  "digests": [d.hex() for d in digests]}
+                  "digests": [d.hex() for d in digests],
+                  # In-flight spans so far (chunks, swaps, the export):
+                  # the request continues on another worker, so its
+                  # trace must not die with this process.
+                  "trace": tid,
+                  "spans": engine.telemetry.recorder.export_open(tid)}
             blob = (kvc.serialize_host_pages(host_pages)
                     if host_pages else b"")
             target = self._req_conn.get(seq.request_id)

@@ -36,9 +36,11 @@ budget (README "Observability": ≤1% on the decode dispatch microbench).
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import sys
+import threading
 import time
 from bisect import bisect_left
 from typing import (Any, Callable, Dict, Iterable, List, Mapping, Optional,
@@ -543,6 +545,451 @@ def telemetry_enabled() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Distributed request tracing (README "Observability": span schema).
+#
+# A span is one JSON-able dict describing a timed phase of one request:
+#
+#     {"name", "trace": trace_id, "parent": parent span NAME ("" = the
+#      root "request" span), "ts": unix seconds, "dur": seconds,
+#      "replica": emitting replica (-1 = the router), "attrs": {...}}
+#
+# Timestamps are monotonic-anchored-to-wallclock: instrumented code
+# passes ``time.perf_counter()`` readings (the clock every existing
+# request timestamp already uses) and the recorder converts them to
+# unix seconds via a (time.time(), perf_counter()) anchor taken at
+# construction — so spans exported by DIFFERENT processes (router,
+# prefill worker, decode worker) land on one comparable timeline.
+# Parent linkage is by span NAME within a trace (the span set is a
+# small fixed vocabulary, and names are unique per trace per replica
+# except prefill_chunk, whose parent "prefill" is unambiguous), which
+# keeps cross-process assembly free of id coordination.
+# ---------------------------------------------------------------------------
+
+
+class SpanRecorder:
+    """Bounded per-process span sink (one per engine replica, plus one
+    in the router). Completed request traces move to a recent ring at
+    ``seal()``; spans for requests the process cannot attribute (cache-
+    eviction swap-outs) land in a maintenance ring instead. Thread
+    stance: a lock guards the dicts (spans are recorded at request
+    granularity, not the dispatch hot path), and all export methods
+    return copies. Disabled (``TPU_INF_TELEMETRY=0``) every method is a
+    cheap no-op, so spans ride the same kill switch as the metrics."""
+
+    MAX_TRACES = 256
+    MAX_SPANS_PER_TRACE = 96
+
+    def __init__(self, enabled: Optional[bool] = None, replica: int = -1):
+        self.enabled = (telemetry_enabled() if enabled is None else enabled)
+        self.replica = replica
+        self._anchor_unix = time.time()
+        self._anchor_mono = time.perf_counter()
+        self._open: "collections.OrderedDict[str, List[dict]]" = \
+            collections.OrderedDict()
+        self._recent: "collections.OrderedDict[str, List[dict]]" = \
+            collections.OrderedDict()
+        self._maintenance: collections.deque = collections.deque(maxlen=128)
+        self._lock = threading.Lock()
+        self.spans_dropped = 0
+
+    def to_unix(self, t_mono: float) -> float:
+        return self._anchor_unix + (t_mono - self._anchor_mono)
+
+    def _span(self, name: str, trace_id: str, t0: float, t1: float,
+              parent: str, attrs: Dict[str, Any]) -> dict:
+        span = {"name": name, "trace": trace_id, "parent": parent,
+                "ts": round(self.to_unix(t0), 6),
+                "dur": round(max(0.0, t1 - t0), 6),
+                "replica": self.replica}
+        if attrs:
+            span["attrs"] = attrs
+        return span
+
+    def add(self, name: str, trace_id: str, t0: float, t1: float,
+            parent: str = "request", **attrs: Any) -> None:
+        """Record one completed span (perf_counter start/end) under a
+        trace. Per-trace span counts and the number of open traces are
+        both capped so an unsealed trace (engine-direct callers that
+        bypass the scheduler) can never grow without bound."""
+        if not self.enabled or not trace_id:
+            return
+        span = self._span(name, trace_id, t0, t1, parent, attrs)
+        with self._lock:
+            spans = self._open.get(trace_id)
+            if spans is None:
+                while len(self._open) >= self.MAX_TRACES:
+                    self._open.popitem(last=False)
+                spans = self._open[trace_id] = []
+            if len(spans) >= self.MAX_SPANS_PER_TRACE:
+                self.spans_dropped += 1
+                return
+            spans.append(span)
+
+    def add_maintenance(self, name: str, t0: float, t1: float,
+                        **attrs: Any) -> None:
+        """Record a span no single request owns (e.g. a cache-eviction
+        swap-out batch): shows up in the Chrome timeline under a
+        per-replica maintenance lane, never in request trees."""
+        if not self.enabled:
+            return
+        self._maintenance.append(self._span(name, "-maintenance-",
+                                            t0, t1, "", attrs))
+
+    def ingest(self, trace_id: str, spans: Sequence[dict]) -> None:
+        """Fold spans exported by ANOTHER process (worker event frames)
+        into this recorder's open table — they carry their source's
+        replica tag and absolute unix timestamps already."""
+        if not self.enabled or not trace_id or not spans:
+            return
+        with self._lock:
+            dest = self._open.get(trace_id)
+            if dest is None:
+                # A finish frame's spans can arrive after the router
+                # already sealed the trace (FIFO per connection, but
+                # handoff traces span two connections): append there.
+                dest = self._recent.get(trace_id)
+            if dest is None:
+                while len(self._open) >= self.MAX_TRACES:
+                    self._open.popitem(last=False)
+                dest = self._open[trace_id] = []
+            room = self.MAX_SPANS_PER_TRACE - len(dest)
+            if room < len(spans):
+                self.spans_dropped += len(spans) - max(0, room)
+            dest.extend(list(spans)[:max(0, room)])
+
+    def seal(self, trace_id: str) -> None:
+        """The request finished: move its spans to the recent ring (the
+        /debug/trace + Chrome-export source)."""
+        if not self.enabled or not trace_id:
+            return
+        with self._lock:
+            spans = self._open.pop(trace_id, None)
+            if spans is None:
+                return
+            prior = self._recent.pop(trace_id, None)
+            if prior:
+                spans = prior + spans
+            while len(self._recent) >= self.MAX_TRACES:
+                self._recent.popitem(last=False)
+            self._recent[trace_id] = spans
+
+    def get_trace(self, trace_id: str) -> Optional[List[dict]]:
+        with self._lock:
+            spans = self._recent.get(trace_id) or self._open.get(trace_id)
+            return list(spans) if spans else None
+
+    def export_recent(self, trace_id: str) -> List[dict]:
+        """Copy a sealed trace's spans (kept in the ring for the pull
+        verb) — the worker's finish-event payload."""
+        with self._lock:
+            return list(self._recent.get(trace_id) or ())
+
+    def export_open(self, trace_id: str) -> List[dict]:
+        """Copy an UNFINISHED trace's spans so far (drain-time migrate
+        events ship these: the request continues elsewhere)."""
+        with self._lock:
+            return list(self._open.get(trace_id) or ())
+
+    def recent_traces(self, n: int = 64) -> Dict[str, List[dict]]:
+        """The last ``n`` sealed traces, oldest first (n <= 0 returns
+        none — the maintenance-only pull uses n=0)."""
+        if n <= 0:
+            return {}
+        with self._lock:
+            ids = list(self._recent)[-n:]
+            return {tid: list(self._recent[tid]) for tid in ids}
+
+    def maintenance_spans(self, n: int = 128) -> List[dict]:
+        return list(self._maintenance)[-n:]
+
+
+def assemble_trace(trace_id: str, spans: Sequence[dict]) -> dict:
+    """One request's cross-process span TREE: spans sorted by start
+    time, children nested under their parent by NAME (first match in
+    the same replica wins, then any replica; orphans attach to the
+    root). The root is the router's ``request`` span when present,
+    else a synthetic envelope covering every span."""
+    spans = sorted(spans, key=lambda s: (s.get("ts", 0.0),
+                                         -s.get("dur", 0.0)))
+    nodes = [{**s, "children": []} for s in spans]
+    root = next((n for n in nodes if n["name"] == "request"), None)
+    if root is None:
+        t0 = min((n["ts"] for n in nodes), default=0.0)
+        t1 = max((n["ts"] + n["dur"] for n in nodes), default=0.0)
+        root = {"name": "request", "trace": trace_id, "parent": "",
+                "ts": round(t0, 6), "dur": round(t1 - t0, 6),
+                "replica": -1, "children": [], "synthetic": True}
+    by_name: Dict[Tuple[str, int], dict] = {}
+    for n in nodes:
+        by_name.setdefault((n["name"], n.get("replica", -1)), n)
+        by_name.setdefault((n["name"], None), n)
+    for n in nodes:
+        if n is root:
+            continue
+        parent = n.get("parent") or "request"
+        if parent == n["name"]:
+            parent = "request"
+        target = (by_name.get((parent, n.get("replica", -1)))
+                  or by_name.get((parent, None)))
+        if target is None or target is n:
+            target = root
+        target["children"].append(n)
+    return {"trace_id": trace_id, "n_spans": len(spans),
+            "replicas": sorted({s.get("replica", -1) for s in spans}),
+            "spans": spans, "tree": root}
+
+
+def spans_to_chrome(traces: Mapping[str, Sequence[dict]],
+                    pid_names: Optional[Mapping[int, str]] = None,
+                    maintenance: Optional[Sequence[dict]] = None,
+                    other_data: Optional[dict] = None) -> dict:
+    """Render span traces as Chrome trace-event JSON (the "JSON Array
+    Format" with complete ``ph:"X"`` events) loadable in Perfetto /
+    chrome://tracing: one pid per replica (router = pid 0, replica i =
+    pid i+1), one tid per trace, absolute-unix microsecond timestamps
+    so spans from different processes interleave correctly."""
+    events: List[dict] = []
+    seen_pids: Dict[int, str] = {}
+    seen_tids: set = set()
+    pid_names = dict(pid_names or {})
+
+    def _pid(replica: int) -> int:
+        pid = replica + 1 if replica >= 0 else 0
+        if pid not in seen_pids:
+            seen_pids[pid] = pid_names.get(
+                pid, "router" if pid == 0 else f"replica {pid - 1}")
+        return pid
+
+    for tidx, (trace_id, spans) in enumerate(traces.items(), start=1):
+        for s in spans:
+            pid = _pid(int(s.get("replica", -1)))
+            if (pid, tidx) not in seen_tids:
+                seen_tids.add((pid, tidx))
+                events.append({"name": "thread_name", "ph": "M",
+                               "pid": pid, "tid": tidx,
+                               "args": {"name": f"trace {trace_id}"}})
+            events.append({
+                "name": s["name"], "cat": "request", "ph": "X",
+                "ts": round(s["ts"] * 1e6, 1),
+                "dur": round(max(s["dur"], 1e-6) * 1e6, 1),
+                "pid": pid, "tid": tidx,
+                "args": {**(s.get("attrs") or {}),
+                         "trace_id": trace_id,
+                         "parent": s.get("parent", "")},
+            })
+    for s in maintenance or ():
+        pid = _pid(int(s.get("replica", -1)))
+        events.append({
+            "name": s["name"], "cat": "maintenance", "ph": "X",
+            "ts": round(s["ts"] * 1e6, 1),
+            "dur": round(max(s["dur"], 1e-6) * 1e6, 1),
+            "pid": pid, "tid": 0,
+            "args": dict(s.get("attrs") or {}),
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": name}}
+            for pid, name in sorted(seen_pids.items())]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": dict(other_data or {})}
+
+
+# ---------------------------------------------------------------------------
+# Rolling SLO gauges (README "Observability": SLO gauges). A fixed-size
+# ring of the most recent request latencies yields EXACT windowed
+# quantiles (unlike the log-bucketed histograms, whose interpolation
+# error can exceed an SLO margin) — the input signal the autoscaler
+# (ROADMAP item 3) consumes. Ring writes are GIL-atomic list stores
+# (the scheduler's decode_call_s stance); quantile reads sort a copy.
+# ---------------------------------------------------------------------------
+
+SLO_WINDOW = 512
+SLO_QUANTILES = (0.5, 0.95)
+
+
+class RollingWindow:
+    """Ring of the last ``size`` observations with exact quantiles."""
+
+    __slots__ = ("_ring", "_n")
+
+    def __init__(self, size: int = SLO_WINDOW):
+        self._ring = [0.0] * size
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        self._ring[self._n % len(self._ring)] = v
+        self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def values(self) -> List[float]:
+        return self._ring[:min(self._n, len(self._ring))]
+
+    def quantile(self, q: float) -> Optional[float]:
+        # Delegates so the per-replica and fleet-pooled gauges can
+        # never drift onto different estimators.
+        return pooled_quantile([self.values()], q)
+
+
+def pooled_quantile(windows: Sequence[Sequence[float]],
+                    q: float) -> Optional[float]:
+    """Exact quantile over several replicas' pooled ring contents (the
+    fleet view — per-replica quantiles do not compose by max/mean)."""
+    xs = sorted(v for w in windows for v in (w or ()))
+    if not xs:
+        return None
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+class SLOTracker:
+    """Windowed TTFT/TPOT quantiles + breach counting against the
+    ``--slo-ttft-ms`` / ``--slo-tpot-ms`` targets (0 = no target: the
+    quantile gauges still export, breaches never count)."""
+
+    def __init__(self, ttft_target_s: float = 0.0,
+                 tpot_target_s: float = 0.0):
+        self.ttft_target_s = max(0.0, ttft_target_s)
+        self.tpot_target_s = max(0.0, tpot_target_s)
+        self.ttft = RollingWindow()
+        self.tpot = RollingWindow()
+        self.ttft_breaches = 0
+        self.tpot_breaches = 0
+
+    def observe(self, ttft_s: Optional[float],
+                tpot_s: Optional[float]) -> None:
+        if ttft_s is not None:
+            self.ttft.observe(ttft_s)
+            if self.ttft_target_s > 0 and ttft_s > self.ttft_target_s:
+                self.ttft_breaches += 1
+        if tpot_s is not None:
+            self.tpot.observe(tpot_s)
+            if self.tpot_target_s > 0 and tpot_s > self.tpot_target_s:
+                self.tpot_breaches += 1
+
+    def gauge_value(self, which: str, q: float) -> float:
+        """Read-through value for the Prometheus gauges (NaN = empty
+        window, the Prometheus idiom for 'no data')."""
+        ring = self.ttft if which == "ttft" else self.tpot
+        v = ring.quantile(q)
+        return float("nan") if v is None else v
+
+    def snapshot(self, include_window: bool = True) -> dict:
+        def _r(v):
+            return None if v is None else round(v, 6)
+
+        out = {
+            "ttft_target_s": self.ttft_target_s or None,
+            "tpot_target_s": self.tpot_target_s or None,
+            "ttft_p50_s": _r(self.ttft.quantile(0.5)),
+            "ttft_p95_s": _r(self.ttft.quantile(0.95)),
+            "tpot_p50_s": _r(self.tpot.quantile(0.5)),
+            "tpot_p95_s": _r(self.tpot.quantile(0.95)),
+            "ttft_breaches": self.ttft_breaches,
+            "tpot_breaches": self.tpot_breaches,
+            "window_requests": min(self.ttft.count, SLO_WINDOW),
+        }
+        if include_window:
+            # Raw ring contents so fleet aggregation can pool EXACT
+            # quantiles across replicas (max/mean of p95s is not a p95).
+            out["ttft_window"] = [round(v, 6) for v in self.ttft.values()]
+            out["tpot_window"] = [round(v, 6) for v in self.tpot.values()]
+        return out
+
+
+def pooled_slo(slos: Sequence[Optional[dict]]) -> dict:
+    """Fleet-level SLO view from per-replica snapshots (with windows):
+    pooled exact quantiles + summed breach counts."""
+    slos = [s for s in slos if s]
+
+    def _r(v):
+        return None if v is None else round(v, 6)
+
+    ttft = [s.get("ttft_window") or [] for s in slos]
+    tpot = [s.get("tpot_window") or [] for s in slos]
+    return {
+        "ttft_target_s": next((s.get("ttft_target_s") for s in slos
+                               if s.get("ttft_target_s")), None),
+        "tpot_target_s": next((s.get("tpot_target_s") for s in slos
+                               if s.get("tpot_target_s")), None),
+        "ttft_p50_s": _r(pooled_quantile(ttft, 0.5)),
+        "ttft_p95_s": _r(pooled_quantile(ttft, 0.95)),
+        "tpot_p50_s": _r(pooled_quantile(tpot, 0.5)),
+        "tpot_p95_s": _r(pooled_quantile(tpot, 0.95)),
+        "ttft_breaches": sum(s.get("ttft_breaches", 0) for s in slos),
+        "tpot_breaches": sum(s.get("tpot_breaches", 0) for s in slos),
+        "window_requests": sum(s.get("window_requests", 0) for s in slos),
+    }
+
+
+def register_fleet_slo(registry: Registry,
+                       quantile_fn: Callable[[str, float], float],
+                       breaches_fn: Callable[[str], float]) -> None:
+    """THE fleet-level SLO series registration, shared by both fleet
+    backends (EngineGroup pools live trackers, ProcessEngineGroup pools
+    cached worker windows + the restart carry) so their /metrics
+    surfaces cannot drift. ``quantile_fn(kind, q)`` returns the pooled
+    exact quantile (NaN = no data); ``breaches_fn(kind)`` the monotone
+    fleet breach total."""
+    for q in SLO_QUANTILES:
+        registry.gauge("tpu_inf_slo_ttft_seconds",
+                       "Fleet rolling exact TTFT quantile (pooled "
+                       "across replica windows; NaN = no data)",
+                       fn=lambda q=q: quantile_fn("ttft", q),
+                       q=f"{q:g}")
+        registry.gauge("tpu_inf_slo_tpot_seconds",
+                       "Fleet rolling exact TPOT quantile (pooled "
+                       "across replica windows; NaN = no data)",
+                       fn=lambda q=q: quantile_fn("tpot", q),
+                       q=f"{q:g}")
+    for kind in ("ttft", "tpot"):
+        registry.counter("tpu_inf_slo_breaches_total",
+                         "Fleet SLO target breaches (monotone across "
+                         "worker restarts)",
+                         fn=lambda k=kind: breaches_fn(k), slo=kind)
+
+
+def capture_jax_profile(profile_dir: str, replica: int,
+                        seconds: float) -> Dict[str, Any]:
+    """THE jax.profiler capture body behind POST /debug/profile, shared
+    by the worker's profile RPC verb and the in-process group: clamp,
+    trace into a per-replica dir under the OPERATOR's profile_dir
+    (never a client-chosen path), return where it landed. Serving
+    continues while the profiler runs — that is the point."""
+    import jax
+
+    seconds = min(max(0.1, float(seconds)), 60.0)
+    trace_dir = os.path.join(profile_dir, f"replica{int(replica)}")
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        jax.profiler.stop_trace()
+    return {"dir": trace_dir, "seconds": seconds,
+            "replica": int(replica)}
+
+
+def emit_build_info(registry: Registry, *, backend: str = "",
+                    fleet: str = "", kv_quant: str = "",
+                    spec_mode: str = "", routing: str = "") -> None:
+    """The ``tpu_inf_build_info`` info-gauge (constant 1; the labels
+    are the payload) every registry emits so dashboards can join series
+    across replicas and restarts. Label VALUES are pure config — a
+    worker restart re-mints the identical series, so the restart carry
+    never sees a label change."""
+    from tpu_inference import __version__
+    registry.gauge(
+        "tpu_inf_build_info",
+        "Build/config info gauge (constant 1; the labels carry the "
+        "version and serving configuration for dashboard joins)",
+        fn=lambda: 1.0,
+        version=__version__, backend=backend or "unknown",
+        fleet=fleet or "none", kv_quant=kv_quant or "none",
+        spec_mode=spec_mode or "off", routing=routing or "none")
+
+
+# ---------------------------------------------------------------------------
 # Engine-side bundle
 # ---------------------------------------------------------------------------
 
@@ -601,6 +1048,13 @@ class EngineTelemetry:
     def __init__(self, engine=None, enabled: Optional[bool] = None):
         self.enabled = (telemetry_enabled() if enabled is None else enabled)
         self.registry = Registry()
+        # Distributed tracing (README "Observability"): the replica's
+        # span sink. Disabled with the rest of telemetry, so the ≤1%
+        # overhead budget covers spans too. The owning fleet stamps
+        # the replica index after construction.
+        self.recorder = SpanRecorder(enabled=self.enabled)
+        # Rolling SLO gauges; bound to targets in bind_engine.
+        self.slo: Optional[SLOTracker] = None
         if not self.enabled:
             for attr in PHASE_HISTOGRAMS.values():
                 setattr(self, attr, NULL_METRIC)
@@ -778,6 +1232,32 @@ class EngineTelemetry:
                 "Decode lane occupancy: bound slots / top ladder rung",
                 fn=lambda: (sum(s is not None for s in engine.slots)
                             / max(engine.ladder[-1], 1)))
+        # Rolling SLO gauges (README "Observability"): exact windowed
+        # TTFT/TPOT quantiles over the last SLO_WINDOW requests, plus
+        # breach counters against the --slo-ttft-ms/--slo-tpot-ms
+        # targets — the autoscaler's input signal (ROADMAP item 3).
+        ecfg = engine.engine_cfg
+        slo = self.slo = SLOTracker(ecfg.slo_ttft_ms / 1e3,
+                                    ecfg.slo_tpot_ms / 1e3)
+        for q in SLO_QUANTILES:
+            r.gauge("tpu_inf_slo_ttft_seconds",
+                    "Rolling exact TTFT quantile over the last "
+                    f"{SLO_WINDOW} requests (NaN = no data)",
+                    fn=lambda q=q: slo.gauge_value("ttft", q),
+                    q=f"{q:g}")
+            r.gauge("tpu_inf_slo_tpot_seconds",
+                    "Rolling exact TPOT quantile over the last "
+                    f"{SLO_WINDOW} requests (NaN = no data)",
+                    fn=lambda q=q: slo.gauge_value("tpot", q),
+                    q=f"{q:g}")
+        r.counter("tpu_inf_slo_breaches_total",
+                  "Finished requests whose TTFT exceeded --slo-ttft-ms "
+                  "(never counts while no target is set)",
+                  fn=lambda: slo.ttft_breaches, slo="ttft")
+        r.counter("tpu_inf_slo_breaches_total",
+                  "Finished requests whose TPOT exceeded --slo-tpot-ms "
+                  "(never counts while no target is set)",
+                  fn=lambda: slo.tpot_breaches, slo="tpot")
 
     def bind_spec(self, engine) -> None:
         """Read-through speculative-decoding counters over state the
