@@ -1,0 +1,425 @@
+"""Elastic fleet (README "Elastic fleet"): SLO-driven autoscaling,
+priority classes, crash-loop quarantine, and zero-downtime rollouts.
+
+Covers the control plane at two levels:
+
+- pure units: class ranking + request-clone plumbing, and the
+  autoscaler SENSOR (hysteresis windows, cooldown, min/max bounds, the
+  no-action-while-transitioning guard that prevents a restart/scale-up
+  double-spawn) against a process-less group with hand-fed SLO windows.
+- REAL processes: crash-loop breaker quarantine (pinned gauge, degraded
+  /healthz, survivor keeps serving byte-identically), per-class
+  admission (batch defers at the cap, interactive preempts the batch
+  lane and the victim resumes byte-identically), SLO-breach scale-up
+  racing a ``kill -9`` (no double-spawn, monotone counters), lossless
+  scale-down, and a rolling upgrade under live traffic with a SIGTERM
+  thrown mid-rollout (zero failed requests).
+"""
+
+import dataclasses
+import re
+import threading
+import time
+
+import pytest
+
+from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                  ParallelConfig, ServerConfig, class_rank,
+                                  tiny_llama)
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+
+ENGINE_KW = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
+                 max_batch_size=2, prefill_buckets=(16,),
+                 host_cache_pages=32)
+
+
+def _cfg(dp=2, engine_kw=None, **server_kw) -> FrameworkConfig:
+    server_kw.setdefault("fleet", "subprocess")
+    server_kw.setdefault("worker_restart_max", 10)
+    server_kw.setdefault("worker_restart_backoff_s", 0.1)
+    server_kw.setdefault("drain_timeout_s", 8.0)
+    return FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(**{**ENGINE_KW, **(engine_kw or {})}),
+        parallel=ParallelConfig(dp=dp),
+        server=ServerConfig(model_name="t", tokenizer="byte",
+                            warmup=False, **server_kw))
+
+
+def _submit(group, rid, prompt, max_new, cls="interactive"):
+    toks, done, box = [], threading.Event(), {}
+    seq = Sequence(request_id=rid, prompt_tokens=list(prompt),
+                   max_new_tokens=max_new, priority_class=cls)
+    group.submit(seq, lambda s, t: toks.append(t),
+                 lambda s: (box.update(seq=s), done.set()))
+    return toks, done, box
+
+
+def _finish(done, box, timeout=180.0):
+    assert done.wait(timeout), "request did not finish"
+    return box["seq"]
+
+
+def _wait(pred, timeout=60.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return InferenceEngine(tiny_llama(vocab_size=512),
+                           EngineConfig(**ENGINE_KW), seed=0)
+
+
+# ------------------------------------------------------------- units
+
+
+def test_class_rank_and_plumbing():
+    """interactive < batch < background; unknown names can never starve
+    (they rank interactive); the class rides request clones and the
+    worker submit payload field."""
+    from tpu_inference.server.replicas import _clone_request
+
+    assert class_rank("interactive") == 0
+    assert class_rank("batch") == 1
+    assert class_rank("background") == 2
+    assert class_rank("tyop") == 0          # fail-open, never starved
+
+    seq = Sequence(request_id=7, prompt_tokens=[1, 2],
+                   max_new_tokens=4, priority_class="background")
+    assert _clone_request(seq).priority_class == "background"
+    assert Sequence(request_id=8, prompt_tokens=[1],
+                    max_new_tokens=1).priority_class == "interactive"
+
+
+def test_autoscale_sensor_hysteresis_and_guards():
+    """The autoscaler sensor against hand-fed windows: breach must be
+    SUSTAINED before a scale-up, a lull must be sustained before a
+    scale-down, bounds and backlog gate both, and NO decision fires
+    while any worker is mid-transition (the restart/scale-up
+    double-spawn guard)."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    g = ProcessEngineGroup(_cfg(
+        dp=2, autoscale=True, autoscale_breach_window_s=1.0,
+        autoscale_idle_window_s=1.0, autoscale_cooldown_s=5.0,
+        autoscale_max_replicas=3, autoscale_low_watermark=0.25,
+        engine_kw={"slo_ttft_ms": 100}))
+    try:
+        calls = []
+        g._scale_up = lambda reason: (calls.append(("up", reason)),
+                                      setattr(g, "_breach_since", 0.0))
+        g._scale_down = lambda reason: (calls.append(("down", reason)),
+                                        setattr(g, "_idle_since", 0.0))
+        for h in g.workers:
+            h.state = "up"
+            h.last_health = {"ladder_occupancy": 0.8}
+        # p95 TTFT 0.5s >> the 100ms target: breached. The sensor reads
+        # the ROUTER-observed window (submit -> first token, lane park
+        # time included), not the workers' engine-side rings.
+        g._ttft_obs.extend((time.perf_counter(), 0.5) for _ in range(20))
+
+        t = 100.0
+        g._autoscale_tick(t)            # arms the breach window
+        g._autoscale_tick(t + 0.5)      # not sustained yet
+        assert calls == []
+        g._autoscale_tick(t + 1.2)      # sustained -> actuate
+        assert calls == [("up", "slo_breach")]
+
+        # Cooldown: an immediate second breach does nothing.
+        g._last_scale_t = t + 1.2
+        g._autoscale_tick(t + 1.5)
+        g._autoscale_tick(t + 3.0)
+        assert len(calls) == 1
+
+        # Transition guard: a restarting worker freezes ALL decisions
+        # (and disarms the breach window) — a chaos-killed worker's
+        # respawn can never race a scale-up into a double-spawn.
+        g.workers[1].state = "restarting"
+        g._autoscale_tick(t + 50.0)
+        g._autoscale_tick(t + 60.0)
+        assert len(calls) == 1 and g._breach_since == 0.0
+        g.workers[1].state = "up"
+
+        # Max bound: breach sustained but n == max -> no actuation.
+        g.server_cfg = dataclasses.replace(g.server_cfg,
+                                           autoscale_max_replicas=2)
+        g._autoscale_tick(t + 70.0)
+        g._autoscale_tick(t + 72.0)
+        assert len(calls) == 1
+        g.server_cfg = dataclasses.replace(g.server_cfg,
+                                           autoscale_max_replicas=3)
+
+        # Idle path: the burst's breached samples AGE OUT of the time
+        # horizon (count-based rings latch forever; the router window
+        # must not), occupancy under the low watermark -> sustained
+        # lull drains the coldest replica.
+        g._ttft_obs.clear()
+        g._ttft_obs.extend((time.perf_counter() - 60.0, 0.5)
+                           for _ in range(20))
+        for h in g.workers:
+            h.last_health = {"ladder_occupancy": 0.0}
+        g._autoscale_tick(t + 100.0)    # arms the idle window
+        assert not g._ttft_obs          # horizon pruned the stale burst
+        g._autoscale_tick(t + 101.2)
+        assert calls[-1] == ("down", "idle")
+
+        # A parked batch backlog blocks scale-down outright.
+        g._deferred["batch"].append(object())
+        g._autoscale_tick(t + 200.0)
+        g._autoscale_tick(t + 202.0)
+        assert calls[-1] == ("down", "idle") and len(calls) == 2
+        g._deferred["batch"].clear()
+
+        # Min bound: one live worker never drains away.
+        g.workers[1].state = "retired"
+        g._autoscale_tick(t + 300.0)
+        g._autoscale_tick(t + 302.0)
+        assert len(calls) == 2
+    finally:
+        g.stop(drain=False)
+
+
+def test_retire_candidate_prefers_cold_and_respects_pd():
+    """Scale-down picks the least-loaded, lowest-occupancy replica and
+    never removes the last worker of a P/D phase."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    g = ProcessEngineGroup(_cfg(dp=3))
+    try:
+        for i, h in enumerate(g.workers):
+            h.state = "up"
+            h.last_health = {"ladder_occupancy": [0.9, 0.1, 0.5][i]}
+        assert g._retire_candidate().replica == 1
+
+        # P/D: with roles [prefill, decode, decode], replica 1 or 2 may
+        # retire but the lone prefill worker (0) never can.
+        g.roles[:] = ["prefill", "decode", "decode"]
+        g.pd_enabled = True
+        assert g._retire_candidate().replica in (1, 2)
+        g.workers[2].state = "retired"
+        cand = g._retire_candidate()
+        assert cand is None or cand.replica != 0
+    finally:
+        g.stop(drain=False)
+
+
+# ------------------------------------------------- real process fleets
+
+
+def test_crash_loop_quarantine(oracle):
+    """Crash-loop breaker: with the restart budget exhausted the
+    replica lands QUARANTINED — visible in /healthz (degraded, not
+    absent), pinned by tpu_inf_worker_quarantined, excluded from
+    tpu_inf_replicas — and the survivor keeps serving byte-identically."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=2, worker_restart_max=0))
+    group.start()
+    try:
+        _wait(lambda: all(h.state == "up" for h in group.workers),
+              what="fleet up")
+        group.apply_chaos({"replica": 1, "kill": "kill9"})
+        _wait(lambda: group.workers[1].state == "quarantined",
+              what="quarantine")
+
+        hs = group.health_snapshot()
+        assert hs["status"] == "degraded"
+        assert hs["replicas"][1]["worker_state"] == "quarantined"
+        assert "quarantined" in hs["supervision"]["states"]
+
+        text = group.prometheus_text()
+        assert re.search(
+            r'tpu_inf_worker_quarantined\{replica="1"\} 1(\.0)?\b', text)
+        assert re.search(
+            r'tpu_inf_worker_quarantined\{replica="0"\} 0(\.0)?\b', text)
+        m = re.search(r"^tpu_inf_replicas (\S+)$", text, re.M)
+        assert m and float(m.group(1)) == 1.0
+
+        toks, done, box = _submit(group, 1, [5, 6, 7], 8)
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length" and fin.routed_replica == 0
+        assert toks == oracle.generate([[5, 6, 7]], max_new_tokens=8)[0]
+    finally:
+        group.stop(drain=False)
+
+
+def test_priority_classes_defer_and_preempt(oracle):
+    """Per-class admission on a saturated single worker: batch work
+    parks in its lane instead of bouncing a 429, an interactive arrival
+    preempts the running batch request (which resumes byte-identically
+    from the router's token record), and every class drains to
+    completion once pressure lifts."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=1, admission_queue_depth=1,
+                                    class_queue_depth=4))
+    group.start()
+    try:
+        _wait(lambda: all(h.state == "up" for h in group.workers),
+              what="fleet up")
+        p1, p2, p3 = [1, 2, 3, 4, 5], [9, 8, 7], [3, 3, 3, 3]
+        t1, d1, b1 = _submit(group, 1, p1, 48, cls="batch")
+        t2, d2, b2 = _submit(group, 2, p2, 12, cls="batch")   # defers
+        # The interactive arrival preempts the RUNNING batch request.
+        t3, d3, b3 = _submit(group, 3, p3, 12, cls="interactive")
+
+        fin3 = _finish(d3, b3)
+        assert fin3.finish_reason == "length"
+        assert t3 == oracle.generate([p3], max_new_tokens=12)[0]
+        # Preempted + deferred batch work completes byte-identically.
+        fin1 = _finish(d1, b1)
+        fin2 = _finish(d2, b2)
+        assert fin1.finish_reason == fin2.finish_reason == "length"
+        assert t1 == oracle.generate([p1], max_new_tokens=48)[0]
+        assert t2 == oracle.generate([p2], max_new_tokens=12)[0]
+
+        sup = group.supervision_counters()
+        assert sup["class_preemptions"].get("batch", 0) >= 1
+        assert sup["requests_shed"] == 0
+        assert sup["class_deferred"] == {"batch": 0, "background": 0}
+        text = group.prometheus_text()
+        assert re.search(
+            r'tpu_inf_class_preempted_total\{class="batch"\} [1-9]', text)
+        assert 'tpu_inf_class_deferred{class="batch"} 0' in text
+    finally:
+        group.stop(drain=False)
+
+
+def test_autoscale_up_with_kill9_no_double_spawn(oracle):
+    """End-to-end scale-up on a sustained SLO breach, then a kill -9
+    thrown at the fleet: the victim RESTARTS (supervision) rather than
+    triggering a second scale-up, requests fail over byte-identically,
+    and the fleet counters stay monotone."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(
+        dp=1, autoscale=True, autoscale_breach_window_s=0.5,
+        autoscale_cooldown_s=1.0, autoscale_max_replicas=2,
+        autoscale_low_watermark=0.0,     # never scale down in this test
+        engine_kw={"slo_ttft_ms": 1}))   # 1 ms: every request breaches
+    group.start()
+    try:
+        _wait(lambda: all(h.state == "up" for h in group.workers),
+              what="fleet up")
+        for i in range(3):
+            toks, done, box = _submit(group, 10 + i, [1, 2, i], 6)
+            _finish(done, box)
+        _wait(lambda: len(group.workers) == 2
+              and group.workers[1].state == "up",
+              timeout=90.0, what="scale-up")
+        assert group.scale_ups == 1
+        assert group.trace_snapshot("scale-up-1") is not None
+        text = group.prometheus_text()
+        assert re.search(r"tpu_inf_fleet_scale_ups_total 1\b", text)
+
+        # kill -9 the original worker with a request in flight.
+        restarts_before = sum(h.restarts for h in group.workers)
+        toks, done, box = _submit(group, 50, [4, 4, 4], 24)
+        group.apply_chaos({"replica": 0, "kill": "kill9"})
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length"
+        assert toks == oracle.generate([[4, 4, 4]], max_new_tokens=24)[0]
+        _wait(lambda: group.workers[0].state == "up", what="heal")
+        time.sleep(2.5)   # past cooldown: breach may persist, max caps it
+        assert len(group.workers) == 2     # restart, NOT a third spawn
+        sup = group.supervision_counters()
+        assert sup["scale_ups"] == 1 and sup["scale_downs"] == 0
+        assert sum(h.restarts for h in group.workers) > restarts_before
+    finally:
+        group.stop(drain=False)
+
+
+def test_scale_down_retires_coldest(oracle):
+    """Lossless scale-down: the idle replica drain-retires (state
+    RETIRED, excluded from tpu_inf_replicas and from /healthz status
+    math) while the busy replica's request streams to completion."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=2))
+    group.start()
+    try:
+        _wait(lambda: all(h.state == "up" for h in group.workers),
+              what="fleet up")
+        prompt = [2, 4, 6, 8]
+        toks, done, box = _submit(group, 1, prompt, 48)
+        time.sleep(0.3)                   # let it land on its worker
+        group._scale_down("test")
+        retired = [h for h in group.workers if h.retiring or
+                   h.state == "retired"]
+        assert len(retired) == 1
+        _wait(lambda: retired[0].state == "retired", what="retire")
+
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length"
+        assert toks == oracle.generate([prompt], max_new_tokens=48)[0]
+
+        assert group.scale_downs == 1
+        assert len(group._live_workers()) == 1
+        hs = group.health_snapshot()
+        assert hs["status"] == "ok"       # retired is NOT degraded
+        text = group.prometheus_text()
+        assert re.search(r"tpu_inf_fleet_scale_downs_total 1\b", text)
+        m = re.search(r"^tpu_inf_replicas (\S+)$", text, re.M)
+        assert m and float(m.group(1)) == 1.0
+        assert group.trace_snapshot("scale-down-1") is not None
+    finally:
+        group.stop(drain=False)
+
+
+def test_rollout_under_traffic_with_sigterm_chaos(oracle):
+    """Rolling upgrade under live traffic with a SIGTERM thrown at an
+    original worker mid-rollout: the in-flight request completes
+    byte-identically (migrated or failed over, never failed), the
+    rollout finishes, successors serve, and a second rollout attempt
+    while one is running is refused."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=2))
+    group.start()
+    try:
+        _wait(lambda: all(h.state == "up" for h in group.workers),
+              what="fleet up")
+        prompt = [1, 3, 5, 7, 9]
+        toks, done, box = _submit(group, 1, prompt, 48)
+
+        res_box = {}
+        th = threading.Thread(
+            target=lambda: res_box.update(res=group.rollout()))
+        th.start()
+        time.sleep(0.3)
+        assert group._rollout_lock.locked()
+        with pytest.raises(ValueError, match="already in progress"):
+            group.rollout()
+        try:
+            group.apply_chaos({"replica": 0, "kill": "sigterm"})
+        except ValueError:
+            pass                          # already exited post-drain
+        th.join(timeout=180.0)
+        assert not th.is_alive(), "rollout wedged"
+        res = res_box["res"]
+
+        # Zero failed requests: the live stream completed identically.
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length"
+        assert toks == oracle.generate([prompt], max_new_tokens=48)[0]
+
+        assert res["replaced"] and not res["failed"]
+        assert group.rollouts == 1
+        assert group.trace_snapshot("rollout-1") is not None
+
+        # Successors serve new traffic byte-identically.
+        _wait(lambda: any(h.state == "up" and h.replica >= 2
+                          for h in group.workers), what="successor up")
+        toks2, done2, box2 = _submit(group, 2, [7, 7, 7], 10)
+        fin2 = _finish(done2, box2)
+        assert fin2.finish_reason == "length"
+        assert toks2 == oracle.generate([[7, 7, 7]], max_new_tokens=10)[0]
+        text = group.prometheus_text()
+        assert re.search(r"tpu_inf_fleet_rollouts_total 1\b", text)
+    finally:
+        group.stop(drain=False)

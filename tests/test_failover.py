@@ -548,8 +548,9 @@ def test_api_ps_ollama_semantics():
 
 
 def test_traffic_generator_resilience_accounting():
-    """429/503 backoff honors Retry-After (never below exponential
-    backoff) and the collector tracks retry/shed counts."""
+    """429/503 backoff = Retry-After hint + FULL-jitter exponential
+    backoff (uniform on [0, base*2^attempt], capped), and the collector
+    tracks retry/shed counts."""
     from traffic_generator.generator import TrafficGenerator
     from traffic_generator.metrics import MetricCollector
 
@@ -560,12 +561,29 @@ def test_traffic_generator_resilience_accounting():
         def __init__(self, headers):
             self.headers = headers
 
-    d = gen._shed_delay(Resp({"Retry-After": "3"}), attempt=0)
-    assert 3.0 <= d <= 3.0 * 1.25            # hint wins, jitter above
-    d = gen._shed_delay(Resp({}), attempt=2)
-    assert 1.0 <= d <= 1.0 * 1.25            # 0.25 * 2**2
-    d = gen._shed_delay(Resp({"Retry-After": "nonsense"}), attempt=0)
-    assert 0.25 <= d <= 0.25 * 1.25          # bad hint -> backoff only
+    for _ in range(16):
+        d = gen._shed_delay(Resp({"Retry-After": "3"}), attempt=0)
+        assert 3.0 <= d <= 3.25              # hint floor + full jitter
+        d = gen._shed_delay(Resp({}), attempt=2)
+        assert 0.0 <= d <= 1.0               # uniform on [0, 0.25*2^2]
+        d = gen._shed_delay(Resp({"Retry-After": "nonsense"}), attempt=0)
+        assert 0.0 <= d <= 0.25              # bad hint -> jitter only
+        d = gen._shed_delay(Resp({}), attempt=30)
+        assert d <= 10.0                     # backoff span is capped
+    # Full jitter actually spreads: not every draw lands in the top
+    # quarter of the span (the old multiplicative jitter put 100% of a
+    # synchronized wave in [span, 1.25*span]).
+    draws = [gen._shed_delay(Resp({}), attempt=2) for _ in range(64)]
+    assert min(draws) < 0.75
+
+    # Shared retry budget: one pool across all queries; a dry pool
+    # means shed-now, and 0/None disables the pool entirely.
+    gen2 = object.__new__(TrafficGenerator)
+    gen2._retry_budget = 2
+    assert gen2._consume_retry() and gen2._consume_retry()
+    assert not gen2._consume_retry()         # pool dry -> shed
+    gen2._retry_budget = None                # disabled -> always retry
+    assert all(gen2._consume_retry() for _ in range(8))
 
     mc = MetricCollector()
     mc.init_query(0, n_input_tokens=3, scheduled_start=0.0)

@@ -620,6 +620,9 @@ def test_pd_fleet_handoff_byte_identity_and_surfaces(pd_fleet, oracle):
     assert "tpu_inf_pd_handoff_seconds_bucket" in pt
 
 
+@pytest.mark.slow   # ~77s of restart-backoff waits; the handoff fallback
+                    # path it races is covered fast by the malformed-blob
+                    # recompute test and pd byte-identity stays tier-1
 def test_pd_handoff_races_decode_restart(pd_fleet, oracle):
     """Satellite: kill -9 the decode worker AFTER it adopted a handoff
     and streamed tokens. The kept handoff blob is stale (decode
@@ -851,6 +854,8 @@ print("COMPILES", len(records) - n0)
 """
 
 
+@pytest.mark.slow   # ~44s subprocess compile-census sweep; role validation
+                    # and role-aware serving stay tier-1
 def test_role_specialized_warmup_shrinks_compile_set():
     """Tentpole claim: a prefill-role warmup compiles only the prefill
     side and a decode-role warmup only the decode side, so each

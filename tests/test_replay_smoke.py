@@ -12,6 +12,8 @@ import json
 import os
 import sys
 
+import pytest
+
 
 def _load_bench(name):
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -246,6 +248,9 @@ def test_replay_smoke_compare_spec(tmp_path, monkeypatch):
     assert c["spec_never_loses"]
 
 
+@pytest.mark.slow   # heaviest chaos lane (~90s); fleet kill/drain behavior
+                    # stays tier-1 in test_fleet.py, the committed artifact
+                    # in benchmarks/results/replay_fleet.json
 def test_replay_smoke_compare_fleet(tmp_path, monkeypatch):
     """Tier-1 process-fleet smoke (CPU, dp=2): the in-process vs
     subprocess comparison lane serves a pinned greedy burst through the
@@ -303,6 +308,73 @@ def test_replay_smoke_compare_fleet(tmp_path, monkeypatch):
     assert c["swap_in_resumes"] >= 1
     assert (c["recomputed_tokens_migrate"]
             < c["recomputed_tokens_resubmit"])
+
+
+def test_replay_smoke_compare_elastic(tmp_path, monkeypatch):
+    """Tier-1 elastic-fleet smoke (CPU): the fixed vs elastic lane
+    replays the pinned mini-diurnal (>= 20x offered-load swing, mixed
+    X-Priority classes) through one fixed subprocess worker and
+    through the autoscaled fleet — which must scale up on the
+    sustained SLO breach, preempt the batch lane for interactives
+    instead of shedding them, survive a rolling upgrade fired mid-
+    burst over HTTP with zero failed requests, and scale back down in
+    the quiet tail. Live assertions are the DETERMINISTIC claims:
+    interactive TTFT p95 holds the SLO in the elastic arm, batch
+    preemptions > 0 with interactive shed == 0, scale-up AND
+    scale-down events visible in /metrics and /debug/trace, the
+    rollout replacing every worker with none failed, and byte-
+    identical greedy outputs across arms; throughput magnitudes are
+    reported, not graded (loaded-CI-box stance)."""
+    root, replay = _load_replay()
+    out = tmp_path / "replay_elastic.json"
+    monkeypatch.chdir(root)
+    monkeypatch.setattr(sys, "argv",
+                        ["replay.py", "--smoke", "--compare-elastic",
+                         "--out", str(out)])
+    cmp = replay.main()
+
+    art = json.loads(out.read_text())
+    assert art["config"]["smoke"] is True
+    for arm in ("fixed", "elastic"):
+        s = art[arm]
+        assert s["requests"] > 0 and s["output_tokens"] > 0, (arm, s)
+    assert art["fixed"]["elastic"] is False
+    assert art["elastic"]["elastic"] is True
+    # The diurnal really swung >= 20x trough-to-peak.
+    assert cmp["load_swing"] >= 20.0
+    # Interactive held the SLO under the peak; batch absorbed the
+    # slack (real preemptions, nothing interactive shed or failed).
+    assert cmp["interactive_slo_held_elastic"], cmp
+    assert cmp["batch_preemptions_elastic"] >= 1
+    assert cmp["interactive_shed_elastic"] == 0
+    assert cmp["elastic_completed_all"], cmp
+    # The fleet scaled up on the breach AND back down in the lull,
+    # with events in /metrics and /debug/trace.
+    assert cmp["scale_ups"] >= 1 and cmp["scale_downs"] >= 1
+    assert cmp["scale_events_in_metrics"]
+    assert cmp["scale_events_in_trace"]
+    # The mid-burst rolling upgrade replaced every worker, failed
+    # none, and left a trace span.
+    assert cmp["rollout_replaced"] >= 1
+    assert cmp["rollout_failed"] == 0
+    assert cmp["rollout_in_trace"]
+    # Byte-identity across arms on every commonly-completed request:
+    # elasticity is a capacity decision, never a behavior change.
+    assert cmp["common_requests"] >= 1
+    assert cmp["outputs_identical_common"], cmp
+    assert cmp["elastic_wins"], cmp
+
+    # The committed artifact carries the same acceptance claims.
+    committed = json.loads(open(os.path.join(
+        root, "benchmarks", "results", "replay_elastic.json")).read())
+    c = committed["comparison"]
+    assert c["elastic_wins"] and c["outputs_identical_common"]
+    assert c["load_swing"] >= 20.0
+    assert c["interactive_slo_held_elastic"]
+    assert c["batch_preemptions_elastic"] >= 1
+    assert c["interactive_shed_elastic"] == 0
+    assert c["scale_ups"] >= 1 and c["scale_downs"] >= 1
+    assert c["rollout_replaced"] >= 1 and c["rollout_failed"] == 0
 
 
 def test_replay_smoke_compare_pd(tmp_path, monkeypatch):

@@ -590,6 +590,20 @@ def validate_spec_config(spec_mode: str, num_speculative_tokens: int,
 # Worker phase roles (README "P/D disaggregation").
 WORKER_ROLES = ("prefill", "decode", "mixed")
 
+# Request priority classes (README "Elastic fleet"), best-first. Admission
+# and scheduling order by rank; preemption steals from the worst rank up.
+PRIORITY_CLASSES = ("interactive", "batch", "background")
+
+
+def class_rank(priority_class: str) -> int:
+    """Scheduling rank of a class (0 = most latency-sensitive). Unknown
+    names rank as interactive so a typo'd header can never starve a
+    request — validation with a 400 belongs at the HTTP edge."""
+    try:
+        return PRIORITY_CLASSES.index(priority_class)
+    except ValueError:
+        return 0
+
 
 def resolve_worker_roles(dp: int, worker_roles, default_role: str = "mixed"
                          ) -> tuple:
@@ -751,6 +765,48 @@ class ServerConfig:
     # share. Used by the --compare-pd replay lane; irrelevant (but
     # harmless) when each worker owns its accelerator.
     pd_prefill_nice: int = 0
+    # --- Elastic fleet (README "Elastic fleet") ---
+    # SLO-driven autoscaler on the subprocess fleet: the router watches
+    # the fleet-pooled TTFT/TPOT quantile windows (the PR-12 SLO sensor)
+    # and spawns an extra worker when p95 breaches the configured
+    # slo_ttft_ms/slo_tpot_ms target for autoscale_breach_window_s
+    # straight, or drain-and-migrates the coldest replica away (lossless
+    # scale-down: KV pages migrate, streams keep going) when pooled
+    # ladder occupancy stays under autoscale_low_watermark for
+    # autoscale_idle_window_s. Hysteresis comes from the two distinct
+    # windows plus autoscale_cooldown_s between ANY two scale decisions,
+    # and the autoscaler never acts while a worker is booting or
+    # restarting — so a chaos-killed worker's restart can never race a
+    # scale-up into a double spawn. False = fixed fleet (legacy).
+    autoscale: bool = False
+    # Replica-count bounds for the autoscaler. max 0 = dp + 2.
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 0
+    # Sustained-breach window before a scale-up (seconds of continuous
+    # p95-over-target on the pooled windows).
+    autoscale_breach_window_s: float = 3.0
+    # Minimum seconds between any two scale decisions.
+    autoscale_cooldown_s: float = 10.0
+    # Scale-down trigger: pooled decode-ladder occupancy (0..1) must stay
+    # under this for autoscale_idle_window_s straight.
+    autoscale_low_watermark: float = 0.25
+    autoscale_idle_window_s: float = 5.0
+    # Role spawned by a scale-up: "decode" on a P/D-split fleet (decode
+    # capacity is what TPOT breaches starve for); "" = "decode" when P/D
+    # roles are in play, else "mixed" (a mixed fleet needs prefill
+    # capacity too for TTFT relief).
+    autoscale_role: str = ""
+    # --- Priority classes (README "Elastic fleet": class semantics) ---
+    # Class assumed for requests without an X-Priority header:
+    # "interactive" | "batch" | "background".
+    default_class: str = "interactive"
+    # Per-class router-side deferral queues: when the fleet is at the
+    # admission cap, batch/background requests park in a bounded
+    # deferral queue (drained as load drops) instead of shedding 429,
+    # and an interactive arrival preempts a running batch-lane request
+    # (recompute-resume, byte-identical under greedy) to make room.
+    # 0 = classes ride the legacy single global cap.
+    class_queue_depth: int = 0
 
 
 @dataclasses.dataclass

@@ -297,6 +297,11 @@ class Sequence:
     # resubmissions (server/replicas.py) so a resubmitted span is marked.
     trace_id: str = ""
     attempt: int = 0
+    # Priority class (README "Elastic fleet"): interactive requests
+    # outrank batch/background at admission AND in the waiting queue
+    # (config.class_rank); lower classes absorb overload via deferral
+    # and watermark preemption instead of a fleet-wide 429.
+    priority_class: str = "interactive"
     # Routing span (server/replicas.py): which dp replica this attempt
     # was dispatched to and how many cached prefix pages the router
     # counted on at decision time (-1/0 when submitted scheduler-direct).

@@ -26,6 +26,7 @@ import time
 from typing import Callable, Deque, Dict, List, Optional
 
 from tpu_inference import telemetry
+from tpu_inference.config import class_rank
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 
@@ -282,7 +283,17 @@ class EngineScheduler:
             return
         seq.enqueue_time = time.perf_counter()
         with self._lock:
-            self._waiting.append(_Pending(seq, on_token, on_finish))
+            # Class-aware queue (README "Elastic fleet"): insert before
+            # any strictly-lower class so an interactive arrival jumps a
+            # batch backlog; FCFS within a class. O(n) from the tail is
+            # fine — the queue is bounded by max_queue_len, and the
+            # common single-class workload degenerates to append().
+            rank = class_rank(seq.priority_class)
+            idx = len(self._waiting)
+            while idx > 0 and class_rank(
+                    self._waiting[idx - 1].seq.priority_class) > rank:
+                idx -= 1
+            self._waiting.insert(idx, _Pending(seq, on_token, on_finish))
         self._work.set()
 
     def kick(self) -> None:

@@ -49,7 +49,9 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_inference import telemetry
-from tpu_inference.config import (FrameworkConfig, framework_config_to_dict)
+from tpu_inference.config import (FrameworkConfig, class_rank,
+                                  framework_config_to_dict,
+                                  resolve_worker_roles)
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import Sequence
 from tpu_inference.engine.prefix_cache import _chain_hashes
@@ -178,7 +180,14 @@ BOOTING = "booting"
 UP = "up"
 DRAINING = "draining"
 RESTARTING = "restarting"
-DEAD = "dead"           # restart budget exhausted (or boot failed)
+DEAD = "dead"           # router teardown
+# Crash-loop breaker tripped (restart budget exhausted): the replica is
+# routed around and VISIBLE — in /healthz and the
+# tpu_inf_worker_quarantined gauge — rather than silently absent.
+QUARANTINED = "quarantined"
+# Intentional exit: scaled down by the autoscaler or replaced by a
+# rolling upgrade. Never respawned, excluded from fleet health math.
+RETIRED = "retired"
 
 
 class WorkerHandle:
@@ -214,6 +223,9 @@ class WorkerHandle:
         # this, so a worker restart never makes the fleet counter
         # decrease (Prometheus rate() reads any dip as a reset).
         self.slo_breach_carry = {"ttft": 0, "tpot": 0}
+        # Intentional-exit marker (scale-down / rollout): the monitor's
+        # death handler retires this worker instead of respawning it.
+        self.retiring = False
 
     @property
     def routable(self) -> bool:
@@ -288,10 +300,11 @@ class ProcessEngineGroup:
         # EngineConfig.role say otherwise. pd_enabled gates the phase-
         # aware routing below; an all-mixed fleet behaves exactly as
         # before.
-        from tpu_inference.config import resolve_worker_roles
-        self.roles = resolve_worker_roles(self.dp,
-                                          cfg.server.worker_roles,
-                                          default_role=cfg.engine.role)
+        # A list, not a tuple: scale-ups and rollout successors append
+        # their role at the new replica index.
+        self.roles = list(resolve_worker_roles(
+            self.dp, cfg.server.worker_roles,
+            default_role=cfg.engine.role))
         self.pd_enabled = any(r != "mixed" for r in self.roles)
         if self.pd_enabled and (
                 all(r == "decode" for r in self.roles)
@@ -332,6 +345,31 @@ class ProcessEngineGroup:
         # no adopter) instead of adopting cleanly.
         self.pd_handoffs = 0
         self.pd_handoff_recomputes = 0
+        # Elastic fleet (README "Elastic fleet"): autoscaler, rolling
+        # upgrades, and per-class admission state.
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.rollouts = 0
+        self.class_preemptions: Dict[str, int] = {}
+        self.class_shed: Dict[str, int] = {}
+        from collections import deque
+        # Bounded per-class deferral queues (batch lanes park here at
+        # the admission cap instead of shedding; the monitor pump
+        # dispatches them as capacity frees up). Guarded by _lock.
+        self._deferred: Dict[str, deque] = {"batch": deque(),
+                                            "background": deque()}
+        self._breach_since = 0.0      # monotonic start of current breach
+        self._idle_since = 0.0        # monotonic start of current lull
+        self._last_scale_t = 0.0      # monotonic time of last scale act
+        self._rollout_lock = threading.Lock()
+        # Router-observed TTFT samples (t_observed, ttft_s), pruned to
+        # a time horizon at each autoscale tick. This is the scale-up
+        # sensor: unlike the workers' engine-side rings it (a) counts
+        # time a request spent PARKED in a class lane — the user-
+        # perceived latency overload actually inflates — and (b) decays
+        # with wall time, so a burst's breached samples cannot latch
+        # the fleet at peak size after traffic stops. Guarded by _lock.
+        self._ttft_obs: deque = deque(maxlen=2048)
         # Fan-out pool for the concurrent candidate peeks. Created
         # eagerly (threads only spawn on first submit): lazy creation
         # under concurrent HTTP submits would race and leak the losing
@@ -359,8 +397,10 @@ class ProcessEngineGroup:
 
     def _build_registry(self) -> None:
         r = self._fleet_registry
-        r.gauge("tpu_inf_replicas", "Configured dp replicas",
-                fn=lambda: self.dp)
+        r.gauge("tpu_inf_replicas",
+                "Live replicas (autoscaler/rollout move this; retired "
+                "and quarantined workers excluded)",
+                fn=lambda: float(len(self._live_workers())))
         r.counter("tpu_inf_retries_attempted_total",
                   "Failover resubmissions attempted",
                   fn=lambda: self.retries_attempted)
@@ -433,6 +473,16 @@ class ProcessEngineGroup:
                           + (((h.last_stats or {}).get("slo") or {})
                              .get(f"{k}_breaches", 0))
                           for h in self.workers))
+        # Elastic-fleet series (README "Elastic fleet"): scale events,
+        # rolling upgrades, and the per-class admission lanes.
+        telemetry.register_fleet_elastic(
+            r,
+            scale_ups=lambda: self.scale_ups,
+            scale_downs=lambda: self.scale_downs,
+            rollouts=lambda: self.rollouts,
+            class_preempted=lambda c: self.class_preemptions.get(c, 0),
+            class_deferred=lambda c: len(self._deferred.get(c) or ()),
+            class_shed=lambda c: self.class_shed.get(c, 0))
         import jax
         telemetry.emit_build_info(
             r, backend=jax.default_backend(), fleet="subprocess",
@@ -442,24 +492,43 @@ class ProcessEngineGroup:
                        else "off"),
             routing=self.server_cfg.routing)
         for h in self.workers:
-            r.gauge("tpu_inf_worker_role_info",
-                    "Worker phase role (constant 1; the role is the "
-                    "label)",
-                    fn=lambda: 1.0, replica=str(h.replica),
-                    role=self.roles[h.replica])
-            r.gauge("tpu_inf_replica_routable",
-                    "1 when the worker accepts traffic",
-                    fn=lambda hh=h: float(hh.routable),
-                    replica=str(h.replica))
-            r.gauge("tpu_inf_worker_up",
-                    "1 while the worker process is serving",
-                    fn=lambda hh=h: float(hh.state == UP),
-                    replica=str(h.replica))
-            r.counter("tpu_inf_worker_restarts_total",
-                      "Worker process respawns (stable replica label "
-                      "across incarnations)",
-                      fn=lambda hh=h: hh.restarts,
-                      replica=str(h.replica))
+            self._register_worker_gauges(h)
+
+    def _register_worker_gauges(self, h: WorkerHandle) -> None:
+        """Per-worker series under the stable replica label. Called for
+        every boot-time handle and again for each worker the autoscaler
+        or a rollout adds at a fresh replica index."""
+        r = self._fleet_registry
+        r.gauge("tpu_inf_worker_role_info",
+                "Worker phase role (constant 1; the role is the "
+                "label)",
+                fn=lambda: 1.0, replica=str(h.replica),
+                role=self.roles[h.replica])
+        r.gauge("tpu_inf_replica_routable",
+                "1 when the worker accepts traffic",
+                fn=lambda hh=h: float(hh.routable),
+                replica=str(h.replica))
+        r.gauge("tpu_inf_worker_up",
+                "1 while the worker process is serving",
+                fn=lambda hh=h: float(hh.state == UP),
+                replica=str(h.replica))
+        r.counter("tpu_inf_worker_restarts_total",
+                  "Worker process respawns (stable replica label "
+                  "across incarnations)",
+                  fn=lambda hh=h: hh.restarts,
+                  replica=str(h.replica))
+        r.gauge("tpu_inf_worker_quarantined",
+                "1 while the crash-loop breaker holds this replica "
+                "quarantined (restart budget exhausted; routed around)",
+                fn=lambda hh=h: float(hh.state == QUARANTINED),
+                replica=str(h.replica))
+
+    def _live_workers(self) -> List[WorkerHandle]:
+        """Workers that count toward fleet size: everything except the
+        intentionally-retired (scale-down/rollout) and the crash-loop
+        quarantined/dead."""
+        return [h for h in self.workers
+                if h.state not in (RETIRED, DEAD, QUARANTINED)]
 
     def _pooled_slo_quantile(self, which: str, q: float) -> float:
         windows = [(((h.last_stats or {}).get("slo") or {})
@@ -559,9 +628,11 @@ class ProcessEngineGroup:
     @property
     def engines(self) -> List[_EngineInfo]:
         """Len/iteration parity with EngineGroup.engines (the HTTP layer
-        reads ``len(group.engines)`` for the dp count)."""
+        reads ``len(group.engines)`` for the replica count — including
+        workers the autoscaler or a rollout added past the configured
+        dp, so e.g. /debug/profile can target them)."""
         info = self.engine or _EngineInfo({})
-        return [info] * self.dp
+        return [info] * max(self.dp, len(self.workers))
 
     def warmup(self) -> float:
         self._ensure_started()
@@ -610,6 +681,10 @@ class ProcessEngineGroup:
         with self._lock:
             leftovers = list(self._tracked.values())
             self._tracked.clear()
+            # Parked batch/background entries are in _tracked too (the
+            # ghost-finish below covers them); drop the lane handles.
+            for q in self._deferred.values():
+                q.clear()
         for entry in leftovers:
             self._finish_trace(entry, "shutdown")
             ghost = entry.seq_local
@@ -645,6 +720,9 @@ class ProcessEngineGroup:
             if now - last_scrape >= 1.0:
                 last_scrape = now
                 self._refresh_caches()
+                if self.server_cfg.autoscale:
+                    self._autoscale_tick(now)
+            self._pump_deferred()
 
     def _refresh_caches(self) -> None:
         for h in self.workers:
@@ -665,10 +743,18 @@ class ProcessEngineGroup:
         # failures — a worker whose boot crashes deterministically
         # (deleted checkpoint, bad device) must go DEAD, not respawn a
         # jax-importing process forever.
-        if (self._stopping or h.restarts >= scfg.worker_restart_max
-                or h.consecutive_failures > scfg.worker_restart_max):
+        if self._stopping:
             h.state = DEAD
-            telemetry.log_event("worker_dead", level="error",
+            return
+        if (h.restarts >= scfg.worker_restart_max
+                or h.consecutive_failures > scfg.worker_restart_max):
+            # Crash-loop breaker: the budget is spent, so stop burning
+            # boot cycles — but keep the replica VISIBLE. QUARANTINED
+            # stays in /healthz (degraded, not absent) and pins the
+            # tpu_inf_worker_quarantined gauge to 1 so an operator sees
+            # a routed-around replica instead of a silently shrunk dp.
+            h.state = QUARANTINED
+            telemetry.log_event("worker_quarantined", level="error",
                                 replica=h.replica, restarts=h.restarts,
                                 consecutive_failures=h.consecutive_failures)
             return
@@ -695,8 +781,9 @@ class ProcessEngineGroup:
             # the death; the state flip under the lock picks one actor.
             if h.state not in (UP, DRAINING):
                 return
-            h.state = RESTARTING
-        h.consecutive_failures += 1
+            h.state = RETIRED if h.retiring else RESTARTING
+        if h.state != RETIRED:
+            h.consecutive_failures += 1
         if h.proc is not None and h.proc.poll() is None:
             try:
                 h.proc.kill()
@@ -723,9 +810,18 @@ class ProcessEngineGroup:
                 h.last_stats = {**h.last_stats,
                                 "slo": {**slo, "ttft_breaches": 0,
                                         "tpot_breaches": 0}}
-        telemetry.log_event("worker_down", level="warning",
-                            replica=h.replica, reason=reason)
-        self._schedule_restart(h)
+        if h.state == RETIRED:
+            # Intentional exit (scale-down or rollout retirement): the
+            # drain already migrated its sequences out, so the failover
+            # sweep below is a no-op safety net, and there is nothing
+            # to respawn.
+            h.retiring = False
+            telemetry.log_event("worker_retired", replica=h.replica,
+                                reason=reason)
+        else:
+            telemetry.log_event("worker_down", level="warning",
+                                replica=h.replica, reason=reason)
+            self._schedule_restart(h)
         self._failover_worker(h)
 
     # --------------------------------------------------------- routing
@@ -933,17 +1029,28 @@ class ProcessEngineGroup:
             # before shedding, exactly like EngineGroup.submit.
             h2, _, load2 = self._pick(pool)
             if load2 >= cap:
-                with self._lock:
-                    self.requests_shed += 1
-                # A shed IS terminal: seal the route span so sustained
-                # overload can't fill the recorder's open table and
-                # evict a LIVE request's trace.
-                self._recorder.seal(seq.trace_id)
-                raise FleetSaturated(
-                    f"admission queue cap reached ({load2} >= {cap} on "
-                    "the least-loaded worker)",
-                    self.server_cfg.retry_after_s)
-            h, hit = h2, self._peek_hit(h2, seq)
+                # Class-aware admission (README "Elastic fleet"): with
+                # per-class queues enabled, saturation means different
+                # things per class. Batch/background requests PARK in
+                # a bounded deferred lane instead of bouncing a 429 at
+                # the client; interactive requests PREEMPT the newest
+                # batch-lane occupant (recompute-resume puts it back,
+                # byte-identical under greedy) and take its slot. Only
+                # when neither escape works does the legacy shed fire.
+                cls = seq.priority_class or "interactive"
+                if self.server_cfg.class_queue_depth > 0:
+                    if class_rank(cls) > 0:
+                        if self._defer(seq, on_token, on_finish, cls):
+                            return
+                        self._shed(seq, cls, load2, cap)
+                    vw = self._preempt_for_interactive()
+                    if vw is None:
+                        self._shed(seq, cls, load2, cap)
+                    h, hit = vw, (0, 0)
+                else:
+                    self._shed(seq, cls, load2, cap)
+            else:
+                h, hit = h2, self._peek_hit(h2, seq)
         entry = _Tracked(_clone_request(seq), on_token, on_finish)
         entry.seq_local.trace_id = seq.trace_id
         entry.seq_local.enqueue_time = time.perf_counter()
@@ -957,6 +1064,116 @@ class ProcessEngineGroup:
             return (0, 0)
         p = self._peek(h, self._digests_for(seq)[0])
         return (p["hbm"], p["host"])
+
+    def _shed(self, seq: Sequence, cls: str, load: int, cap: int) -> None:
+        """Terminal 429: count it (globally and per class) and raise.
+        Message format is pinned by tests/clients — keep it identical
+        to the pre-class-queue single-cap shed."""
+        with self._lock:
+            self.requests_shed += 1
+            self.class_shed[cls] = self.class_shed.get(cls, 0) + 1
+        # A shed IS terminal: seal the route span so sustained overload
+        # can't fill the recorder's open table and evict a LIVE
+        # request's trace.
+        self._recorder.seal(seq.trace_id)
+        raise FleetSaturated(
+            f"admission queue cap reached ({load} >= {cap} on "
+            "the least-loaded worker)",
+            self.server_cfg.retry_after_s)
+
+    def _defer(self, seq: Sequence, on_token: Callable,
+               on_finish: Callable, cls: str) -> bool:
+        """Park a batch/background request in its class lane instead of
+        shedding it. Returns False when the lane itself is full (then
+        the caller sheds — the deferred queues are bounded so a batch
+        flood can't grow router memory without limit)."""
+        entry = _Tracked(_clone_request(seq), on_token, on_finish)
+        entry.seq_local.trace_id = seq.trace_id
+        entry.seq_local.enqueue_time = time.perf_counter()
+        with self._lock:
+            q = self._deferred[cls]
+            if len(q) >= self.server_cfg.class_queue_depth:
+                return False
+            self._tracked[seq.request_id] = entry
+            q.append(entry)
+        telemetry.log_event("request_deferred", request_id=seq.request_id,
+                            trace_id=seq.trace_id, priority_class=cls)
+        return True
+
+    def _preempt_for_interactive(self) -> Optional[WorkerHandle]:
+        """Watermark preemption: evict the newest lowest-class running
+        request back to its deferred lane (recompute-resume replays its
+        generated tokens on re-dispatch — byte-identical under greedy)
+        and return the worker whose slot it freed."""
+        with self._lock:
+            victims = [e for e in self._tracked.values()
+                       if e.worker is not None
+                       and class_rank(e.template.priority_class) > 0]
+            if not victims:
+                return None
+            victim = max(victims, key=lambda e: (
+                class_rank(e.template.priority_class), e.t_submit))
+            vw, vc = victim.worker, victim.client
+            victim.generation += 1
+            victim.worker = victim.client = None
+            victim.attempts += 1
+            vcls = victim.template.priority_class
+            self.class_preemptions[vcls] = (
+                self.class_preemptions.get(vcls, 0) + 1)
+            # Front of its lane: a preempted request resumes before any
+            # never-started work of the same class.
+            self._deferred[vcls].appendleft(victim)
+        rid = victim.template.request_id
+
+        def _rpc_cancel(client=vc):
+            try:
+                client.rpc("cancel", timeout=10.0, rid=rid)
+            except (WorkerGone, TimeoutError, RuntimeError):
+                pass
+
+        if vc is not None:
+            threading.Thread(target=_rpc_cancel, daemon=True,
+                             name="fleet-preempt-cancel").start()
+        telemetry.log_event("class_preempted", request_id=rid,
+                            trace_id=victim.template.trace_id,
+                            priority_class=vcls, replica=vw.replica)
+        return vw
+
+    def _pump_deferred(self) -> None:
+        """Monitor-thread lane drain: re-admit parked batch/background
+        work whenever capacity frees up. Single consumer (the monitor),
+        so head-pop races only against cancel()."""
+        if not any(self._deferred.values()):
+            return
+        cap = self.server_cfg.admission_queue_depth
+        while True:
+            with self._lock:
+                entry = None
+                for cls in ("batch", "background"):
+                    q = self._deferred[cls]
+                    # Purge heads cancelled while parked.
+                    while q and q[0].template.request_id \
+                            not in self._tracked:
+                        q.popleft()
+                    if q:
+                        entry = q[0]
+                        break
+                if entry is None:
+                    return
+            pool = self._phase_pool(self._entry_phase(entry))
+            if not pool:
+                return
+            h, hit, load = self._pick(pool, entry.template)
+            if cap > 0 and load >= cap:
+                return
+            with self._lock:
+                q = self._deferred[cls]
+                if (not q or q[0] is not entry
+                        or entry.template.request_id not in self._tracked):
+                    continue
+                q.popleft()
+            if not self._dispatch(entry, h, hit):
+                self._retry_or_fail(entry, exclude=h)
 
     def _dispatch(self, entry: _Tracked, h: WorkerHandle,
                   hit: Tuple[int, int]) -> bool:
@@ -998,6 +1215,7 @@ class ProcessEngineGroup:
             "repeat_last_n": t.repeat_last_n,
             "eos_token_id": t.eos_token_id,
             "trace_id": t.trace_id,
+            "class": t.priority_class,
             "attempt": entry.attempts,
             "generated": gen_tokens,
         }
@@ -1142,6 +1360,12 @@ class ProcessEngineGroup:
             sl.generated.append(tok)
             if sl.first_token_time == 0.0:
                 sl.first_token_time = time.perf_counter()
+                # Router-observed TTFT (submit -> first streamed token,
+                # deferral park time included) — the autoscaler's
+                # breach sensor.
+                self._ttft_obs.append(
+                    (sl.first_token_time,
+                     sl.first_token_time - entry.t_submit))
         entry.on_token(sl, tok)
 
     def _finish_trace(self, entry: _Tracked, reason: str) -> None:
@@ -1468,6 +1692,246 @@ class ProcessEngineGroup:
         kw = {} if migrate is None else {"migrate": migrate}
         h.client.rpc("drain", timeout=30.0, **kw)
 
+    # --------------------------------------------------- elastic fleet
+
+    def _add_worker(self, role: str) -> WorkerHandle:
+        """Append a new replica slot (handle + role + per-replica
+        routing/gauge state) without booting it. Index-keyed arrays
+        grow BEFORE the workers append so no reader ever sees a worker
+        whose replica index is out of range."""
+        with self._lock:
+            h = WorkerHandle(len(self.workers))
+            self.roles.append(role)
+            self._route_stats.append({"hits": 0, "cold": 0,
+                                      "hit_pages": 0,
+                                      "host_hit_pages": 0})
+            self.workers.append(h)
+        self._register_worker_gauges(h)
+        return h
+
+    def _autoscale_tick(self, now: float) -> None:
+        """One control-loop step (monitor thread, ~1/s): scale up on a
+        sustained pooled p95 SLO breach, scale down on a sustained lull.
+        Hysteresis = separate breach/idle windows; flap damping = one
+        cooldown shared by both directions; and NO action while any
+        worker is mid-transition (booting/restarting/draining) — that
+        is what makes a chaos kill and a scale-up never double-spawn."""
+        scfg = self.server_cfg
+        if self._stopping or self._rollout_lock.locked():
+            return
+        if any(h.state in (BOOTING, RESTARTING, DRAINING)
+               for h in self.workers):
+            self._breach_since = 0.0
+            return
+        live = self._live_workers()
+        n = len(live)
+        max_n = scfg.autoscale_max_replicas or (self.dp + 2)
+        min_n = max(1, scfg.autoscale_min_replicas)
+        cooled = (now - self._last_scale_t) >= scfg.autoscale_cooldown_s
+        breached = False
+        ecfg = self.engine_cfg
+        if ecfg.slo_ttft_ms:
+            # Router-observed TTFT over a rolling time horizon: the
+            # sensor sees lane park time (engine-side rings do not),
+            # and samples age out, so a finished burst releases the
+            # breach and lets the idle path scale back down.
+            horizon = max(5.0 * scfg.autoscale_breach_window_s,
+                          2.0 * scfg.autoscale_cooldown_s)
+            cut = time.perf_counter() - horizon  # samples' own clock
+            with self._lock:
+                while self._ttft_obs and self._ttft_obs[0][0] < cut:
+                    self._ttft_obs.popleft()
+                xs = sorted(v for _, v in self._ttft_obs)
+            if xs:
+                p95 = xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+                breached = p95 > ecfg.slo_ttft_ms / 1000.0
+        if not breached and ecfg.slo_tpot_ms and self._tracked:
+            # TPOT breach from the workers' pooled rings, gated on live
+            # in-flight work (a count-based ring cannot age out on its
+            # own — without traffic it must not pin the fleet wide).
+            p95 = self._pooled_slo_quantile("tpot", 0.95)
+            if p95 == p95 and p95 > ecfg.slo_tpot_ms / 1000.0:
+                breached = True
+        if breached:
+            self._idle_since = 0.0
+            if not self._breach_since:
+                self._breach_since = now
+            elif (now - self._breach_since >= scfg.autoscale_breach_window_s
+                    and cooled and n < max_n):
+                self._scale_up("slo_breach")
+            return
+        self._breach_since = 0.0
+        occs = [float((h.last_health or {}).get("ladder_occupancy") or 0.0)
+                for h in live if h.state == UP]
+        pooled_occ = (sum(occs) / len(occs)) if occs else 1.0
+        backlog = any(self._deferred.values())
+        if backlog or pooled_occ >= scfg.autoscale_low_watermark:
+            self._idle_since = 0.0
+            return
+        if not self._idle_since:
+            self._idle_since = now
+        elif (now - self._idle_since >= scfg.autoscale_idle_window_s
+                and cooled and n > min_n and n > 1):
+            self._scale_down("idle")
+
+    def _scale_up(self, reason: str) -> None:
+        t0 = time.perf_counter()
+        role = self.server_cfg.autoscale_role or (
+            "decode" if self.pd_enabled else "mixed")
+        h = self._add_worker(role)
+        telemetry.log_event("fleet_scale_up", replica=h.replica,
+                            role=role, reason=reason)
+        try:
+            self._spawn(h)
+        except (WorkerGone, TimeoutError, RuntimeError, OSError) as e:
+            # Boot failed: hand the slot to the ordinary supervisor
+            # (backoff respawn → quarantine) rather than special-casing.
+            h.consecutive_failures += 1
+            telemetry.log_event("worker_respawn_failed", level="error",
+                                replica=h.replica, error=str(e))
+            self._schedule_restart(h)
+        with self._lock:
+            self.scale_ups += 1
+        self._last_scale_t = time.monotonic()
+        self._breach_since = 0.0
+        tid = f"scale-up-{self.scale_ups}"
+        self._recorder.add("scale_up", tid, t0, time.perf_counter(),
+                           parent="", replica=h.replica, role=role,
+                           reason=reason)
+        self._recorder.seal(tid)
+
+    def _scale_down(self, reason: str) -> None:
+        t0 = time.perf_counter()
+        h = self._retire_candidate()
+        if h is None:
+            return
+        h.retiring = True
+        try:
+            # PR 9 lossless scale-down: drain exports live KV as
+            # migrate events, the router re-lands them on survivors,
+            # and the post-drain exit lands in RETIRED (not a respawn)
+            # because retiring is set.
+            self.drain_worker(h.replica)
+        except (WorkerGone, TimeoutError, RuntimeError, ValueError) as e:
+            h.retiring = False
+            telemetry.log_event("fleet_scale_down_failed", level="warning",
+                                replica=h.replica, error=str(e))
+            return
+        with self._lock:
+            self.scale_downs += 1
+        self._last_scale_t = time.monotonic()
+        self._idle_since = 0.0
+        telemetry.log_event("fleet_scale_down", replica=h.replica,
+                            reason=reason)
+        tid = f"scale-down-{self.scale_downs}"
+        self._recorder.add("scale_down", tid, t0, time.perf_counter(),
+                           parent="", replica=h.replica, reason=reason)
+        self._recorder.seal(tid)
+
+    def _retire_candidate(self) -> Optional[WorkerHandle]:
+        """Coldest UP replica that can leave without killing a P/D
+        phase: fewest in-flight requests, then lowest occupancy, ties
+        retire the newest index (scale-ups go first)."""
+        cands = [h for h in self.workers
+                 if h.state == UP and not h.retiring]
+        if len(cands) <= 1:
+            return None
+        if self.pd_enabled:
+            def _ok_without(w):
+                rest = [self.roles[h.replica] for h in cands if h is not w]
+                return (any(r in ("prefill", "mixed") for r in rest)
+                        and any(r in ("decode", "mixed") for r in rest))
+            cands = [h for h in cands if _ok_without(h)]
+            if not cands:
+                return None
+        return min(cands, key=lambda h: (
+            self._fleet_load(h),
+            float((h.last_health or {}).get("ladder_occupancy") or 0.0),
+            -h.replica))
+
+    def rollout(self) -> dict:
+        """Zero-downtime rolling upgrade (POST /debug/rollout): replace
+        each worker one at a time under live traffic — spawn the
+        successor FIRST, then drain-and-migrate the predecessor into
+        the fleet, then let its post-drain exit retire it. In-flight
+        sequences ride the migrate path (or recompute-resume), so no
+        request fails or restarts from zero."""
+        self._ensure_started()
+        if self._stopping:
+            raise ValueError("fleet is stopping")
+        if not self._rollout_lock.acquire(blocking=False):
+            raise ValueError("a rollout is already in progress")
+        t0 = time.perf_counter()
+        replaced, failed = [], []
+        try:
+            targets = [h for h in self.workers
+                       if h.state == UP and not h.retiring]
+            telemetry.log_event("fleet_rollout_start",
+                                targets=[h.replica for h in targets])
+            for old in targets:
+                if old.state != UP:
+                    continue    # died mid-rollout; supervisor owns it
+                succ = self._add_worker(self.roles[old.replica])
+                try:
+                    self._spawn(succ)
+                except (WorkerGone, TimeoutError, RuntimeError,
+                        OSError) as e:
+                    # Never retire a predecessor without a live
+                    # successor: abort the rollout, keep serving.
+                    succ.state = DEAD
+                    failed.append({"replica": old.replica,
+                                   "successor": succ.replica,
+                                   "error": str(e)})
+                    telemetry.log_event("fleet_rollout_spawn_failed",
+                                        level="error",
+                                        replica=succ.replica,
+                                        error=str(e))
+                    break
+                old.retiring = True
+                try:
+                    self.drain_worker(old.replica)
+                except (WorkerGone, TimeoutError, RuntimeError,
+                        ValueError) as e:
+                    # The predecessor died or restarted out from under
+                    # the rollout (e.g. chaos): the supervisor owns it
+                    # now and its in-flight work already failed over.
+                    # The successor stays (extra capacity is harmless);
+                    # move on without stalling the pass.
+                    old.retiring = False
+                    telemetry.log_event("fleet_rollout_drain_failed",
+                                        level="warning",
+                                        replica=old.replica,
+                                        error=str(e))
+                    replaced.append({"old": old.replica,
+                                     "new": succ.replica,
+                                     "old_state": old.state})
+                    continue
+                deadline = (time.monotonic()
+                            + self.server_cfg.drain_timeout_s + 30.0)
+                while (time.monotonic() < deadline
+                       and old.state not in (RETIRED, DEAD)
+                       and old.retiring):
+                    time.sleep(0.05)
+                replaced.append({"old": old.replica,
+                                 "new": succ.replica,
+                                 "old_state": old.state})
+        finally:
+            with self._lock:
+                self.rollouts += 1
+            tid = f"rollout-{self.rollouts}"
+            self._recorder.add("rollout", tid, t0, time.perf_counter(),
+                               parent="", replaced=len(replaced),
+                               failed=len(failed))
+            self._recorder.seal(tid)
+            self._rollout_lock.release()
+        wall = time.perf_counter() - t0
+        telemetry.log_event("fleet_rollout_done",
+                            replaced=len(replaced), failed=len(failed),
+                            wall_s=round(wall, 3))
+        return {"replaced": replaced, "failed": failed,
+                "live": len(self._live_workers()),
+                "wall_s": round(wall, 3)}
+
     # ---------------------------------------------------- observability
 
     def embed_many(self, batch):
@@ -1521,6 +1985,14 @@ class ProcessEngineGroup:
                 "resume_reused_tokens": self.resume_reused_tokens,
                 "swap_in_resumes": sum(d.get("swap_in_resumes", 0)
                                        for d in stats),
+                # Elastic fleet (README "Elastic fleet").
+                "scale_ups": self.scale_ups,
+                "scale_downs": self.scale_downs,
+                "rollouts": self.rollouts,
+                "class_preemptions": dict(self.class_preemptions),
+                "class_shed": dict(self.class_shed),
+                "class_deferred": {c: len(q)
+                                   for c, q in self._deferred.items()},
             }
 
     def health_snapshot(self) -> dict:
@@ -1554,10 +2026,15 @@ class ProcessEngineGroup:
                 if k in hz:
                     d[k] = hz[k]
             replicas.append(d)
-        routable = sum(1 for h in self.workers if h.routable)
+        # RETIRED replicas left the fleet ON PURPOSE (scale-down or a
+        # rollout retirement) — they must not drag status to degraded
+        # forever. QUARANTINED stays in the denominator: a crash-looped
+        # replica is a visible degradation, not an intentional absence.
+        live = [h for h in self.workers if h.state != RETIRED]
+        routable = sum(1 for h in live if h.routable)
         if routable == 0:
             status = "unavailable"
-        elif routable == len(self.workers):
+        elif routable == len(live):
             status = "ok"
         else:
             status = "degraded"

@@ -44,7 +44,7 @@ from typing import Any, Optional
 from aiohttp import web
 
 from tpu_inference import telemetry
-from tpu_inference.config import FrameworkConfig
+from tpu_inference.config import PRIORITY_CLASSES, FrameworkConfig
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.sampling import PENALTY_WINDOW
 from tpu_inference.server.replicas import (FleetSaturated, FleetUnavailable)
@@ -187,6 +187,7 @@ class InferenceServer:
             app.router.add_get("/debug/trace", self.handle_debug_trace)
             app.router.add_post("/debug/profile", self.handle_profile)
             app.router.add_post("/debug/chaos", self.handle_chaos)
+            app.router.add_post("/debug/rollout", self.handle_rollout)
         app.on_startup.append(self._on_startup)
         app.on_cleanup.append(self._on_cleanup)
         return app
@@ -547,6 +548,26 @@ class InferenceServer:
                 content_type="application/json")
         return web.json_response(result)
 
+    async def handle_rollout(self, request: web.Request) -> web.Response:
+        """Zero-downtime rolling upgrade (README "Elastic fleet"):
+        ``POST /debug/rollout`` replaces every worker one at a time
+        under live traffic — spawn successor, drain-and-migrate the
+        predecessor's in-flight sequences, retire it. Subprocess fleet
+        only (the in-process group has no worker processes to roll).
+        409 when a rollout is already running; debug-only so a
+        production endpoint can't be rolled by an anonymous POST."""
+        roll = getattr(self.group, "rollout", None)
+        if roll is None:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": "rolling upgrades need --fleet subprocess"}),
+                content_type="application/json")
+        try:
+            result = await asyncio.to_thread(roll)
+        except ValueError as e:
+            raise web.HTTPConflict(text=json.dumps(
+                {"error": str(e)}), content_type="application/json")
+        return web.json_response(result)
+
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         """Ollama ``/api/chat``: messages-based wrapper over the same
         engine path (the reference's notebooks drive this via ChatOllama —
@@ -732,18 +753,30 @@ class InferenceServer:
         trace_id = (request.headers.get("X-Request-Id") or "").strip()
         trace_id = ("".join(c for c in trace_id if c.isprintable())[:64]
                     or uuid.uuid4().hex[:16])
+        # Priority class (README "Elastic fleet"): X-Priority header
+        # (interactive | batch | background), else the server default.
+        # An unknown name is a 400 — silently ranking a typo'd class as
+        # interactive would defeat the batch lane it asked for.
+        pcls = (request.headers.get("X-Priority") or "").strip().lower()
+        if not pcls:
+            pcls = self.cfg.server.default_class
+        if pcls not in PRIORITY_CLASSES:
+            raise web.HTTPBadRequest(text=json.dumps(
+                {"error": f"unknown X-Priority {pcls!r} (expected one "
+                          f"of {', '.join(PRIORITY_CLASSES)})"}),
+                content_type="application/json")
         seq = Sequence(request_id=rid, prompt_tokens=prompt_ids,
                        max_new_tokens=max_tokens, temperature=temperature,
                        top_p=top_p, top_k=top_k, seed=seed,
                        repeat_penalty=repeat_penalty,
                        repeat_last_n=repeat_last_n,
                        eos_token_id=self.tokenizer.eos_token_id,
-                       trace_id=trace_id)
+                       trace_id=trace_id, priority_class=pcls)
         telemetry.log_event(
             "request_received", level="info", request_id=trace_id,
             route="chat" if chat else "generate",
             prompt_tokens=len(prompt_ids), max_tokens=max_tokens,
-            stream=stream)
+            priority_class=pcls, stream=stream)
 
         loop = asyncio.get_running_loop()
         queue: asyncio.Queue = asyncio.Queue()

@@ -158,6 +158,7 @@ def _clone_request(seq: Sequence) -> Sequence:
         seed=seq.seed, repeat_penalty=seq.repeat_penalty,
         repeat_last_n=seq.repeat_last_n, eos_token_id=seq.eos_token_id,
         trace_id=seq.trace_id,
+        priority_class=seq.priority_class,
         # The prompt's chain hashes are a pure function of the tokens:
         # the replay reuses the original's single hash pass (bytes are
         # immutable — sharing the list is safe).

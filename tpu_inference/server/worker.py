@@ -397,6 +397,7 @@ class EngineWorker:
             repeat_last_n=int(s.get("repeat_last_n", 64)),
             eos_token_id=s.get("eos_token_id"),
             trace_id=s.get("trace_id", ""),
+            priority_class=s.get("class", "interactive"),
             attempt=int(s.get("attempt", 0)))
         generated = s.get("generated") or []
         if generated:

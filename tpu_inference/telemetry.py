@@ -949,6 +949,49 @@ def register_fleet_slo(registry: Registry,
                          fn=lambda k=kind: breaches_fn(k), slo=kind)
 
 
+def register_fleet_elastic(registry: Registry,
+                           scale_ups: Callable[[], int],
+                           scale_downs: Callable[[], int],
+                           rollouts: Callable[[], int],
+                           class_preempted: Callable[[str], int],
+                           class_deferred: Callable[[str], int],
+                           class_shed: Callable[[str], int]) -> None:
+    """Elastic-fleet series (README "Elastic fleet"): autoscaler and
+    rollout actuations, plus the per-class admission outcomes. All
+    router-side state, so the series survive worker restarts without a
+    carry. Interactive requests never defer or preempt (they are the
+    preemptORs), so those two series only exist for the lower classes."""
+    from tpu_inference.config import PRIORITY_CLASSES
+
+    registry.counter("tpu_inf_fleet_scale_ups_total",
+                     "Autoscaler scale-up actuations (worker spawned on "
+                     "a sustained pooled-SLO breach)", fn=scale_ups)
+    registry.counter("tpu_inf_fleet_scale_downs_total",
+                     "Autoscaler scale-down actuations (coldest replica "
+                     "drain-and-migrated away on a sustained lull)",
+                     fn=scale_downs)
+    registry.counter("tpu_inf_fleet_rollouts_total",
+                     "Completed rolling-upgrade passes (POST "
+                     "/debug/rollout)", fn=rollouts)
+    for cls in PRIORITY_CLASSES:
+        registry.counter("tpu_inf_class_shed_total",
+                         "Requests shed with 429 after every class "
+                         "escape (defer/preempt) failed",
+                         fn=lambda c=cls: class_shed(c), **{"class": cls})
+        if cls == PRIORITY_CLASSES[0]:
+            continue
+        registry.counter("tpu_inf_class_preempted_total",
+                         "Running requests of this class preempted back "
+                         "to their lane by an interactive arrival",
+                         fn=lambda c=cls: class_preempted(c),
+                         **{"class": cls})
+        registry.gauge("tpu_inf_class_deferred",
+                       "Requests currently parked in this class's "
+                       "deferred admission lane",
+                       fn=lambda c=cls: float(class_deferred(c)),
+                       **{"class": cls})
+
+
 def capture_jax_profile(profile_dir: str, replica: int,
                         seconds: float) -> Dict[str, Any]:
     """THE jax.profiler capture body behind POST /debug/profile, shared

@@ -36,6 +36,18 @@ class TrafficGenerator:
         self.logger = logger
         self.queries = Query(data, schedule, max_prompt_len=max_prompt_len,
                              max_gen_len=max_gen_len)
+        # Shared retry budget (README "Elastic fleet" client contract):
+        # one pool across ALL in-flight queries, consumed one token per
+        # retry. Under sustained overload the budget drains and later
+        # 429s shed immediately — a fleet of clients stops amplifying
+        # the exact load the server is shedding. Default scales with
+        # the trace; 0 disables the pool (per-query max_retries still
+        # bounds each call).
+        budget = config.get("retry_budget")
+        if budget is None:
+            self._retry_budget = max(16, len(self.queries))
+        else:
+            self._retry_budget = int(budget) or None  # 0 = unlimited
 
     def _payload(self, prompt: str, len_output: int) -> dict:
         temperature = float(self.config.get("temperature", 0.0))
@@ -71,17 +83,31 @@ class TrafficGenerator:
         return max(0, n_lines - 1)
 
     def _shed_delay(self, resp, attempt: int) -> float:
-        """Backoff before retrying a 429/503: honor the server's
-        Retry-After hint when present, never below exponential backoff
-        with jitter (so a fleet of clients doesn't re-stampede the
-        server on the exact hinted second)."""
+        """Backoff before retrying a 429/503: the server's Retry-After
+        hint plus FULL-jitter exponential backoff — uniform on
+        [0, base·2^attempt], capped. Multiplicative jitter (hint ×
+        1.0–1.25) kept 80% of a synchronized shed wave inside a 25%
+        window, re-stampeding the router right at the hinted second;
+        full jitter spreads the wave across the whole backoff span
+        (Exponential Backoff And Jitter, AWS architecture blog)."""
         base = float(self.config.get("retry_backoff_s", 0.25))
+        cap = float(self.config.get("retry_backoff_cap_s", 10.0))
         try:
             hinted = float(resp.headers.get("Retry-After", ""))
         except ValueError:
             hinted = 0.0
-        delay = max(hinted, base * (2 ** attempt))
-        return delay * (1.0 + 0.25 * random.random())
+        return hinted + random.uniform(0.0, min(cap, base * (2 ** attempt)))
+
+    def _consume_retry(self) -> bool:
+        """Take one token from the shared retry budget. False means the
+        pool is dry: shed instead of retrying (single-threaded asyncio,
+        so the read-decrement needs no lock)."""
+        if self._retry_budget is None:
+            return True
+        if self._retry_budget <= 0:
+            return False
+        self._retry_budget -= 1
+        return True
 
     async def inference_call(self, session: aiohttp.ClientSession,
                              prompt: str, len_output: int, sleep_time: float,
@@ -111,6 +137,12 @@ class TrafficGenerator:
                             collector.record_shed(query_id)
                             print(f"[SHED] query {query_id}: "
                                   f"{resp.status} after {attempt} retries")
+                            return
+                        if not self._consume_retry():
+                            collector.record_shed(query_id)
+                            print(f"[SHED] query {query_id}: "
+                                  f"{resp.status}, retry budget "
+                                  "exhausted")
                             return
                         delay = self._shed_delay(resp, attempt)
                         collector.record_retry(query_id)
