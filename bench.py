@@ -238,6 +238,20 @@ def lane_child(spec: str) -> None:
               if k in ("decode_dispatch_s", "decode_sync_s",
                        "dispatch_bubble_s", "prefill_dispatch_s",
                        "tokens_per_dispatch")}
+    # Roofline attribution for the lane (README "Performance
+    # attribution"): the same verdict block the serving fleet exposes
+    # at /debug/steps, computed from this lane's own step ledger.
+    steps = engine.telemetry.steps_report()
+    attribution = ({"enabled": False} if not steps.get("enabled") else {
+        "enabled": True,
+        "records": steps.get("records_window"),
+        "verdicts": {kk: v.get("verdict")
+                     for kk, v in (steps.get("kinds") or {}).items()},
+        "rung_occupancy": steps.get("rung_occupancy") or {},
+        "top_sinks": steps.get("top_sinks") or [],
+        "compile_events": steps.get("compile_events"),
+        "mfu": steps.get("mfu") or {},
+    })
     print(json.dumps({
         "lane": spec, "model": cfg.name, "platform": platform,
         "sync_tok_s": sync_tok_s, "chained_tok_s": chained_tok_s,
@@ -246,6 +260,7 @@ def lane_child(spec: str) -> None:
         "kv_bytes_per_token": 2 * 2 * cfg.n_layers * cfg.n_kv_heads
                               * cfg.head_dim,
         "phases": phases,
+        "step_attribution": attribution,
     }), flush=True)
     del engine
     gc.collect()

@@ -53,6 +53,35 @@ def scrape_metrics(port: int, fmt: str = None) -> tuple:
         return r.read().decode(), r.headers.get("Content-Type", "")
 
 
+def step_attribution(port: int) -> dict:
+    """GET /debug/steps compressed into the artifact's attribution
+    block (README "Performance attribution"): the fleet-merged
+    bottleneck verdict per step kind, the per-rung occupancy histogram,
+    the top-3 time sinks, and the MFU cross-check — so every committed
+    row explains WHY it ran at the throughput it did."""
+    url = f"http://127.0.0.1:{port}/debug/steps"
+    with urllib.request.urlopen(url, timeout=60) as r:
+        snap = json.loads(r.read().decode())
+    fleet = snap.get("fleet") or {}
+    if not fleet.get("enabled"):
+        return {"enabled": False}
+    return {
+        "enabled": True,
+        "records": fleet.get("records_window"),
+        "verdicts": {k: v.get("verdict")
+                     for k, v in (fleet.get("kinds") or {}).items()},
+        "rung_occupancy": fleet.get("rung_occupancy") or {},
+        "top_sinks": fleet.get("top_sinks") or [],
+        "compile_events": fleet.get("compile_events"),
+        "mfu": fleet.get("mfu") or {},
+        "replica_verdicts": {
+            rep: {k: v.get("verdict")
+                  for k, v in (rr.get("kinds") or {}).items()}
+            for rep, rr in (snap.get("replicas") or {}).items()
+            if rr.get("enabled")},
+    }
+
+
 def phase_breakdown(before: dict, after: dict) -> dict:
     """Diff two /metrics?format=json scrapes into the run window's phase
     histograms: dispatch wall vs host bubble vs queue wait (p50/p95/p99)
@@ -735,6 +764,7 @@ def run_replay(args) -> dict:
         after_json, _ = scrape_metrics(port, fmt="json")
         after = json.loads(after_json)
         prom_text, prom_ctype = scrape_metrics(port)
+        attribution = step_attribution(port)
         summary = summarize(metrics,
                             n_chips=getattr(args, "dp", 1) * args.tp * args.sp)
         summary["replay_s"] = round(replay_s, 3)
@@ -752,6 +782,7 @@ def run_replay(args) -> dict:
             "shed_rate": summary["shed_rate"],
         }
         summary["phase_breakdown"] = phase_breakdown(before, after)
+        summary["step_attribution"] = attribution
         # Rolling SLO gauges (README "Observability"): the fleet's
         # exact windowed quantiles + breach counts at scrape time
         # (windows dropped — the artifact carries the numbers).

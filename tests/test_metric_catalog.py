@@ -65,5 +65,91 @@ def test_catalog_covers_this_prs_series():
     tripwire for the greps themselves going blind)."""
     code, doc = _code_names(), _doc_names()
     for name in ("tpu_inf_slo_ttft_seconds", "tpu_inf_slo_tpot_seconds",
-                 "tpu_inf_slo_breaches_total", "tpu_inf_build_info"):
+                 "tpu_inf_slo_breaches_total", "tpu_inf_build_info",
+                 "tpu_inf_metrics_render_seconds",
+                 "tpu_inf_trace_ring_traces",
+                 "tpu_inf_trace_spans_dropped_total"):
         assert name in code and name in doc, name
+
+
+# ---------------------------------------------------------------------------
+# Span-name drift gate: the literals passed to SpanRecorder.add()/
+# add_maintenance() across the codebase must agree with the canonical
+# telemetry.SPAN_NAMES vocabulary AND with the README span table — in
+# both directions — so a new span cannot ship undocumented and a
+# documented span cannot outlive its emitter. Several call sites wrap
+# the name onto the line after ``add(`` — the regex tolerates that.
+_SPAN_ADD_RE = re.compile(r'\.add(?:_maintenance)?\(\s*\n?\s*"([a-z_0-9]+)"')
+# README documents spans as table rows: | `name` | emitted by | ...
+_SPAN_DOC_RE = re.compile(r"^\|\s*`([a-z_0-9]+)`(?:\s*/\s*`([a-z_0-9]+)`)*",
+                          re.MULTILINE)
+
+
+def _code_span_names() -> set:
+    names = set()
+    for path in (ROOT / "tpu_inference").rglob("*.py"):
+        names |= set(_SPAN_ADD_RE.findall(path.read_text()))
+    return names
+
+
+def _doc_span_names() -> set:
+    """Backticked names in README table rows that are span names (a row
+    may document two spans: | `drain_export` / `migrate` | ...)."""
+    text = (ROOT / "README.md").read_text()
+    names = set()
+    for line in text.splitlines():
+        m = re.match(r"\|\s*`([a-z_0-9]+)`(\s*/\s*`([a-z_0-9]+)`)?\s*\|",
+                     line)
+        if m:
+            names.add(m.group(1))
+            if m.group(3):
+                names.add(m.group(3))
+    return names
+
+
+def test_span_vocabulary_matches_code():
+    from tpu_inference import telemetry
+    code = _code_span_names()
+    assert code, "span grep found no add() literals — the pattern rotted"
+    vocab = set(telemetry.SPAN_NAMES)
+    assert code <= vocab, (
+        f"spans emitted in code but missing from SPAN_NAMES: "
+        f"{sorted(code - vocab)}")
+    assert vocab <= code, (
+        f"SPAN_NAMES entries no code path emits: {sorted(vocab - code)}")
+
+
+def test_span_vocabulary_documented():
+    from tpu_inference import telemetry
+    doc = _doc_span_names()
+    vocab = set(telemetry.SPAN_NAMES)
+    missing = sorted(vocab - doc)
+    assert not missing, (
+        f"SPAN_NAMES entries absent from the README span table: {missing}")
+
+
+# ---------------------------------------------------------------------------
+# Debug-endpoint drift gate: every "/debug/<name>" route registered in
+# code must be mentioned in the README, and every /debug/ path the
+# README documents must still be served.
+_ROUTE_RE = re.compile(r'"(/debug/[a-z_]+)"')
+_ROUTE_DOC_RE = re.compile(r"/debug/[a-z_]+")
+
+
+def _code_routes() -> set:
+    routes = set()
+    for path in (ROOT / "tpu_inference").rglob("*.py"):
+        routes |= set(_ROUTE_RE.findall(path.read_text()))
+    return routes
+
+
+def test_every_debug_route_is_documented():
+    code = _code_routes()
+    doc = set(_ROUTE_DOC_RE.findall((ROOT / "README.md").read_text()))
+    assert code, "route grep found no /debug/ literals — pattern rotted"
+    missing = sorted(code - doc)
+    assert not missing, (
+        f"/debug/ routes served but absent from the README: {missing}")
+    stale = sorted(doc - code)
+    assert not stale, (
+        f"/debug/ routes documented in README but not served: {stale}")

@@ -61,6 +61,11 @@ def test_replay_smoke_commits_phase_breakdown(tmp_path, monkeypatch):
     assert prom["content_type"].startswith("text/plain; version=0.0.4")
     assert prom["families"] >= 10
     assert prom["samples"] > 50
+    # The step-attribution block rode along (live /debug/steps path;
+    # the committed artifact's copy is graded in test_step_ledger.py).
+    att = art["summary"]["step_attribution"]
+    assert att["enabled"] and att["records"] > 0
+    assert att["verdicts"] and att["mfu"]["ledger"] is not None
 
 
 def test_replay_smoke_compare_admission(tmp_path, monkeypatch):

@@ -75,7 +75,8 @@ def test_render_prometheus_label_escaping_roundtrip():
     assert '\\"' in text and "\\\\" in text and "\\n" in text
     # ...and the independent parser recovers the original value.
     meta, samples = _prom.parse(text)
-    (name, labels, value), = samples
+    # The page also carries the render-time self-histogram; pick ours.
+    (name, labels, value), = [s for s in samples if s[0] == "t_total"]
     assert name == "t_total" and value == 3
     assert labels["reason"] == nasty and labels["replica"] == "0"
     assert meta["t_total"]["type"] == "counter"

@@ -516,6 +516,11 @@ class EngineConfig:
     # SLO-driven autoscaler scales on. 0 = no target.
     slo_ttft_ms: float = 0.0
     slo_tpot_ms: float = 0.0
+    # Step ledger depth (README "Performance attribution"): how many
+    # per-dispatch records the roofline-attribution ring retains. Each
+    # record is one small tuple, so deeper rings cost only memory; 60 s
+    # of bs=8 decode at ~10 ms/dispatch is ~6000 records.
+    step_ledger_depth: int = 256
     # Worker phase role (README "P/D disaggregation"): "mixed" runs both
     # phases (the compatibility default — every pre-P/D topology);
     # "prefill" serves prompt prefills only and HANDS each settled
@@ -649,6 +654,16 @@ class ServerConfig:
     # client never chooses the path.
     enable_debug: bool = False
     profile_dir: str = "/tmp/jax-trace"
+    # Crash flight recorder (README "Performance attribution"): bounded
+    # per-replica capture dir for step records + spans + config + stats
+    # on watchdog trip / step_error / SIGTERM / atexit. Same security
+    # stance as profile_dir: the OPERATOR configures the path (CLI
+    # --blackbox-dir), never a client. "" disables the recorder — the
+    # library default, so embedded/test engine groups do no disk I/O
+    # unless a path is set; the CLI serves with /tmp/tpu-inf-blackbox.
+    blackbox_dir: str = ""
+    # Captures retained per replica before the oldest is pruned.
+    blackbox_retain: int = 8
     # Fault injection (SURVEY.md §5 failure detection: "HTTP-stub chaos
     # mode"): randomly reject this fraction of generate/chat/embed
     # requests with 503 and/or delay them, to test client resilience.

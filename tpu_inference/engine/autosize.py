@@ -57,6 +57,18 @@ PEAK_FLOPS_BY_DEVICE_KIND = {
 }
 DEFAULT_PEAK_FLOPS = 394e12
 
+# Per-chip HBM bandwidth (bytes/s) — the bytes-roofline denominator the
+# step-ledger bottleneck verdicts (telemetry.roofline_report) divide by.
+# Same unknown-chip stance as the peak-FLOPs table: CPU reports against
+# a v5e so the attribution math always renders.
+PEAK_HBM_BW_BY_DEVICE_KIND = {
+    "TPU v5 lite": 819e9,
+    "TPU v4": 1228e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+}
+DEFAULT_PEAK_HBM_BW = 819e9
+
 
 def estimate_param_count(model_cfg) -> int:
     """Parameter count from the architecture config (norms elided)."""
@@ -243,6 +255,15 @@ def detect_peak_flops() -> float:
 
     return PEAK_FLOPS_BY_DEVICE_KIND.get(jax.devices()[0].device_kind,
                                          DEFAULT_PEAK_FLOPS)
+
+
+def detect_peak_hbm_bw() -> float:
+    """Per-chip HBM bandwidth (bytes/s) of the visible device — the
+    bytes-roofline denominator for step-ledger bottleneck verdicts."""
+    import jax
+
+    return PEAK_HBM_BW_BY_DEVICE_KIND.get(jax.devices()[0].device_kind,
+                                          DEFAULT_PEAK_HBM_BW)
 
 
 def decode_ladder_rungs(top: int, base: int = 8) -> tuple:

@@ -611,6 +611,17 @@ class InferenceEngine:
         self.decode_rung = ladder[0]      # rung of the latest dispatch
         self.rung_peak = ladder[0]        # highest rung reached
         self.rung_switches_total = 0      # dispatches at a changed rung
+        # Step-ledger scratch (telemetry.py StepLedger; README
+        # "Performance attribution"): compile-event detection per rung /
+        # prefill bucket, the staged bubble/staging micros the next
+        # ledger push consumes, and the KV-swap byte-counter watermark
+        # that turns cumulative swap counters into per-record deltas.
+        self._rungs_seen: set = set()
+        self._prefill_buckets_seen: set = set()
+        self._pending_bubble = 0.0
+        self._last_staging_s = 0.0
+        self._last_swap_bytes_total = 0.0
+        self._last_compile_event = False
         # Host staging reuse (the per-dispatch bubble shrinker): per-rung
         # persistent arrays, refreshed incrementally. Device hand-off
         # always copies — jnp.asarray aliases numpy memory on CPU, and
@@ -1177,22 +1188,25 @@ class InferenceEngine:
         start timestamp."""
         now = time.perf_counter()
         last = self._last_decode_end
+        self._pending_bubble = 0.0
         if last is not None and self.telemetry.enabled:
             gap = now - last
             self.telemetry.dispatch_bubble_s.observe(gap)
+            self._pending_bubble = gap     # step-ledger host-bound input
             for seq in active_seqs:
                 seq.bubble_s += gap
         return now
 
     def _note_decode_exit(self, t0: float,
-                          active_seqs: List["Sequence"]) -> None:
+                          active_seqs: List["Sequence"]) -> float:
         """Record one decode dispatch's host wall and refresh the bubble
         reference point. The streak survives only while some sequence is
-        still live — cross-idle gaps are not bubbles."""
+        still live — cross-idle gaps are not bubbles. Returns the
+        dispatch wall (the step ledger's device_s input)."""
         now = time.perf_counter()
+        dt = now - t0
         tel = self.telemetry
         if tel.enabled:
-            dt = now - t0
             tel.decode_dispatch_s.observe(dt)
             tel.decode_dispatches.inc()
             for seq in active_seqs:
@@ -1200,6 +1214,7 @@ class InferenceEngine:
         self._last_decode_end = (
             now if any(s is not None and not s.done for s in self.slots)
             else None)
+        return dt
 
     def _next_key(self) -> jax.Array:
         self._step_count += 1
@@ -1908,6 +1923,15 @@ class InferenceEngine:
                 "prefill_chunk", seq.trace_id or str(seq.request_id),
                 t0, t0 + dt, parent="prefill",
                 offset=int(offset), tokens=int(st["chunk_tokens"]))
+            c = st["chunk_tokens"]
+            final = offset + c >= len(prompt)
+            self._ledger_push(
+                "prefill_chunk", rung=0, slots=1,
+                tokens=1 if final else 0, chunk_tokens=c,
+                device_s=dt, kv_read=c * offset + c * (c + 1) // 2,
+                compile_event=st["bucket"]
+                not in self._prefill_buckets_seen)
+            self._prefill_buckets_seen.add(st["bucket"])
         return offset + st["chunk_tokens"], tok
 
     def _prefill_chunked(self, seq: Sequence, prompt: List[int]) -> None:
@@ -2020,6 +2044,17 @@ class InferenceEngine:
             self.telemetry.prefill_dispatches.inc()
             for seq, _ in group:
                 seq.dispatch_wall_s += dt
+            graph_key = (bucket, p, use_sp)
+            self._ledger_push(
+                "prefill_chunk", rung=0, slots=len(group),
+                tokens=len(group),
+                chunk_tokens=int(plen[:len(group)].sum()),
+                device_s=dt,
+                kv_read=int((plen[:len(group)] * pref[:len(group)]
+                             + plen[:len(group)]
+                             * (plen[:len(group)] + 1) // 2).sum()),
+                compile_event=graph_key not in self._prefill_buckets_seen)
+            self._prefill_buckets_seen.add(graph_key)
         for i, (seq, prompt) in enumerate(group):
             self._prefill_finish(seq, prompt, int(toks_out[i]))
 
@@ -2289,11 +2324,48 @@ class InferenceEngine:
         return self.ladder[-1]
 
     def _note_rung(self, rung: int) -> None:
-        """Record the dispatch rung (gauge + graph-switch counter)."""
+        """Record the dispatch rung (gauge + graph-switch counter) and
+        flag first-ever-rung dispatches for the step ledger (the compile
+        event a warm-up-free boot pays on that dispatch)."""
+        self._last_compile_event = rung not in self._rungs_seen
+        self._rungs_seen.add(rung)
         if rung != self.decode_rung:
             self.rung_switches_total += 1
             self.decode_rung = rung
             self.rung_peak = max(self.rung_peak, rung)
+
+    def _ledger_push(self, kind: str, *, rung: int, slots: int,
+                     tokens: int, chunk_tokens: int = 0, steps: int = 1,
+                     device_s: float = 0.0, kv_read: int = 0,
+                     spec_accepted: int = 0,
+                     staging_s: Optional[float] = None,
+                     bubble_s: Optional[float] = None,
+                     compile_event: Optional[bool] = None) -> None:
+        """Push one per-dispatch record into the step ledger, folding in
+        the staged bubble/staging micros (unless the caller captured
+        them at stage time — pipelined calls push at SYNC, by which
+        point the scratch belongs to a newer dispatch) and the KV-swap
+        byte delta since the previous record. Callers gate on
+        telemetry.enabled (the swap counters are NULL_METRIC otherwise).
+        """
+        tel = self.telemetry
+        swap_total = (tel.kv_offload_bytes.value
+                      + tel.kv_restore_bytes.value)
+        swap = max(0.0, swap_total - self._last_swap_bytes_total)
+        self._last_swap_bytes_total = swap_total
+        if staging_s is None:
+            staging_s = self._last_staging_s
+            self._last_staging_s = 0.0
+        if bubble_s is None:
+            bubble_s = self._pending_bubble
+            self._pending_bubble = 0.0
+        if compile_event is None:
+            compile_event = self._last_compile_event
+            self._last_compile_event = False
+        tel.step_ledger.push(
+            kind, rung, slots, tokens, chunk_tokens, steps, device_s,
+            staging_s, bubble_s, kv_read, swap, spec_accepted,
+            compile_event)
 
     def _compact_slots(self) -> None:
         """Step-down helper: relocate bound sequences out of high slots
@@ -2370,6 +2442,8 @@ class InferenceEngine:
         benign: their ``allowed`` is 0, so the graph masks every read
         and write (writes land on the trash page) and their token is
         discarded (-1)."""
+        tel_on = self.telemetry.enabled
+        t_stage = time.perf_counter() if tel_on else 0.0
         if not self._stage_reuse:
             # Legacy rebuild-per-dispatch (the bubble comparison arm).
             tokens = np.zeros((rung,), np.int32)
@@ -2393,6 +2467,8 @@ class InferenceEngine:
                 rpens[i], rlasts[i] = self._penalty_arrays(seq)
                 if rpens[i] != 1.0:
                     windows[i] = self._penalty_window_row(seq)
+            if tel_on:
+                self._last_staging_s = time.perf_counter() - t_stage
             return (tokens, ctx_lens, bts, temps, top_ps, top_ks, seeds,
                     rpens, rlasts, windows)
         buf = self._stage_buffers(rung)
@@ -2423,6 +2499,8 @@ class InferenceEngine:
                 row[n:] = 0
             if buf["rpens"][i] != 1.0:
                 buf["windows"][i] = self._penalty_window_row(seq)
+        if tel_on:
+            self._last_staging_s = time.perf_counter() - t_stage
         return (buf["tokens"].copy(), buf["ctx"].copy(), buf["bts"].copy(),
                 buf["temps"].copy(), buf["top_ps"].copy(),
                 buf["top_ks"].copy(), buf["seeds"].copy(),
@@ -2518,7 +2596,8 @@ class InferenceEngine:
             jnp.asarray(top_ks), jnp.asarray(seeds), jnp.asarray(rpens),
             jnp.asarray(rlasts), jnp.asarray(windows))
         outs = np.asarray(outs)                                 # [K, B]
-        self._note_decode_exit(t0, active_seqs)
+        dt = self._note_decode_exit(t0, active_seqs)
+        kv_read = sum(s.ctx_len for s in active_seqs) * k_steps
 
         result: Dict[int, List[int]] = {}
         for seq in active_seqs:
@@ -2527,8 +2606,11 @@ class InferenceEngine:
             if got:
                 result[seq.request_id] = got
         if self.telemetry.enabled:
-            self.telemetry.tokens_per_dispatch.observe(
-                sum(len(t) for t in result.values()))
+            n_tokens = sum(len(t) for t in result.values())
+            self.telemetry.tokens_per_dispatch.observe(n_tokens)
+            self._ledger_push("decode", rung=b, slots=len(active_seqs),
+                              tokens=n_tokens, steps=k_steps,
+                              device_s=dt, kv_read=kv_read)
         return result
 
     # ------------------------------------------------------------------
@@ -2582,15 +2664,27 @@ class InferenceEngine:
         self._last_decode_end = None   # prefill breaks the decode streak
         self.kv, p_tok, _ = self._prefill_jit(
             self.params, self.kv, *self._chunk_device_args(chunk))
+        call = {"outs": None, "final": None, "final_window": None,
+                "allowed": {}, "seqs": {}, "rung": 0,
+                "prefill": {"seq": chunk["seq"], "prompt": chunk["prompt"],
+                            "final": chunk["final"], "tok": p_tok}}
         if self.telemetry.enabled:
             dt = time.perf_counter() - t0
             self.telemetry.prefill_dispatch_s.observe(dt)
             self.telemetry.prefill_dispatches.inc()
             chunk["seq"].dispatch_wall_s += dt
-        return {"outs": None, "final": None, "final_window": None,
-                "allowed": {}, "seqs": {}, "rung": 0,
-                "prefill": {"seq": chunk["seq"], "prompt": chunk["prompt"],
-                            "final": chunk["final"], "tok": p_tok}}
+            c = chunk["chunk_tokens"]
+            off = int(chunk["prefix_len"][0])
+            call["ledger"] = {
+                "kind": "prefill_chunk", "rung": 0, "slots": 1,
+                "tokens": 1 if chunk["final"] else 0,
+                "chunk_tokens": c, "steps": 1, "dispatch_s": dt,
+                "staging_s": 0.0, "bubble_s": 0.0,
+                "kv_read": c * off + c * (c + 1) // 2,
+                "compile": chunk["bucket"]
+                not in self._prefill_buckets_seen}
+            self._prefill_buckets_seen.add(chunk["bucket"])
+        return call
 
     def _stage_decode_call(self, prefill_seq: Optional[Sequence] = None):
         """Stage one fused-decode dispatch from current host state plus
@@ -2718,7 +2812,7 @@ class InferenceEngine:
         # Non-blocking dispatch: the wall recorded here is host dispatch
         # overhead; the device wait surfaces in decode_sync_s at
         # _sync_oldest.
-        self._note_decode_exit(t0, staged)
+        dispatch_dt = self._note_decode_exit(t0, staged)
         if chunk is not None and self.telemetry.enabled:
             dt = time.perf_counter() - t0
             self.telemetry.hybrid_dispatch_s.observe(dt)
@@ -2730,6 +2824,37 @@ class InferenceEngine:
         if chunk is not None:
             call["prefill"] = {"seq": chunk["seq"], "prompt": chunk["prompt"],
                                "final": chunk["final"], "tok": p_tok}
+        if self.telemetry.enabled:
+            # Step-ledger metadata captured at STAGE time (the scratch
+            # micros belong to this dispatch); the record is pushed at
+            # sync with device_s = dispatch + sync wall and the folded
+            # token count.
+            kv_read = sum(int(ctx_lens[s.slot]) * allowed_by_slot[s.slot]
+                          for s in staged)
+            compile_ev = self._last_compile_event
+            self._last_compile_event = False
+            if chunk is not None:
+                c = chunk["chunk_tokens"]
+                off = int(chunk["prefix_len"][0])
+                kv_read += c * off + c * (c + 1) // 2
+                hkey = ("hybrid", chunk["bucket"])
+                compile_ev = compile_ev or (
+                    hkey not in self._prefill_buckets_seen)
+                self._prefill_buckets_seen.add(hkey)
+            call["ledger"] = {
+                "kind": "decode" if chunk is None else "hybrid",
+                "rung": b, "slots": len(staged),
+                # the final chunk's sampled first token folds at sync
+                "tokens": 1 if chunk is not None and chunk["final"]
+                else 0,
+                "chunk_tokens": 0 if chunk is None
+                else chunk["chunk_tokens"],
+                "steps": k_steps, "dispatch_s": dispatch_dt,
+                "staging_s": self._last_staging_s,
+                "bubble_s": self._pending_bubble,
+                "kv_read": kv_read, "compile": compile_ev}
+            self._last_staging_s = 0.0
+            self._pending_bubble = 0.0
         return call
 
     def _sync_oldest(self) -> Dict[int, List[int]]:
@@ -2751,8 +2876,9 @@ class InferenceEngine:
             outs = None
             if pf is not None:
                 jax.block_until_ready(pf["tok"])
+        sync_dt = time.perf_counter() - t0
         if self.telemetry.enabled:
-            dt = time.perf_counter() - t0
+            dt = sync_dt
             if outs is not None:
                 self.telemetry.decode_sync_s.observe(dt)
             if pf is not None:
@@ -2797,9 +2923,23 @@ class InferenceEngine:
                 self._prefill_finish(seq, pf["prompt"],
                                      int(np.asarray(pf["tok"])[0]))
                 seq.prefill_prompt = None
+        n_tokens = sum(len(t) for t in result.values())
         if self.telemetry.enabled and outs is not None:
-            self.telemetry.tokens_per_dispatch.observe(
-                sum(len(t) for t in result.values()))
+            self.telemetry.tokens_per_dispatch.observe(n_tokens)
+        led = call.get("ledger")
+        if led is not None and self.telemetry.enabled:
+            # Pipelined record lands at SYNC with the true device wall
+            # (non-blocking dispatch + the blocking sync) and the folded
+            # token count; the stage-time micros rode along in ``led``.
+            tokens = (led["tokens"] if led["kind"] == "prefill_chunk"
+                      else n_tokens + led["tokens"])
+            self._ledger_push(
+                led["kind"], rung=led["rung"], slots=led["slots"],
+                tokens=tokens, chunk_tokens=led["chunk_tokens"],
+                steps=led["steps"],
+                device_s=led["dispatch_s"] + sync_dt,
+                kv_read=led["kv_read"], staging_s=led["staging_s"],
+                bubble_s=led["bubble_s"], compile_event=led["compile"])
         return result
 
     def _pressure_settle_round(self) -> Dict[int, List[int]]:
@@ -2990,6 +3130,8 @@ class InferenceEngine:
         tokens_dev = jnp.asarray(tokens)
         window_dev = jnp.asarray(windows)
         outs_all = []
+        kv_read = sum(s.ctx_len for s in active_seqs) * total
+        dispatch_wall = 0.0
         for c in range(n_calls):
             t0 = self._note_decode_entry(active_seqs)
             self.kv, outs, tokens_dev, window_dev = self._decode_multi_jit(
@@ -2998,12 +3140,12 @@ class InferenceEngine:
                 allowed_d, no_eos, self._next_key(), temps_d, top_ps_d,
                 top_ks_d, seeds_d, rpens_d, rlasts_d, window_dev)
             outs_all.append(outs)
-            self._note_decode_exit(t0, active_seqs)
+            dispatch_wall += self._note_decode_exit(t0, active_seqs)
         t_sync = time.perf_counter()
         jax.block_until_ready(tokens_dev)
+        sync_dt = time.perf_counter() - t_sync
         if self.telemetry.enabled:
-            self.telemetry.decode_sync_s.observe(
-                time.perf_counter() - t_sync)
+            self.telemetry.decode_sync_s.observe(sync_dt)
         # Device wait, not host bubble (same rationale as _sync_oldest).
         self._last_decode_end = time.perf_counter()
 
@@ -3020,6 +3162,14 @@ class InferenceEngine:
                 result[seq.request_id].extend(got)
         for seq in active_seqs:
             self._maybe_finish(seq, seq.last_token)
+        if self.telemetry.enabled:
+            # One record for the whole chained run (the mode's unit of
+            # dispatch from the host's point of view: one sync).
+            self._ledger_push(
+                "decode", rung=b, slots=len(active_seqs),
+                tokens=sum(len(t) for t in result.values()),
+                steps=total, device_s=dispatch_wall + sync_dt,
+                kv_read=kv_read)
         return result
 
     def _spec_grant(self, active_seqs: List[Sequence], s_len: int,
@@ -3112,7 +3262,11 @@ class InferenceEngine:
         self.kv, self.draft_kv = out.kv, out.draft_kv
         emitted = np.asarray(out.emitted)                   # [B, gamma+1]
         n_acc = np.asarray(out.n_accepted)
-        self._note_decode_exit(t0, active_seqs)
+        dt = self._note_decode_exit(t0, active_seqs)
+        # Pre-fold context: the verify forward read the cache at the ctx
+        # the lanes ENTERED the round with.
+        kv_read = sum(s.ctx_len for s in active_seqs) * s_len
+        acc0 = self.spec_accepted
 
         result: Dict[int, List[int]] = {}
         for seq in active_seqs:
@@ -3145,8 +3299,12 @@ class InferenceEngine:
             if got:
                 result[seq.request_id] = got
         if self.telemetry.enabled:
-            self.telemetry.tokens_per_dispatch.observe(
-                sum(len(t) for t in result.values()))
+            n_toks = sum(len(t) for t in result.values())
+            self.telemetry.tokens_per_dispatch.observe(n_toks)
+            self._ledger_push(
+                "spec_verify", rung=b, slots=len(active_seqs),
+                tokens=n_toks, device_s=dt, kv_read=kv_read,
+                spec_accepted=self.spec_accepted - acc0)
         return result
 
     # ------------------------------------------------------------------
@@ -3324,7 +3482,12 @@ class InferenceEngine:
             jnp.asarray(top_ks), jnp.asarray(rpens), jnp.asarray(rlasts),
             jnp.asarray(windows))
         self.kv = out.kv
-        self._note_decode_exit(t0, active_seqs)
+        # Stash the (non-blocking) dispatch wall and the cache-read
+        # estimate for whichever caller pushes this round's ledger
+        # record (sync path: after the fold; pipelined: at sync).
+        self._last_verify_dt = self._note_decode_exit(t0, active_seqs)
+        self._last_verify_kv_read = (
+            sum(s.ctx_len for s in active_seqs) * s_len)
         self.spec_rounds_total += 1
         if self.telemetry.enabled:
             full = ecfg.num_speculative_tokens
@@ -3406,11 +3569,20 @@ class InferenceEngine:
                                                      max_steps)
         if not active_seqs:
             return {}
-        out, prop_by_slot, _ = self._dispatch_verify(active_seqs,
-                                                     proposals, s_len)
-        return self._fold_spec_emissions(
+        out, prop_by_slot, rung = self._dispatch_verify(active_seqs,
+                                                        proposals, s_len)
+        acc0 = self.spec_accepted
+        result = self._fold_spec_emissions(
             {s.slot: s for s in active_seqs}, emit_by_slot, prop_by_slot,
             np.asarray(out.emitted), np.asarray(out.n_accepted))
+        if self.telemetry.enabled:
+            self._ledger_push(
+                "spec_verify", rung=rung, slots=len(active_seqs),
+                tokens=sum(len(t) for t in result.values()),
+                device_s=self._last_verify_dt,
+                kv_read=self._last_verify_kv_read,
+                spec_accepted=self.spec_accepted - acc0)
+        return result
 
     def _stage_ngram_call(self) -> Optional[dict]:
         """Stage one spec round non-blocking for the dispatch-ahead
@@ -3445,12 +3617,28 @@ class InferenceEngine:
             return None
         out, prop_by_slot, rung = self._dispatch_verify(active_seqs,
                                                         proposals, s_len)
-        return {"spec": True, "emitted": out.emitted,
+        call = {"spec": True, "emitted": out.emitted,
                 "n_accepted": out.n_accepted,
                 "allowed": dict(emit_by_slot), "n_prop": prop_by_slot,
                 "seqs": {s.slot: s for s in active_seqs},
                 "rung": rung, "outs": None, "final": None,
                 "final_window": None}
+        if self.telemetry.enabled:
+            # Stage-time micros ride on the call; the record lands at
+            # sync with the true device wall (see _sync_spec_call).
+            call["ledger"] = {
+                "kind": "spec_verify", "rung": rung,
+                "slots": len(active_seqs), "tokens": 0,
+                "chunk_tokens": 0, "steps": 1,
+                "dispatch_s": self._last_verify_dt,
+                "staging_s": self._last_staging_s,
+                "bubble_s": self._pending_bubble,
+                "kv_read": self._last_verify_kv_read,
+                "compile": self._last_compile_event}
+            self._last_staging_s = 0.0
+            self._pending_bubble = 0.0
+            self._last_compile_event = False
+        return call
 
     def _sync_spec_call(self, call: dict) -> Dict[int, List[int]]:
         """Block on an in-flight spec round and fold its emissions
@@ -3458,8 +3646,9 @@ class InferenceEngine:
         t0 = time.perf_counter()
         emitted = np.asarray(call["emitted"])           # [B, γ+1] blocks
         n_acc = np.asarray(call["n_accepted"])
+        sync_dt = time.perf_counter() - t0
         if self.telemetry.enabled:
-            dt = time.perf_counter() - t0
+            dt = sync_dt
             self.telemetry.decode_sync_s.observe(dt)
             for seq in call["seqs"].values():
                 if not seq.done and seq.slot >= 0 \
@@ -3470,8 +3659,20 @@ class InferenceEngine:
             time.perf_counter()
             if any(s is not None and not s.done for s in self.slots)
             else None)
-        return self._fold_spec_emissions(call["seqs"], call["allowed"],
-                                         call["n_prop"], emitted, n_acc)
+        acc0 = self.spec_accepted
+        result = self._fold_spec_emissions(call["seqs"], call["allowed"],
+                                           call["n_prop"], emitted, n_acc)
+        led = call.get("ledger")
+        if led is not None and self.telemetry.enabled:
+            self._ledger_push(
+                led["kind"], rung=led["rung"], slots=led["slots"],
+                tokens=sum(len(t) for t in result.values()),
+                steps=led["steps"],
+                device_s=led["dispatch_s"] + sync_dt,
+                kv_read=led["kv_read"], staging_s=led["staging_s"],
+                bubble_s=led["bubble_s"], compile_event=led["compile"],
+                spec_accepted=self.spec_accepted - acc0)
+        return result
 
     def _ngram_steps_pipelined(self) -> Dict[int, List[int]]:
         """Dispatch-ahead serving step for ngram spec: sync the in-flight

@@ -240,6 +240,11 @@ class EngineScheduler:
 
     def _note_error(self, exc: BaseException) -> None:
         self.stats.step_failures += 1
+        flight = self.engine.telemetry.flight
+        if flight is not None:
+            # Evidence first: dump the ledger/spans/config while the
+            # failed step's records are still the newest in the ring.
+            flight.capture("step_error")
         if self.on_step_error is not None:
             self.on_step_error(exc)
 
@@ -908,6 +913,13 @@ class EngineScheduler:
     def run(self) -> None:
         engine = self.engine
         while not self._stop.is_set():
+            # Re-read each tick: the recorder may be attached after the
+            # engine thread starts (worker boot binds it post-start).
+            flight = engine.telemetry.flight
+            if flight is not None:
+                # Rolling periodic.json refresh — the capture a kill -9
+                # leaves behind (no signal handler runs for SIGKILL).
+                flight.maybe_periodic()
             # Cross-thread chaos page-pressure requests (/debug/chaos)
             # and migration imports (the worker's import-kv RPC) apply
             # HERE — the allocator and host tier are engine-thread only,

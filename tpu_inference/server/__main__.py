@@ -342,6 +342,18 @@ def main() -> None:
                         "tpu_inf_slo_breaches_total{slo=\"tpot\"}; "
                         "0 = no target")
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--step-ledger-depth", type=int, default=256,
+                   help="per-replica step-ledger ring depth (per-"
+                        "dispatch records behind GET /debug/steps and "
+                        "the flight recorder; floor 8)")
+    p.add_argument("--blackbox-dir", default="/tmp/tpu-inf-blackbox",
+                   help="crash flight-recorder root (per-replica "
+                        "capture dirs survive kill -9; '' disables). "
+                        "Operator-chosen — clients never name capture "
+                        "paths")
+    p.add_argument("--blackbox-retain", type=int, default=8,
+                   help="flight-recorder retention cap: newest N "
+                        "trigger captures kept per replica")
     p.add_argument("--debug", action="store_true",
                    help="expose the unauthenticated /debug/* endpoints "
                         "(request timelines, profiler control)")
@@ -509,7 +521,10 @@ def main() -> None:
                               failover_max_retries=args.failover_retries,
                               admission_queue_depth=args.admission_queue_depth,
                               chaos_failure_rate=args.chaos_failure_rate,
-                              chaos_delay_s=args.chaos_delay_s),
+                              chaos_delay_s=args.chaos_delay_s,
+                              blackbox_dir=args.blackbox_dir,
+                              blackbox_retain=args.blackbox_retain),
+                          step_ledger_depth=args.step_ledger_depth,
                           chaos_step_failure_rate=args.chaos_step_failure_rate,
                           chaos_step_wedge_s=args.chaos_step_wedge_s,
                           chaos_page_pressure=args.chaos_page_pressure,

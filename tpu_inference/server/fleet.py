@@ -211,6 +211,7 @@ class WorkerHandle:
         self.last_stats: dict = {}
         self.last_metrics: list = []
         self.last_health: dict = {}
+        self.last_steps: dict = {}
         # Monotonic-series carry from dead incarnations (telemetry.
         # fold_dump_into_carry) — the restart-survival half of the
         # stable replica label. folded_incarnation makes the fold
@@ -397,6 +398,7 @@ class ProcessEngineGroup:
 
     def _build_registry(self) -> None:
         r = self._fleet_registry
+        telemetry.register_span_ring(r, self._recorder)
         r.gauge("tpu_inf_replicas",
                 "Live replicas (autoscaler/rollout move this; retired "
                 "and quarantined workers excluded)",
@@ -734,6 +736,8 @@ class ProcessEngineGroup:
                 h.last_stats = h.client.rpc(
                     "stats", timeout=10.0)["stats"]
                 h.last_health = h.client.rpc("healthz", timeout=10.0)
+                h.last_steps = h.client.rpc(
+                    "steps", timeout=10.0)["steps"]
             except (WorkerGone, TimeoutError, RuntimeError):
                 pass
 
@@ -821,8 +825,30 @@ class ProcessEngineGroup:
         else:
             telemetry.log_event("worker_down", level="warning",
                                 replica=h.replica, reason=reason)
+            self._harvest_blackbox(h, reason)
             self._schedule_restart(h)
         self._failover_worker(h)
+
+    def _harvest_blackbox(self, h: WorkerHandle, reason: str) -> None:
+        """Post-mortem evidence sweep: the dead worker's flight-recorder
+        dir is on the router's local FS (same --blackbox-dir), so a
+        kill -9's last periodic heartbeat and any trigger captures are
+        sitting there — surface them in the log and the /debug/blackbox
+        index so the death is triaged with evidence, not guesses."""
+        root = self.server_cfg.blackbox_dir
+        if not root:
+            return
+        rdir = os.path.join(root, f"replica-{h.replica}")
+        try:
+            captures = sorted(f for f in os.listdir(rdir)
+                              if f.endswith(".json"))
+        except OSError:
+            captures = []
+        if captures:
+            telemetry.log_event(
+                "worker_blackbox_harvested", replica=h.replica,
+                reason=reason, captures=len(captures),
+                newest=captures[-1], dir=rdir)
 
     # --------------------------------------------------------- routing
 
@@ -2070,6 +2096,35 @@ class ProcessEngineGroup:
                     "dp": self.dp}
         return aggregate_replica_stats(per,
                                        self.supervision_counters())
+
+    def steps_snapshot(self) -> dict:
+        """Step-ledger roofline attribution (GET /debug/steps): live
+        per-replica reports (cache fallback for downed workers, same
+        stance as stats_snapshot) + the fleet-merged report."""
+        reports: Dict[str, dict] = {}
+        for h in self.workers:
+            d = None
+            if h.state == UP and h.client is not None:
+                try:
+                    d = h.client.rpc("steps", timeout=30.0)["steps"]
+                    h.last_steps = d
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    d = None
+            if d is None and h.last_steps:
+                d = dict(h.last_steps)
+                d["stale"] = True
+            if d is not None:
+                reports[str(h.replica)] = d
+        return {"replicas": reports,
+                "fleet": telemetry.merge_steps_reports(
+                    list(reports.values()))}
+
+    def blackbox_index(self) -> dict:
+        """Flight-recorder capture index (GET /debug/blackbox): scans
+        the operator's blackbox_dir on the router's FS — captures from
+        dead incarnations are listed exactly like live ones (the dir
+        survives kill -9; that is the point)."""
+        return telemetry.blackbox_index(self.server_cfg.blackbox_dir)
 
     def prometheus_text(self) -> str:
         groups = []

@@ -185,6 +185,9 @@ class InferenceServer:
         if self.cfg.server.enable_debug:
             app.router.add_get("/debug/requests", self.handle_debug_requests)
             app.router.add_get("/debug/trace", self.handle_debug_trace)
+            app.router.add_get("/debug/steps", self.handle_debug_steps)
+            app.router.add_get("/debug/blackbox",
+                               self.handle_debug_blackbox)
             app.router.add_post("/debug/profile", self.handle_profile)
             app.router.add_post("/debug/chaos", self.handle_chaos)
             app.router.add_post("/debug/rollout", self.handle_rollout)
@@ -413,6 +416,22 @@ class InferenceServer:
             return web.json_response([])
         return web.json_response(
             await asyncio.to_thread(self.group.recent_snapshot, n))
+
+    async def handle_debug_steps(self, request: web.Request
+                                 ) -> web.Response:
+        """Step-ledger roofline attribution (README "Performance
+        attribution"): per-replica + fleet-merged bottleneck verdicts
+        per step kind, cross-checked against tpu_inf_mfu_estimate."""
+        return web.json_response(
+            await asyncio.to_thread(self.group.steps_snapshot))
+
+    async def handle_debug_blackbox(self, request: web.Request
+                                    ) -> web.Response:
+        """Crash flight-recorder capture index: every capture under the
+        operator's --blackbox-dir, newest first — including those left
+        behind by dead (kill -9'd) worker incarnations."""
+        return web.json_response(
+            await asyncio.to_thread(self.group.blackbox_index))
 
     async def handle_debug_trace(self, request: web.Request
                                  ) -> web.Response:

@@ -248,6 +248,7 @@ class EngineGroup:
         # replica="i" labels) by prometheus_text().
         self._fleet_registry = telemetry.Registry()
         r = self._fleet_registry
+        telemetry.register_span_ring(r, self._recorder)
         r.gauge("tpu_inf_replicas", "Configured dp replicas",
                 fn=lambda: len(self.engines))
         r.counter("tpu_inf_retries_attempted_total",
@@ -311,6 +312,18 @@ class EngineGroup:
         for e in self.engines:
             if e.telemetry.enabled:
                 telemetry.emit_build_info(e.telemetry.registry, **kw)
+        # Crash flight recorders (one per replica) when the operator
+        # configured --blackbox-dir; direct-constructed test groups
+        # leave it '' and do no disk I/O.
+        if self.server_cfg.blackbox_dir:
+            import dataclasses as _dc
+            for i, (e, s) in enumerate(zip(self.engines,
+                                           self.schedulers)):
+                telemetry.attach_flight_recorder(
+                    e.telemetry, self.server_cfg.blackbox_dir, i,
+                    retain=self.server_cfg.blackbox_retain,
+                    config=_dc.asdict(self.server_cfg),
+                    stats_fn=lambda s=s, e=e: s.stats.snapshot(e))
 
     def _pooled_slo_quantile(self, which: str, q: float) -> float:
         windows = []
@@ -375,6 +388,11 @@ class EngineGroup:
             for sched, health in zip(self.schedulers, self.health):
                 if self._wedged(sched):
                     if health.mark_wedged():
+                        flight = sched.engine.telemetry.flight
+                        if flight is not None:
+                            # The wedged dispatch's records are still
+                            # the newest in the ring — dump them now.
+                            flight.capture("watchdog")
                         self._failover_stranded(sched)
                 else:
                     health.maybe_recover()
@@ -884,6 +902,21 @@ class EngineGroup:
         for d, h in zip(per, self.health):
             d["health"] = h.snapshot()
         return aggregate_replica_stats(per, self.supervision_counters())
+
+    def steps_snapshot(self) -> dict:
+        """Step-ledger roofline attribution (GET /debug/steps):
+        per-replica bottleneck verdicts + the fleet-merged report."""
+        reports = {str(i): e.telemetry.steps_report()
+                   for i, e in enumerate(self.engines)}
+        return {"replicas": reports,
+                "fleet": telemetry.merge_steps_reports(
+                    list(reports.values()))}
+
+    def blackbox_index(self) -> dict:
+        """Flight-recorder capture index (GET /debug/blackbox) — scans
+        the operator's blackbox_dir; every replica is in-process here,
+        so there is nothing to harvest, only to list."""
+        return telemetry.blackbox_index(self.server_cfg.blackbox_dir)
 
     def apply_chaos(self, body: dict) -> dict:
         """Arm/disarm engine-level fault injection (POST /debug/chaos):

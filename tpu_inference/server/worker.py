@@ -233,6 +233,15 @@ class EngineWorker:
             routing=cfg.server.routing)
         if self.role == "prefill":
             self.sched.on_prefill_handoff = self._emit_handoff
+        # Crash flight recorder: per-replica dir under the OPERATOR's
+        # --blackbox-dir ('' = off). The dir outlives this process, so
+        # the fleet monitor can harvest evidence after a kill -9.
+        import dataclasses as _dc
+        _tm.attach_flight_recorder(
+            self.engine.telemetry, cfg.server.blackbox_dir, self.replica,
+            retain=cfg.server.blackbox_retain,
+            config=_dc.asdict(cfg),
+            stats_fn=lambda: self.sched.stats.snapshot(self.engine))
         if self.do_warmup:
             self.warmup_s = self.engine.warmup()
         self.sched.start()
@@ -502,6 +511,11 @@ class EngineWorker:
     def _verb_stats(self, conn, obj, blob) -> dict:
         return {"stats": self.sched.stats.snapshot(self.engine)}
 
+    def _verb_steps(self, conn, obj, blob) -> dict:
+        """Step-ledger roofline report (GET /debug/steps): windowed
+        per-step-kind bottleneck verdicts from this replica's ring."""
+        return {"steps": self.engine.telemetry.steps_report()}
+
     def _verb_metrics(self, conn, obj, blob) -> dict:
         from tpu_inference import telemetry
         return {"samples": telemetry.dump_registry(
@@ -689,6 +703,10 @@ class EngineWorker:
         telemetry.log_event("worker_drain", level="warning",
                             replica=self.replica, migrate=migrate,
                             load=sched.load)
+        if engine.telemetry.flight is not None:
+            # Last full capture before state is torn down (the atexit
+            # hook won't run — drain ends in os._exit).
+            engine.telemetry.flight.capture("sigterm", min_interval_s=0.0)
         sched.stop(drain=False, timeout=budget)
         try:
             if engine.pipeline_pending:

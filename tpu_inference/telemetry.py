@@ -384,6 +384,18 @@ def _fmt_labels(labels: Mapping[str, str],
     return "{" + inner + "}"
 
 
+# Self-metrics (README "Performance attribution"): the telemetry path
+# observes its own exposition cost, so observability overhead is itself
+# observable. One module-level registry per process; rendered as an
+# extra unlabeled group on every scrape (the render that is being timed
+# exposes the PREVIOUS renders' histogram — exact-once semantics are
+# not worth a second pass).
+_SELF_REGISTRY = Registry()
+_RENDER_SECONDS = _SELF_REGISTRY.histogram(
+    "tpu_inf_metrics_render_seconds",
+    "Host wall of one Prometheus text exposition render")
+
+
 def render_prometheus(groups: Iterable[Tuple[Mapping[str, str], Registry]]
                       ) -> str:
     """Render label-tagged registries as one Prometheus text page.
@@ -393,6 +405,10 @@ def render_prometheus(groups: Iterable[Tuple[Mapping[str, str], Registry]]
     HELP/TYPE are emitted once per metric name (first definition wins);
     all samples of a name stay contiguous, as the format requires.
     """
+    t_render = time.perf_counter()
+    groups = list(groups)
+    if telemetry_enabled():
+        groups.append(({}, _SELF_REGISTRY))
     # name -> (kind, help, [(merged labels, metric)])
     families: Dict[str, Tuple[str, str, List[Tuple[Dict[str, str], Any]]]] = {}
     order: List[str] = []
@@ -423,7 +439,9 @@ def render_prometheus(groups: Iterable[Tuple[Mapping[str, str], Registry]]
             else:
                 ls = _fmt_labels(m.labels, shared)
                 lines.append(f"{name}{ls} {_fmt_value(m.collect_value())}")
-    return "\n".join(lines) + "\n"
+    out = "\n".join(lines) + "\n"
+    _RENDER_SECONDS.observe(time.perf_counter() - t_render)
+    return out
 
 
 # Content type the text page must be served under (version matters:
@@ -591,6 +609,7 @@ class SpanRecorder:
         self._maintenance: collections.deque = collections.deque(maxlen=128)
         self._lock = threading.Lock()
         self.spans_dropped = 0
+        self.traces_evicted = 0
 
     def to_unix(self, t_mono: float) -> float:
         return self._anchor_unix + (t_mono - self._anchor_mono)
@@ -619,6 +638,7 @@ class SpanRecorder:
             if spans is None:
                 while len(self._open) >= self.MAX_TRACES:
                     self._open.popitem(last=False)
+                    self.traces_evicted += 1
                 spans = self._open[trace_id] = []
             if len(spans) >= self.MAX_SPANS_PER_TRACE:
                 self.spans_dropped += 1
@@ -651,6 +671,7 @@ class SpanRecorder:
             if dest is None:
                 while len(self._open) >= self.MAX_TRACES:
                     self._open.popitem(last=False)
+                    self.traces_evicted += 1
                 dest = self._open[trace_id] = []
             room = self.MAX_SPANS_PER_TRACE - len(dest)
             if room < len(spans):
@@ -671,6 +692,7 @@ class SpanRecorder:
                 spans = prior + spans
             while len(self._recent) >= self.MAX_TRACES:
                 self._recent.popitem(last=False)
+                self.traces_evicted += 1
             self._recent[trace_id] = spans
 
     def get_trace(self, trace_id: str) -> Optional[List[dict]]:
@@ -701,6 +723,40 @@ class SpanRecorder:
 
     def maintenance_spans(self, n: int = 128) -> List[dict]:
         return list(self._maintenance)[-n:]
+
+
+# The full span-name vocabulary any recorder in the repo can emit.
+# tests/test_metric_catalog.py gates this against both the code's
+# add()/add_maintenance() literals and the README span table, so a new
+# span cannot ship undocumented (and a doc row cannot outlive its span).
+SPAN_NAMES = (
+    "request", "route", "queue_wait", "prefill", "prefill_chunk",
+    "decode", "handoff", "handoff_adopt", "handoff_export",
+    "drain_export", "migrate",
+    "kv_swap_in", "kv_swap_out", "rollout", "scale_up", "scale_down",
+)
+
+
+def register_span_ring(registry: Registry, recorder: SpanRecorder) -> None:
+    """Span-ring self-metrics (README "Performance attribution"):
+    occupancy gauges + drop/eviction counters over one SpanRecorder, so
+    trace loss under ring pressure is visible on /metrics instead of
+    silently truncating /debug/trace. Shared by the engine bundle (its
+    replica recorder) and both fleet backends (the router recorder)."""
+    registry.gauge("tpu_inf_trace_ring_traces",
+                   "Sealed request traces resident in the recent ring",
+                   fn=lambda: float(len(recorder._recent)))
+    registry.gauge("tpu_inf_trace_ring_open",
+                   "Unsealed (in-flight or abandoned) traces in the "
+                   "open table",
+                   fn=lambda: float(len(recorder._open)))
+    registry.counter("tpu_inf_trace_spans_dropped_total",
+                     "Spans dropped by the per-trace span cap",
+                     fn=lambda: recorder.spans_dropped)
+    registry.counter("tpu_inf_trace_evictions_total",
+                     "Whole traces evicted from the rings by the "
+                     "trace-count cap",
+                     fn=lambda: recorder.traces_evicted)
 
 
 def assemble_trace(trace_id: str, spans: Sequence[dict]) -> dict:
@@ -1033,6 +1089,605 @@ def emit_build_info(registry: Registry, *, backend: str = "",
 
 
 # ---------------------------------------------------------------------------
+# Step ledger + roofline attribution (README "Performance attribution").
+#
+# The phase histograms say how LONG dispatches take; the step ledger
+# says WHY. Every engine dispatch pushes one fixed-shape record into an
+# allocation-light ring; an analytic cost model (FLOPs from the
+# architecture config, HBM bytes from weight bytes per device iteration
+# + KV pages touched at the active kv_quant) converts each record into
+# achieved FLOP/s and bytes/s, and windowed aggregation yields one
+# bottleneck verdict per step kind: compute-bound, HBM-bound, or
+# host-bound (staging + bubble dominate the dispatch wall).
+# ---------------------------------------------------------------------------
+
+STEP_KINDS = ("prefill_chunk", "decode", "hybrid", "spec_verify")
+
+# Record layout (one tuple per dispatch; field order is the wire shape
+# the flight recorder and /debug/steps serialize):
+STEP_FIELDS = (
+    "ts",             # unix seconds the record was pushed (≈ sync time)
+    "kind",           # one of STEP_KINDS
+    "rung",           # compiled batch-ladder rung dispatched (0=prefill)
+    "slots",          # decode lanes occupied in the dispatch
+    "tokens",         # tokens GENERATED (the MFU gauge's unit)
+    "chunk_tokens",   # prompt tokens processed (prefill/hybrid chunk)
+    "steps",          # device loop iterations (fused-K; weights stream
+                      # from HBM once per iteration)
+    "device_s",       # device wall (dispatch + sync for pipelined calls)
+    "staging_s",      # host batch-staging wall (_stage_batch micro)
+    "bubble_s",       # host gap before the dispatch (device-idle
+                      # exposure while lanes were active)
+    "kv_read_tokens",  # Σ (query position, context token) pairs attended
+    "kv_swap_bytes",  # host<->device KV tier traffic since last record
+    "spec_accepted",  # speculative positions accepted (spec_verify)
+    "compile_event",  # 1 = first dispatch of this rung/bucket (compile)
+)
+
+
+class StepLedger:
+    """Fixed-depth ring of per-dispatch step records.
+
+    ``push`` is the hot-path write: one tuple build + one list store +
+    one int add (GIL-atomic, same stance as the metric primitives); no
+    locks, no allocation growth. Readers copy the ring first, so a
+    concurrent push can at worst duplicate-or-miss the newest record,
+    never tear one."""
+
+    __slots__ = ("depth", "_ring", "_n")
+
+    def __init__(self, depth: int = 256):
+        self.depth = max(8, int(depth))
+        self._ring: List[Optional[tuple]] = [None] * self.depth
+        self._n = 0
+
+    def push(self, kind: str, rung: int, slots: int, tokens: int,
+             chunk_tokens: int, steps: int, device_s: float,
+             staging_s: float, bubble_s: float, kv_read_tokens: int,
+             kv_swap_bytes: float, spec_accepted: int,
+             compile_event: bool) -> None:
+        self._ring[self._n % self.depth] = (
+            time.time(), kind, int(rung), int(slots), int(tokens),
+            int(chunk_tokens), int(steps), float(device_s),
+            float(staging_s), float(bubble_s), int(kv_read_tokens),
+            float(kv_swap_bytes), int(spec_accepted),
+            1 if compile_event else 0)
+        self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def overflowed(self) -> bool:
+        return self._n > self.depth
+
+    def records(self) -> List[tuple]:
+        """Resident records, oldest first (point-in-time ring copy)."""
+        ring, n = list(self._ring), self._n
+        if n <= self.depth:
+            return [r for r in ring[:n] if r is not None]
+        i = n % self.depth
+        return [r for r in ring[i:] + ring[:i] if r is not None]
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """JSON-able dump (flight-recorder payload)."""
+        return [dict(zip(STEP_FIELDS, r)) for r in self.records()]
+
+
+class _NullLedger:
+    """No-op ledger when telemetry is disabled (shared singleton, the
+    NULL_METRIC stance): push is one attribute lookup + empty call."""
+
+    __slots__ = ()
+    depth = 0
+    count = 0
+    overflowed = False
+
+    def push(self, *a, **k) -> None:
+        pass
+
+    def records(self) -> List[tuple]:
+        return []
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        return []
+
+
+NULL_LEDGER = _NullLedger()
+
+
+class StepCostModel:
+    """Analytic per-record FLOPs + HBM bytes from the architecture
+    config — no device counters needed, so the same model grades CPU
+    smoke runs and real-TPU campaigns.
+
+    - matmul FLOPs: 2 x params per token position processed (generated
+      tokens + prompt chunk tokens).
+    - attention FLOPs: 4 x n_heads x head_dim per layer per (query
+      position, context token) pair (QK^T + AV, 2 multiply-adds each).
+    - HBM bytes: resident weight bytes once per device loop iteration
+      (fused-K decode streams the weights K times) + KV bytes for every
+      context token attended (at the active kv_quant's per-token
+      footprint) + KV bytes written for new positions + host<->device
+      swap traffic.
+    """
+
+    __slots__ = ("n_params", "n_layers", "n_heads", "head_dim",
+                 "weight_bytes", "kv_token_bytes", "peak_flops",
+                 "peak_hbm_bw")
+
+    def __init__(self, *, n_params: int, n_layers: int, n_heads: int,
+                 head_dim: int, weight_bytes: int, kv_token_bytes: int,
+                 peak_flops: float, peak_hbm_bw: float):
+        self.n_params = int(n_params)
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.weight_bytes = int(weight_bytes)
+        self.kv_token_bytes = int(kv_token_bytes)
+        self.peak_flops = float(peak_flops)
+        self.peak_hbm_bw = float(peak_hbm_bw)
+
+    @classmethod
+    def from_engine(cls, engine) -> "StepCostModel":
+        from tpu_inference.engine import autosize
+        mcfg, ecfg = engine.model_cfg, engine.engine_cfg
+        return cls(n_params=engine.n_params, n_layers=mcfg.n_layers,
+                   n_heads=mcfg.n_heads, head_dim=mcfg.head_dim,
+                   weight_bytes=autosize.weight_bytes(mcfg, ecfg.quant),
+                   kv_token_bytes=autosize.kv_bytes_per_token(
+                       mcfg, ecfg.kv_quant),
+                   peak_flops=autosize.detect_peak_flops(),
+                   peak_hbm_bw=autosize.detect_peak_hbm_bw())
+
+    def flops(self, rec: tuple) -> float:
+        positions = rec[4] + rec[5]          # tokens + chunk_tokens
+        return (2.0 * self.n_params * positions
+                + 4.0 * self.n_layers * self.n_heads * self.head_dim
+                * rec[10])                   # kv_read_tokens
+
+    def hbm_bytes(self, rec: tuple) -> float:
+        positions = rec[4] + rec[5]
+        return (float(self.weight_bytes) * max(1, rec[6])   # steps
+                + float(self.kv_token_bytes) * (rec[10] + positions)
+                + rec[11])                   # kv_swap_bytes
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+def _finalize_kind(agg: Dict[str, Any], peak_flops: float,
+                   peak_hbm_bw: float) -> Dict[str, Any]:
+    """Derive achieved rates, roofline fractions, and the bottleneck
+    verdict from one kind's raw sums — shared by the per-replica report
+    and the fleet merge so the two can never disagree on semantics."""
+    device_s = agg["device_s"]
+    host_s = agg["staging_s"] + agg["bubble_s"]
+    out = dict(agg)
+    out["host_s"] = round(host_s, 6)
+    if device_s > 0:
+        out["achieved_flops_per_s"] = round(agg["flops"] / device_s, 3)
+        out["achieved_bytes_per_s"] = round(agg["hbm_bytes"] / device_s, 3)
+    else:
+        out["achieved_flops_per_s"] = 0.0
+        out["achieved_bytes_per_s"] = 0.0
+    compute_frac = out["achieved_flops_per_s"] / max(peak_flops, 1.0)
+    hbm_frac = out["achieved_bytes_per_s"] / max(peak_hbm_bw, 1.0)
+    host_frac = host_s / max(host_s + device_s, 1e-12)
+    out["compute_frac"] = round(compute_frac, 6)
+    out["hbm_frac"] = round(hbm_frac, 6)
+    out["host_frac"] = round(host_frac, 6)
+    if host_frac > 0.5:
+        out["verdict"] = "host-bound"
+    elif compute_frac >= hbm_frac:
+        out["verdict"] = "compute-bound"
+    else:
+        out["verdict"] = "hbm-bound"
+    for k in ("device_s", "staging_s", "bubble_s", "flops", "hbm_bytes",
+              "kv_swap_bytes"):
+        out[k] = round(out[k], 6)
+    return out
+
+
+def _ledger_mfu_ewma(recs: Sequence[tuple], n_params: int,
+                     peak_flops: float, bind_unix: Optional[float],
+                     now: float, tau_s: float = 30.0) -> Optional[float]:
+    """Replay the MFU gauge's dt-weighted EWMA (telemetry bind_scheduler:
+    alpha = 1 - exp(-dt/tau), tau ≈ 30 s) over the ledger's (ts, tokens)
+    events, from the gauge's bind time — the apples-to-apples value the
+    /debug/steps cross-check compares against ``tpu_inf_mfu_estimate``.
+    A plain window-average would NOT agree with the gauge over short
+    windows; the EWMA replay does, up to ring truncation (flagged by the
+    caller via ``truncated``)."""
+    import math
+
+    if not recs:
+        return None
+    rate = 0.0
+    t = bind_unix if bind_unix is not None else recs[0][0]
+    for r in recs:
+        ts, tokens = r[0], r[4]
+        dt = max(1e-6, ts - t)
+        inst = tokens / dt
+        rate += (1.0 - math.exp(-dt / tau_s)) * (inst - rate)
+        t = ts
+    dt = now - t
+    if dt > 1e-3:
+        rate *= math.exp(-dt / tau_s)   # zero-rate tail, gauge-identical
+    return rate * 2.0 * n_params / max(peak_flops, 1.0)
+
+
+def roofline_report(ledger, model: StepCostModel, *,
+                    mfu_gauge: Optional[float] = None,
+                    bind_unix: Optional[float] = None,
+                    window_s: float = 60.0,
+                    now: Optional[float] = None) -> Dict[str, Any]:
+    """One replica's step-attribution report: per-kind roofline sums +
+    bottleneck verdicts over the trailing window, per-rung occupancy,
+    the top time sinks, and the ledger-replayed MFU cross-check."""
+    now = time.time() if now is None else now
+    recs = ledger.records()
+    cutoff = now - window_s
+    window = [r for r in recs if r[0] >= cutoff]
+    kinds: Dict[str, Dict[str, Any]] = {}
+    rungs: Dict[str, Dict[str, float]] = {}
+    for r in window:
+        agg = kinds.get(r[1])
+        if agg is None:
+            agg = kinds[r[1]] = {
+                "records": 0, "tokens": 0, "chunk_tokens": 0,
+                "device_s": 0.0, "staging_s": 0.0, "bubble_s": 0.0,
+                "flops": 0.0, "hbm_bytes": 0.0, "kv_swap_bytes": 0.0,
+                "kv_read_tokens": 0, "spec_accepted": 0,
+                "compile_events": 0}
+        agg["records"] += 1
+        agg["tokens"] += r[4]
+        agg["chunk_tokens"] += r[5]
+        agg["device_s"] += r[7]
+        agg["staging_s"] += r[8]
+        agg["bubble_s"] += r[9]
+        agg["kv_read_tokens"] += r[10]
+        agg["kv_swap_bytes"] += r[11]
+        agg["spec_accepted"] += r[12]
+        agg["compile_events"] += r[13]
+        agg["flops"] += model.flops(r)
+        agg["hbm_bytes"] += model.hbm_bytes(r)
+        if r[1] != "prefill_chunk":
+            ra = rungs.setdefault(str(r[2]), {"dispatches": 0,
+                                              "slots_sum": 0})
+            ra["dispatches"] += 1
+            ra["slots_sum"] += r[3]
+    kinds = {k: _finalize_kind(v, model.peak_flops, model.peak_hbm_bw)
+             for k, v in kinds.items()}
+    occupancy = {rung: {"dispatches": ra["dispatches"],
+                        "mean_slots": round(ra["slots_sum"]
+                                            / max(ra["dispatches"], 1), 2)}
+                 for rung, ra in rungs.items()}
+    sinks = sorted(
+        ({"sink": f"{k}.{comp}", "seconds": v[f"{comp}_s"]}
+         for k, v in kinds.items() for comp in ("device", "staging",
+                                                "bubble")
+         if v[f"{comp}_s"] > 0),
+        key=lambda s: -s["seconds"])[:3]
+    ledger_mfu = _ledger_mfu_ewma(recs, model.n_params, model.peak_flops,
+                                  bind_unix, now)
+    mfu = {"gauge": mfu_gauge,
+           "ledger": None if ledger_mfu is None else round(ledger_mfu, 12)}
+    if mfu_gauge and ledger_mfu is not None and mfu_gauge > 0:
+        mfu["agreement"] = round(ledger_mfu / mfu_gauge, 4)
+    else:
+        mfu["agreement"] = None
+    return {
+        "enabled": True,
+        "ts": round(now, 3),
+        "window_s": window_s,
+        "records_window": len(window),
+        "records_total": ledger.count,
+        "ledger_depth": ledger.depth,
+        "truncated": bool(ledger.overflowed),
+        "peaks": {"flops_per_s": model.peak_flops,
+                  "hbm_bytes_per_s": model.peak_hbm_bw},
+        "kinds": kinds,
+        "rung_occupancy": occupancy,
+        "top_sinks": sinks,
+        "compile_events": sum(r[13] for r in window),
+        "mfu": mfu,
+    }
+
+
+# Raw per-kind sums merge_steps_reports re-accumulates before
+# re-deriving the verdict fields (which do not sum).
+_KIND_SUM_FIELDS = ("records", "tokens", "chunk_tokens", "device_s",
+                    "staging_s", "bubble_s", "flops", "hbm_bytes",
+                    "kv_swap_bytes", "kv_read_tokens", "spec_accepted",
+                    "compile_events")
+
+
+def merge_steps_reports(reports: Sequence[Optional[Dict[str, Any]]]
+                        ) -> Dict[str, Any]:
+    """Fleet-merged step attribution from per-replica reports: per-kind
+    raw sums re-finalized (verdicts recomputed over the pooled window —
+    fractions and verdicts do not average), occupancy pooled, MFU gauge
+    and ledger replay averaged across replicas (MFU is a per-chip
+    utilization; the fleet runs dp chips)."""
+    reports = [r for r in reports if r and r.get("enabled")]
+    if not reports:
+        return {"enabled": False}
+    peaks = reports[0].get("peaks") or {}
+    peak_flops = peaks.get("flops_per_s") or 1.0
+    peak_bw = peaks.get("hbm_bytes_per_s") or 1.0
+    kinds: Dict[str, Dict[str, Any]] = {}
+    rungs: Dict[str, Dict[str, float]] = {}
+    for rep in reports:
+        for k, v in (rep.get("kinds") or {}).items():
+            agg = kinds.setdefault(k, {f: 0 for f in _KIND_SUM_FIELDS})
+            for f in _KIND_SUM_FIELDS:
+                agg[f] += v.get(f, 0)
+        for rung, ra in (rep.get("rung_occupancy") or {}).items():
+            dst = rungs.setdefault(rung, {"dispatches": 0,
+                                          "slots_sum": 0.0})
+            dst["dispatches"] += ra.get("dispatches", 0)
+            dst["slots_sum"] += (ra.get("mean_slots", 0)
+                                 * ra.get("dispatches", 0))
+    kinds = {k: _finalize_kind(v, peak_flops, peak_bw)
+             for k, v in kinds.items()}
+    occupancy = {rung: {"dispatches": int(ra["dispatches"]),
+                        "mean_slots": round(ra["slots_sum"]
+                                            / max(ra["dispatches"], 1), 2)}
+                 for rung, ra in rungs.items()}
+    sinks = sorted(
+        ({"sink": f"{k}.{comp}", "seconds": v[f"{comp}_s"]}
+         for k, v in kinds.items() for comp in ("device", "staging",
+                                                "bubble")
+         if v[f"{comp}_s"] > 0),
+        key=lambda s: -s["seconds"])[:3]
+    gauges = [r["mfu"].get("gauge") for r in reports
+              if (r.get("mfu") or {}).get("gauge") is not None]
+    ledgers = [r["mfu"].get("ledger") for r in reports
+               if (r.get("mfu") or {}).get("ledger") is not None]
+    mfu = {"gauge": round(sum(gauges) / len(gauges), 12) if gauges
+           else None,
+           "ledger": round(sum(ledgers) / len(ledgers), 12) if ledgers
+           else None}
+    if mfu["gauge"] and mfu["ledger"] is not None and mfu["gauge"] > 0:
+        mfu["agreement"] = round(mfu["ledger"] / mfu["gauge"], 4)
+    else:
+        mfu["agreement"] = None
+    return {
+        "enabled": True,
+        "replicas_merged": len(reports),
+        "window_s": max(r.get("window_s", 0) for r in reports),
+        "records_window": sum(r.get("records_window", 0)
+                              for r in reports),
+        "records_total": sum(r.get("records_total", 0) for r in reports),
+        "truncated": any(r.get("truncated") for r in reports),
+        "peaks": {"flops_per_s": peak_flops, "hbm_bytes_per_s": peak_bw},
+        "kinds": kinds,
+        "rung_occupancy": occupancy,
+        "top_sinks": sinks,
+        "compile_events": sum(r.get("compile_events", 0)
+                              for r in reports),
+        "mfu": mfu,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Crash flight recorder (README "Performance attribution"). A bounded
+# per-replica blackbox/ directory of JSON captures — last-N step
+# records + recent spans + resolved config + stats — written on watchdog
+# trip, step_error, SIGTERM, and atexit, plus a periodic heartbeat
+# capture that survives kill -9 (tmp+rename keeps every file whole).
+# The fleet monitor harvests dead workers' directories and serves the
+# index at GET /debug/blackbox. Every write path swallows exceptions:
+# the recorder must never take serving down with it.
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Per-replica crash capture sink under ``{root}/replica-{i}/``.
+
+    ``capture(trigger)`` writes ``capture-{seq:06d}-{trigger}.json``
+    atomically and prunes beyond the retention cap (oldest first);
+    ``maybe_periodic()`` refreshes a single ``periodic.json`` heartbeat
+    at most every ``periodic_interval_s`` — the evidence a kill -9
+    leaves behind. Per-trigger rate limiting stops a step_error storm
+    from churning the whole retention window."""
+
+    def __init__(self, root_dir: str, replica: int = 0, *,
+                 retain: int = 8, config: Optional[dict] = None,
+                 steps_fn: Optional[Callable[[], list]] = None,
+                 spans_fn: Optional[Callable[[], list]] = None,
+                 stats_fn: Optional[Callable[[], dict]] = None,
+                 periodic_interval_s: float = 10.0):
+        self.root = root_dir
+        self.replica = int(replica)
+        self.dir = os.path.join(root_dir, f"replica-{self.replica}")
+        self.retain = max(1, int(retain))
+        self.config = dict(config or {})
+        self.steps_fn = steps_fn
+        self.spans_fn = spans_fn
+        self.stats_fn = stats_fn
+        self.periodic_interval_s = max(0.5, float(periodic_interval_s))
+        self._last_periodic = 0.0
+        self._last_by_trigger: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._seq = 0
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            for fname in os.listdir(self.dir):
+                if fname.startswith("capture-"):
+                    try:
+                        self._seq = max(self._seq,
+                                        int(fname.split("-")[1]) + 1)
+                    except (ValueError, IndexError):
+                        pass
+            # A heartbeat left behind by a prior incarnation IS the
+            # kill -9 postmortem: archive it under a sequence number
+            # before this process's first beat overwrites it.
+            prior = os.path.join(self.dir, "periodic.json")
+            if os.path.exists(prior):
+                dest = os.path.join(
+                    self.dir, f"capture-{self._seq:06d}-postmortem.json")
+                try:
+                    with open(prior) as f:
+                        payload = json.load(f)
+                    payload["trigger"] = "postmortem"
+                    self._write(dest, payload)
+                    os.remove(prior)
+                except (OSError, ValueError):
+                    os.replace(prior, dest)
+                self._seq += 1
+        except OSError:
+            pass
+
+    def _payload(self, trigger: str) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {
+            "ts": round(time.time(), 3), "replica": self.replica,
+            "pid": os.getpid(), "trigger": trigger,
+            "config": self.config}
+        for key, fn, empty in (("steps", self.steps_fn, []),
+                               ("spans", self.spans_fn, []),
+                               ("stats", self.stats_fn, {})):
+            try:
+                payload[key] = fn() if fn is not None else empty
+            except Exception:
+                payload[key] = empty
+        return payload
+
+    def _write(self, path: str, payload: Dict[str, Any]) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def capture(self, trigger: str,
+                min_interval_s: float = 1.0) -> Optional[str]:
+        """Write one capture; returns its path (None = rate-limited or
+        failed — the recorder never raises into serving code)."""
+        try:
+            with self._lock:
+                now = time.time()
+                if (now - self._last_by_trigger.get(trigger, -1e9)
+                        < min_interval_s):
+                    return None
+                self._last_by_trigger[trigger] = now
+                seq = self._seq
+                self._seq += 1
+            path = os.path.join(self.dir,
+                                f"capture-{seq:06d}-{trigger}.json")
+            self._write(path, self._payload(trigger))
+            self._prune()
+            log_event("blackbox_capture", trigger=trigger, path=path,
+                      replica=self.replica)
+            return path
+        except Exception:
+            return None
+
+    def _prune(self) -> None:
+        caps = sorted(f for f in os.listdir(self.dir)
+                      if f.startswith("capture-") and f.endswith(".json"))
+        for fname in caps[:-self.retain]:
+            try:
+                os.unlink(os.path.join(self.dir, fname))
+            except OSError:
+                pass
+
+    def maybe_periodic(self) -> None:
+        """Cheap scheduler-loop hook: refresh the heartbeat capture at
+        most once per interval (two float compares otherwise)."""
+        now = time.time()
+        if now - self._last_periodic < self.periodic_interval_s:
+            return
+        self._last_periodic = now
+        try:
+            self._write(os.path.join(self.dir, "periodic.json"),
+                        self._payload("periodic"))
+        except Exception:
+            pass
+
+    def install_atexit(self) -> None:
+        import atexit
+        atexit.register(lambda: self.capture("atexit",
+                                             min_interval_s=0.0))
+
+
+def blackbox_index(root_dir: str) -> Dict[str, Any]:
+    """Scan a blackbox root for per-replica captures (newest first) —
+    the GET /debug/blackbox body, shared by both fleet backends. Each
+    entry carries enough to triage without downloading the capture:
+    trigger, timestamp, pid, and payload section sizes."""
+    out: Dict[str, Any] = {"dir": root_dir, "captures": []}
+    if not root_dir or not os.path.isdir(root_dir):
+        return out
+    for sub in sorted(os.listdir(root_dir)):
+        rdir = os.path.join(root_dir, sub)
+        if not (sub.startswith("replica-") and os.path.isdir(rdir)):
+            continue
+        try:
+            replica = int(sub.split("-", 1)[1])
+        except ValueError:
+            continue
+        try:
+            fnames = sorted(os.listdir(rdir))
+        except OSError:
+            continue
+        for fname in fnames:
+            if not fname.endswith(".json"):
+                continue
+            path = os.path.join(rdir, fname)
+            entry: Dict[str, Any] = {"replica": replica, "file": fname,
+                                     "path": path}
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+                entry.update({
+                    "trigger": payload.get("trigger"),
+                    "ts": payload.get("ts"),
+                    "pid": payload.get("pid"),
+                    "n_steps": len(payload.get("steps") or ()),
+                    "n_spans": len(payload.get("spans") or ()),
+                    "has_config": bool(payload.get("config")),
+                    "has_stats": bool(payload.get("stats")),
+                })
+            except (OSError, ValueError):
+                entry["error"] = "unreadable"
+            out["captures"].append(entry)
+    out["captures"].sort(key=lambda e: e.get("ts") or 0.0, reverse=True)
+    return out
+
+
+def attach_flight_recorder(tel: "EngineTelemetry", root_dir: str,
+                           replica: int, *, retain: int = 8,
+                           config: Optional[dict] = None,
+                           stats_fn: Optional[Callable[[], dict]] = None
+                           ) -> Optional[FlightRecorder]:
+    """Bind a FlightRecorder to one engine's telemetry bundle (shared
+    by the subprocess worker and the in-process fleet, so the payload
+    shape cannot drift between backends). No-op when the operator left
+    ``blackbox_dir`` empty or telemetry is disabled."""
+    if not root_dir or not tel.enabled:
+        return None
+    recorder = tel.recorder
+
+    def spans_fn() -> list:
+        spans: list = []
+        for tid, trace in recorder.recent_traces(32).items():
+            spans.extend(trace)
+        spans.extend(recorder.maintenance_spans(32))
+        return spans
+
+    fr = FlightRecorder(root_dir, replica, retain=retain, config=config,
+                        steps_fn=lambda: tel.step_ledger.snapshot(),
+                        spans_fn=spans_fn, stats_fn=stats_fn)
+    tel.flight = fr
+    fr.install_atexit()
+    return fr
+
+
+# ---------------------------------------------------------------------------
 # Engine-side bundle
 # ---------------------------------------------------------------------------
 
@@ -1098,6 +1753,13 @@ class EngineTelemetry:
         self.recorder = SpanRecorder(enabled=self.enabled)
         # Rolling SLO gauges; bound to targets in bind_engine.
         self.slo: Optional[SLOTracker] = None
+        # Step ledger + roofline attribution (README "Performance
+        # attribution"): sized/bound in bind_engine; the flight
+        # recorder is attached by the owning worker/fleet (it needs the
+        # operator's --blackbox-dir, which the engine never sees).
+        self.step_ledger = NULL_LEDGER
+        self.cost_model: Optional[StepCostModel] = None
+        self.flight: Optional[FlightRecorder] = None
         if not self.enabled:
             for attr in PHASE_HISTOGRAMS.values():
                 setattr(self, attr, NULL_METRIC)
@@ -1112,6 +1774,7 @@ class EngineTelemetry:
             self.kv_restore_bytes = NULL_METRIC
             return
         r = self.registry
+        register_span_ring(r, self.recorder)
         self.prefill_dispatch_s = r.histogram(
             "tpu_inf_prefill_dispatch_seconds",
             "Host wall time of one prefill dispatch")
@@ -1202,6 +1865,8 @@ class EngineTelemetry:
         (zero hot-path cost)."""
         if not self.enabled:
             return
+        self.step_ledger = StepLedger(engine.engine_cfg.step_ledger_depth)
+        self.cost_model = StepCostModel.from_engine(engine)
         r = self.registry
         alloc = engine.allocator
         total = engine.engine_cfg.num_pages - 1   # page 0 = trash page
@@ -1388,6 +2053,10 @@ class EngineTelemetry:
         tau_s = 30.0
         state = {"tokens": stats.tokens_generated,
                  "t": time.perf_counter(), "rate": 0.0}
+        # Wall-clock EWMA epoch: the /debug/steps cross-check replays
+        # this gauge's smoothing over the step ledger's timestamps, and
+        # both must integrate from the same origin to agree.
+        self._mfu_bind_unix = time.time()
 
         def _mfu() -> float:
             now = time.perf_counter()
@@ -1410,7 +2079,21 @@ class EngineTelemetry:
         """Latest scrape-window MFU estimate (None when telemetry is
         off or no scheduler is bound)."""
         g = getattr(self, "_mfu_gauge", None)
-        return round(g.collect_value(), 6) if g is not None else None
+        # 12 decimals, not 6: a toy CPU model against a real chip's
+        # peak sits at MFU ~1e-9, and the /debug/steps agreement
+        # cross-check needs the ratio, not a rounded-to-zero pair.
+        return round(g.collect_value(), 12) if g is not None else None
+
+    def steps_report(self, window_s: float = 60.0) -> Dict[str, Any]:
+        """This replica's step-attribution report (the ``steps`` worker
+        RPC verb / GET /debug/steps body)."""
+        if not self.enabled or self.cost_model is None:
+            return {"enabled": False}
+        return roofline_report(
+            self.step_ledger, self.cost_model,
+            mfu_gauge=self.mfu_estimate(),
+            bind_unix=getattr(self, "_mfu_bind_unix", None),
+            window_s=window_s)
 
     def request_finished(self, reason: str) -> None:
         """Per-finish-reason counter (lazy label children)."""

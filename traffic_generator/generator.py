@@ -48,6 +48,43 @@ class TrafficGenerator:
             self._retry_budget = max(16, len(self.queries))
         else:
             self._retry_budget = int(budget) or None  # 0 = unlimited
+        # Priority-class mix (README "Elastic fleet"): ``class_mix`` like
+        # "interactive:0.8,batch:0.15,background:0.05" tags each query
+        # with an X-Priority header in those proportions, so the
+        # per-class summary measures what each class actually
+        # experienced under the server's class-aware admission. Empty =
+        # off (no header; the server applies its default_class).
+        self._class_mix = self._parse_class_mix(
+            config.get("class_mix") or "")
+        self._class_counts = {name: 0 for name, _ in self._class_mix}
+
+    @staticmethod
+    def _parse_class_mix(spec: str) -> list:
+        """'name:weight,...' -> [(name, weight)]; raises ValueError on
+        malformed specs (a silently dropped class would skew the mix)."""
+        out = []
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition(":")
+            name = name.strip().lower()
+            weight = float(w) if w.strip() else 1.0
+            if not name or weight <= 0:
+                raise ValueError(f"bad class_mix entry {part!r}")
+            out.append((name, weight))
+        return out
+
+    def _next_class(self) -> Optional[str]:
+        """Deterministic proportional assignment (smallest served/weight
+        ratio next — weighted round-robin without RNG, so reruns of the
+        same trace tag the same queries)."""
+        if not self._class_mix:
+            return None
+        name = min(self._class_mix,
+                   key=lambda kv: self._class_counts[kv[0]] / kv[1])[0]
+        self._class_counts[name] += 1
+        return name
 
     def _payload(self, prompt: str, len_output: int) -> dict:
         temperature = float(self.config.get("temperature", 0.0))
@@ -111,7 +148,8 @@ class TrafficGenerator:
 
     async def inference_call(self, session: aiohttp.ClientSession,
                              prompt: str, len_output: int, sleep_time: float,
-                             query_id: int) -> None:
+                             query_id: int,
+                             priority: Optional[str] = None) -> None:
         collector = self.logger
         await asyncio.sleep(sleep_time)
         # Load-shed resilience: a chaos- or admission-control-enabled
@@ -124,12 +162,15 @@ class TrafficGenerator:
         # harness's per-query metrics to the server's structured logs
         # and /debug/requests spans (the server echoes it back).
         trace_id = f"tg-{query_id}"
+        headers = {"X-Request-Id": trace_id}
+        if priority:
+            headers["X-Priority"] = priority
         try:
             for attempt in range(max_retries + 1):
                 async with session.post(
                         self.config["url"],
                         json=self._payload(prompt, len_output),
-                        headers={"X-Request-Id": trace_id},
+                        headers=headers,
                         trace_request_ctx={"query_id": query_id,
                                            "collector": collector}) as resp:
                     if resp.status in (429, 503):
@@ -218,13 +259,21 @@ class TrafficGenerator:
             for _ in range(len(self.queries)):
                 prompt, len_p, len_g, qid, t = self.queries.get_query()
                 self.logger.init_query(qid, len_p, t)
+                pcls = self._next_class()
+                if pcls is not None:
+                    self.logger.record(qid, "priority_class", pcls)
                 calls.append(self.inference_call(session, prompt, len_g, t,
-                                                 qid))
+                                                 qid, priority=pcls))
             self.logger.start_session()
             await asyncio.gather(*calls)
         if self.logger.retries_total or self.logger.shed_total:
             print(f"[RESILIENCE] retries={self.logger.retries_total} "
                   f"shed={self.logger.shed_total}")
+        if self._class_mix:
+            for name, summ in self.logger.class_summary().items():
+                print(f"[CLASS] {name}: n={summ['requests']} "
+                      f"ttft_p95={summ['ttft_s']['p95']} "
+                      f"e2e_p95={summ['e2e_s']['p95']}")
         return self.logger.metrics
 
     def start_profile(self) -> dict:

@@ -40,6 +40,10 @@ config = {
     # server's Retry-After hint, before counting as shed.
     "max_retries": 4,
     "retry_backoff_s": 0.25,
+    # Priority-class mix, e.g. "interactive:0.8,batch:0.15,
+    # background:0.05": tags queries with X-Priority in those
+    # proportions and prints a per-class TTFT/E2E breakdown. "" = off.
+    "class_mix": "",
 }
 
 
@@ -71,6 +75,9 @@ def main() -> dict:
                                  max_gen_len=MAX_GEN_LEN)
     metrics = generator.start_profile()
     print(metrics)
+    if cfg.get("class_mix"):
+        import json as _json
+        print(_json.dumps(collector.class_summary(), indent=1))
     if cfg.get("save_log", True):
         log_path = cfg["log_path"]
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)

@@ -57,6 +57,9 @@ class MetricCollector:
             # record to server-side spans/logs. Additive field; the
             # reference schema is otherwise preserved.
             "request_id": None,
+            # Class the query was tagged with (class_mix; X-Priority
+            # header) — the per-class summary groups on this.
+            "priority_class": None,
             "scheduled_start_time": scheduled_start,
             "num_retries": 0,
             "shed": False,
@@ -83,6 +86,54 @@ class MetricCollector:
     def save(self, path: str) -> None:
         with open(path, "w") as f:
             json.dump(self.metrics, f, indent=1)
+
+    @staticmethod
+    def _pctls(xs, ps=(50, 95, 99)):
+        """Linear-interpolation percentiles (numpy 'linear' / the
+        server's percentile_from_cumulative convention) without a
+        numpy dependency in the client harness."""
+        out = {}
+        xs = sorted(xs)
+        for p in ps:
+            if not xs:
+                out[f"p{p}"] = None
+                continue
+            rank = (len(xs) - 1) * p / 100.0
+            lo = int(rank)
+            hi = min(lo + 1, len(xs) - 1)
+            out[f"p{p}"] = round(
+                xs[lo] + (xs[hi] - xs[lo]) * (rank - lo), 4)
+        return out
+
+    def class_summary(self) -> Dict[str, dict]:
+        """Per-priority-class latency breakdown (README "Elastic
+        fleet"): what each class's clients actually experienced —
+        TTFT + E2E percentiles, retries and sheds — keyed by the
+        class the query was tagged with ("untagged" otherwise)."""
+        by_class: Dict[str, list] = {}
+        for m in self.metrics.values():
+            by_class.setdefault(m.get("priority_class") or "untagged",
+                                []).append(m)
+        out: Dict[str, dict] = {}
+        for name, ms in sorted(by_class.items()):
+            ttft, e2e = [], []
+            for m in ms:
+                start = m.get("request_start_time")
+                first = m.get("first_token_arrive_time")
+                end = m.get("response_end_time")
+                if start is not None and first is not None:
+                    ttft.append(first - start)
+                if start is not None and end is not None:
+                    e2e.append(end - start)
+            out[name] = {
+                "requests": len(ms),
+                "succeeded": sum(1 for m in ms if m.get("success")),
+                "shed": sum(1 for m in ms if m.get("shed")),
+                "retries": sum(m.get("num_retries") or 0 for m in ms),
+                "ttft_s": self._pctls(ttft),
+                "e2e_s": self._pctls(e2e),
+            }
+        return out
 
 
 class RequestTracer(aiohttp.TraceConfig):
