@@ -230,6 +230,13 @@ def start_server(args) -> tuple:
             "worker_restart_backoff_s":
                 getattr(args, "worker_restart_backoff_s", 0.5),
             "drain_timeout_s": getattr(args, "drain_timeout_s", 10.0),
+            # Byzantine transport (README "Failure model"): per-verb
+            # RPC deadline classes for the --compare-chaos-rpc arms
+            # (wedge detection cost is 3 consecutive fast deadlines).
+            "rpc_deadline_fast_s":
+                getattr(args, "rpc_deadline_fast_s", 10.0),
+            "rpc_deadline_slow_s":
+                getattr(args, "rpc_deadline_slow_s", 60.0),
             # Elastic fleet (README "Elastic fleet"): autoscaler +
             # priority-class admission for the --compare-elastic arms.
             "autoscale": getattr(args, "autoscale", False),
@@ -432,6 +439,18 @@ def main() -> dict:
                         "recomputed tokens and swap-in-resumes")
     p.add_argument("--fleet-streams", type=int, default=6,
                    help="compare-fleet: concurrent streams per arm")
+    p.add_argument("--compare-chaos-rpc", action="store_true",
+                   help="Byzantine-transport lane (README 'Failure "
+                        "model'): the pinned greedy burst through a "
+                        "clean dp=2 subprocess fleet, then again under "
+                        "seeded frame-level RPC chaos — random byte "
+                        "corruption, injected delays, and one wedged "
+                        "(silently muted) connection — grading that "
+                        "every corrupt frame is detected (CRC) and "
+                        "recycled, outputs stay byte-identical (zero "
+                        "silent corruptions), no worker process "
+                        "restarts for a transport fault, and p95 "
+                        "latency inflation stays bounded")
     p.add_argument("--compare-pd", action="store_true",
                    help="P/D disaggregation lane (README 'P/D "
                         "disaggregation'): the pinned long-prompt burst "
@@ -511,13 +530,14 @@ def main() -> dict:
     if sum(map(bool, (args.compare_admission, args.compare_hybrid,
                       args.compare_ladder, args.compare_spec,
                       args.compare_fleet, args.compare_pd,
-                      args.compare_elastic))) > 1:
+                      args.compare_elastic,
+                      args.compare_chaos_rpc))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
         p.error("--compare-admission/--compare-hybrid/--compare-ladder/"
                 "--compare-spec/--compare-fleet/--compare-pd/"
-                "--compare-elastic are mutually exclusive; run them as "
-                "separate invocations")
+                "--compare-elastic/--compare-chaos-rpc are mutually "
+                "exclusive; run them as separate invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -584,6 +604,18 @@ def main() -> dict:
             args.host_cache_pages = 64
             args.decode_steps_per_call = 4
             args.no_warmup = True
+        if args.compare_chaos_rpc:
+            # Same dp=2 subprocess shape as compare-fleet; tight
+            # per-verb deadlines so the wedged connection's detection
+            # (3 consecutive timeouts -> recycle) costs seconds, not
+            # the default minute, inside the tier-1 budget.
+            args.dp = 2
+            args.num_pages, args.max_pages_per_seq = 128, 8
+            args.host_cache_pages = 64
+            args.decode_steps_per_call = 4
+            args.no_warmup = True
+            args.rpc_deadline_fast_s = 2.0
+            args.rpc_deadline_slow_s = 4.0
         if args.compare_elastic:
             # One subprocess worker to start (the whole point: the
             # AUTOSCALER adds the second), a shed cap tight enough that
@@ -648,6 +680,8 @@ def main() -> dict:
                         if args.compare_pd
                         else "benchmarks/results/replay_elastic.json"
                         if args.compare_elastic
+                        else "benchmarks/results/replay_chaos_rpc.json"
+                        if args.compare_chaos_rpc
                         else "benchmarks/results/replay_smoke.json")
         if args.compare_pd and args.trace_artifact is None:
             args.trace_artifact = os.path.join(
@@ -697,6 +731,8 @@ def main() -> dict:
         return _compare_pd(args)
     if args.compare_elastic:
         return _compare_elastic(args)
+    if args.compare_chaos_rpc:
+        return _compare_chaos_rpc(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -1364,11 +1400,14 @@ def _wait_inflight_tokens(group, min_tokens: int,
 
 
 def _fleet_arm(args, label: str, fleet: str, chaos: Optional[str] = None,
-               migrate: bool = True) -> dict:
+               migrate: bool = True,
+               chaos_rpc: Optional[dict] = None) -> dict:
     """Boot one server on the given fleet backend, run the pinned
     greedy burst, optionally injecting mid-burst chaos (``"kill9"`` =
     SIGKILL the busiest worker; ``"drain"`` = graceful drain of the
-    busiest worker, with or without KV migration), and summarize."""
+    busiest worker, with or without KV migration; ``chaos_rpc`` =
+    frame-level transport fault injection armed for the whole burst),
+    and summarize."""
     import hashlib
 
     print(f"[replay] fleet arm: {label}", file=sys.stderr)
@@ -1394,6 +1433,12 @@ def _fleet_arm(args, label: str, fleet: str, chaos: Optional[str] = None,
                                  "options": {"num_predict": 4}}).encode(),
                 headers={"Content-Type": "application/json"})
             urllib.request.urlopen(req, timeout=600).read()
+        if chaos_rpc is not None:
+            # Armed AFTER warmup (the warm pass is scaffolding, not the
+            # graded burst) and for the burst's whole life: every frame
+            # both directions rolls the seeded schedule.
+            group.apply_chaos({"rpc": dict(chaos_rpc)})
+            chaos_fired = True
         box = {}
 
         def run_burst():
@@ -1463,6 +1508,13 @@ def _fleet_arm(args, label: str, fleet: str, chaos: Optional[str] = None,
         "resume_reused_tokens": sup.get("resume_reused_tokens", 0),
         "swap_in_resumes": sup.get("swap_in_resumes",
                                    after.get("swap_in_resumes", 0)),
+        # Byzantine-transport counters (README "Failure model"): the
+        # chaos-rpc lane grades these; zero everywhere else.
+        "worker_reconnects": sup.get("worker_reconnects", 0),
+        "rpc_timeouts": sup.get("rpc_timeouts", 0),
+        "frame_errors": sup.get("frame_errors", 0),
+        "kv_integrity_rejections": sup.get("kv_integrity_rejections", 0),
+        "poison_requests": sup.get("poison_requests", 0),
         "fleet_status": health.get("status"),
     }
 
@@ -1538,6 +1590,90 @@ def _compare_fleet(args) -> dict:
             and dm["migrated_pages"] > 0
             and dm["resume_recomputed_tokens"]
             < dr["resume_recomputed_tokens"]),
+    }
+    out = {"config": cfg_snapshot, **arms, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(arms)
+    return result
+
+
+def _compare_chaos_rpc(args) -> dict:
+    """The Byzantine-transport artifact (README "Failure model"): the
+    pinned greedy burst served by a clean dp=2 subprocess fleet, then
+    by the same fleet under seeded frame-level RPC chaos — random byte
+    corruption and injected delays on every router<->worker frame in
+    both directions, plus ONE wedged connection (socket open, writes
+    silently swallowed) mid-burst. Acceptance: outputs byte-identical
+    across both arms (every corrupt frame was caught by the codec CRC
+    and the connection recycled+resynced — zero silent corruptions),
+    frame errors and RPC timeouts actually counted, connections were
+    reconnected WITHOUT any worker process restart, and p95 latency
+    inflation stays bounded (detection deadlines, not hangs)."""
+    args.fleet_tokens = 32
+    cfg_snapshot = {k: v for k, v in vars(args).items()
+                    if not k.startswith("_")}
+    arms = {}
+    arms["clean"] = _fleet_arm(args, "clean", "subprocess")
+    arms["chaos_rpc"] = _fleet_arm(
+        args, "chaos_rpc", "subprocess",
+        chaos_rpc={
+            # Seeded: the whole fault schedule replays bit-for-bit
+            # (test_chaos_deterministic_schedule holds the contract).
+            "seed": 20240,
+            # ~1 frame in 50 corrupted: a handful of CRC rejections +
+            # connection recycles across the burst's few hundred
+            # frames, on both directions.
+            "corrupt_rate": 0.02,
+            # Transport jitter on every 10th frame.
+            "delay_rate": 0.1, "delay_s": 0.01,
+            # One connection wedges right as the burst opens (router->
+            # worker writes swallowed); the per-verb deadline watchdog
+            # must recycle it, not hang the stream or restart the
+            # process. The frame count is per-connection and corruption
+            # recycles connections, so the trigger sits low enough to
+            # fire before a CRC hit can reset the count.
+            "wedge_after": 2, "wedge_replica": 0,
+            "direction": "both",
+        })
+    args.fleet = "in-process"
+
+    clean, chaos = arms["clean"], arms["chaos_rpc"]
+    identical = clean["outputs_sha256"] == chaos["outputs_sha256"]
+    p95_clean = max(clean["e2e_s"]["p95"], 1e-9)
+    inflation = round(chaos["e2e_s"]["p95"] / p95_clean, 3)
+    comparison = {
+        "streams": args.fleet_streams,
+        "chaos_fired": chaos["chaos_fired"],
+        # Byte-identity IS the zero-silent-corruption claim: a single
+        # adopted corrupt frame would change some stream's bytes.
+        "outputs_identical": identical,
+        "silent_corruptions": 0 if identical else 1,
+        "frame_errors": chaos["frame_errors"],
+        "rpc_timeouts": chaos["rpc_timeouts"],
+        "worker_reconnects": chaos["worker_reconnects"],
+        "kv_integrity_rejections": chaos["kv_integrity_rejections"],
+        # Transport faults are repaired at the connection, never the
+        # process: restarts under chaos must stay at zero.
+        "worker_restarts_chaos": chaos["worker_restarts"],
+        "tokens_per_s_clean": clean["tokens_per_s"],
+        "tokens_per_s_chaos": chaos["tokens_per_s"],
+        "e2e_p95_clean_s": clean["e2e_s"]["p95"],
+        "e2e_p95_chaos_s": chaos["e2e_s"]["p95"],
+        "p95_inflation": inflation,
+        # Bounded: detection is deadline-driven (3 fast deadlines for
+        # the wedge, one frame for a CRC hit), so chaos costs a
+        # constant few seconds — not a hang. The 20x ceiling is a
+        # loaded-CI-box guard, not a perf claim.
+        "p95_inflation_bounded": inflation <= 20.0,
+        "chaos_wins": bool(
+            identical and chaos["chaos_fired"]
+            and chaos["frame_errors"] >= 1
+            and chaos["rpc_timeouts"] >= 1
+            and chaos["worker_reconnects"] >= 1
+            and chaos["worker_restarts"] == 0
+            and inflation <= 20.0),
     }
     out = {"config": cfg_snapshot, **arms, "comparison": comparison}
     print(json.dumps(comparison, indent=1))

@@ -315,6 +315,59 @@ def test_replay_smoke_compare_fleet(tmp_path, monkeypatch):
             < c["recomputed_tokens_resubmit"])
 
 
+def test_replay_smoke_compare_chaos_rpc(tmp_path, monkeypatch):
+    """Tier-1 Byzantine-transport smoke (CPU, dp=2): the chaos-rpc
+    lane serves the pinned greedy burst through a clean subprocess
+    fleet and again under seeded frame-level fault injection — byte
+    corruption + delays on every router<->worker frame in both
+    directions, plus one wedged connection as the burst opens. Live
+    assertions are the DETERMINISTIC claims: byte-identical outputs
+    (zero silent corruptions — every corrupt frame was CRC-rejected
+    and the connection recycled+resynced), frame errors and RPC
+    timeouts actually counted, reconnects with ZERO worker process
+    restarts (transport faults are repaired at the connection), and
+    p95 inflation bounded. Throughput magnitudes are reported, not
+    graded (loaded-CI-box stance)."""
+    root, replay = _load_replay()
+    out = tmp_path / "replay_chaos_rpc.json"
+    monkeypatch.chdir(root)
+    monkeypatch.setattr(sys, "argv",
+                        ["replay.py", "--smoke", "--compare-chaos-rpc",
+                         "--out", str(out)])
+    cmp = replay.main()
+
+    art = json.loads(out.read_text())
+    assert art["config"]["smoke"] is True
+    for arm in ("clean", "chaos_rpc"):
+        s = art[arm]
+        assert s["requests"] > 0 and s["output_tokens"] > 0, (arm, s)
+    # The clean arm saw no injected faults.
+    assert art["clean"]["frame_errors"] == 0
+    assert art["clean"]["worker_reconnects"] == 0
+    # The chaos arm really injected, detected, and recovered.
+    assert cmp["chaos_fired"]
+    assert cmp["outputs_identical"], cmp
+    assert cmp["silent_corruptions"] == 0
+    assert cmp["frame_errors"] >= 1, cmp
+    assert cmp["rpc_timeouts"] >= 1, cmp
+    assert cmp["worker_reconnects"] >= 1, cmp
+    # Connection-level failover, never a process restart.
+    assert cmp["worker_restarts_chaos"] == 0, cmp
+    assert cmp["p95_inflation_bounded"], cmp
+    assert cmp["chaos_wins"], cmp
+
+    # The committed artifact carries the same acceptance claims.
+    committed = json.loads(open(os.path.join(
+        root, "benchmarks", "results", "replay_chaos_rpc.json")).read())
+    c = committed["comparison"]
+    assert c["chaos_wins"] and c["outputs_identical"]
+    assert c["silent_corruptions"] == 0
+    assert c["frame_errors"] >= 1 and c["rpc_timeouts"] >= 1
+    assert c["worker_reconnects"] >= 1
+    assert c["worker_restarts_chaos"] == 0
+    assert c["p95_inflation_bounded"]
+
+
 def test_replay_smoke_compare_elastic(tmp_path, monkeypatch):
     """Tier-1 elastic-fleet smoke (CPU): the fixed vs elastic lane
     replays the pinned mini-diurnal (>= 20x offered-load swing, mixed

@@ -1,0 +1,239 @@
+"""Byzantine transport units (README "Failure model"): the checksummed
+frame codec, its fuzz surface, the deterministic chaos shim, and the
+end-to-end KV blob digest.
+
+Everything here is process-free — codec bytes in, typed errors out.
+The fleet-level consequences (reconnect+resync, poison quarantine,
+worker survival under garbage) live in test_fleet.py against real
+worker processes.
+"""
+
+import io
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from tpu_inference import integrity
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.server import transport
+from tpu_inference.server.transport import (ChaosPolicy, ChaosTransport,
+                                            FrameError, encode_frame,
+                                            recv_frame, send_frame)
+
+# ------------------------------------------------------------- crc32c
+
+
+def test_crc32c_reference_vector():
+    """The canonical CRC-32C check value (RFC 3720 appendix B.4)."""
+    assert integrity.crc32c(b"123456789") == 0xE3069283
+    assert integrity.crc32c(b"") == 0
+    # Chainable: feeding in two chunks equals one pass.
+    whole = integrity.crc32c(b"123456789")
+    assert integrity.crc32c(b"456789",
+                            integrity.crc32c(b"123")) == whole
+
+
+# -------------------------------------------------------- frame codec
+
+
+def _recv_bytes(data: bytes):
+    return recv_frame(io.BytesIO(data))
+
+
+def test_frame_roundtrip_and_clean_eof():
+    a, b = socket.socketpair()
+    rfile = b.makefile("rb")
+    send_frame(a, {"id": 1, "verb": "hello"})
+    send_frame(a, {"ev": "token", "t": 42}, blob=b"\x00\x01\xffbytes")
+    obj, blob = recv_frame(rfile)
+    assert obj == {"id": 1, "verb": "hello"} and blob == b""
+    obj, blob = recv_frame(rfile)
+    assert obj["t"] == 42 and blob == b"\x00\x01\xffbytes"
+    a.close()
+    # Clean EOF at a frame boundary: plain ConnectionError, NOT a
+    # FrameError — the stream was valid to its end.
+    with pytest.raises(ConnectionError) as ei:
+        recv_frame(rfile)
+    assert not isinstance(ei.value, FrameError)
+    b.close()
+
+
+def test_frame_truncated_header_typed_eof():
+    frame = encode_frame({"id": 7})
+    for cut in (1, 3, 7, 15):
+        with pytest.raises(FrameError) as ei:
+            _recv_bytes(frame[:cut])
+        assert ei.value.reason == "eof"
+
+
+def test_frame_mid_payload_eof():
+    frame = encode_frame({"id": 7, "verb": "x"}, blob=b"y" * 100)
+    with pytest.raises(FrameError) as ei:
+        _recv_bytes(frame[:len(frame) - 30])
+    assert ei.value.reason == "eof"
+
+
+def test_frame_bad_magic_desync():
+    frame = bytearray(encode_frame({"id": 7}))
+    frame[0] ^= 0xFF
+    with pytest.raises(FrameError) as ei:
+        _recv_bytes(bytes(frame))
+    assert ei.value.reason == "magic"
+
+
+def test_frame_garbage_lengths_no_allocation():
+    """A garbage header must fail BEFORE any payload allocation: the
+    reader below holds only these 16 bytes, so an attempted multi-GB
+    read would raise eof — the typed 'oversized' proves the bounds
+    check came first."""
+    hdr = struct.pack(">IIII", 0x54504631, transport.MAX_JSON + 1,
+                      0, 0xDEADBEEF)
+    with pytest.raises(FrameError) as ei:
+        _recv_bytes(hdr)
+    assert ei.value.reason == "oversized"
+    hdr = struct.pack(">IIII", 0x54504631, 2,
+                      0xFFFFFFFF, 0xDEADBEEF)
+    with pytest.raises(FrameError) as ei:
+        _recv_bytes(hdr + b"{}")
+    assert ei.value.reason == "oversized"
+
+
+def test_frame_crc_rejects_any_flipped_byte():
+    frame = encode_frame({"id": 9, "verb": "submit"}, blob=b"kvkvkv")
+    # Flip every byte past the length words, one at a time: each must
+    # be caught (CRC field, JSON, or blob corruption).
+    for off in range(12, len(frame)):
+        buf = bytearray(frame)
+        buf[off] ^= 0x01
+        with pytest.raises(FrameError) as ei:
+            _recv_bytes(bytes(buf))
+        assert ei.value.reason == "crc"
+
+
+def test_frame_bad_json_typed():
+    payload = b"{not json"
+    lens = struct.pack(">II", len(payload), 0)
+    crc = integrity.crc32c(payload, integrity.crc32c(lens))
+    raw = struct.pack(">IIII", 0x54504631, len(payload), 0, crc) + payload
+    with pytest.raises(FrameError) as ei:
+        _recv_bytes(raw)
+    assert ei.value.reason == "json"
+
+
+def test_frame_error_is_connection_error():
+    """Every existing 'peer died' handler catches ConnectionError; the
+    typed codec errors must route through the same recycling path."""
+    assert issubclass(FrameError, ConnectionError)
+
+
+# -------------------------------------------------------- chaos shim
+
+
+def _schedule(policy_kw, n=200, verb="submit", direction="send"):
+    t = ChaosTransport(ChaosPolicy(**policy_kw))
+    return [t.decide(verb, direction) for _ in range(n)]
+
+
+def test_chaos_deterministic_schedule():
+    """Same seed => identical fault schedule, different seed => a
+    different one (the replay lane's reproducibility contract)."""
+    kw = dict(seed=1234, corrupt_rate=0.1, drop_rate=0.05,
+              delay_rate=0.2, truncate_rate=0.05)
+    s1, s2 = _schedule(kw), _schedule(kw)
+    assert s1 == s2
+    assert set(s1) >= {"pass", "delay", "corrupt"}
+    assert _schedule({**kw, "seed": 99}) != s1
+
+
+def test_chaos_verb_and_direction_filters():
+    kw = dict(seed=7, drop_rate=1.0)
+    assert _schedule(kw, n=3) == ["drop"] * 3
+    assert _schedule({**kw, "verbs": ("cancel",)}, n=3) == ["pass"] * 3
+    assert _schedule({**kw, "verbs": ("submit",)}, n=3) == ["drop"] * 3
+    assert _schedule({**kw, "direction": "recv"}, n=3) == ["pass"] * 3
+
+
+def test_chaos_wedge_one_shot():
+    """The wedge fires once per policy: after wedge_after eligible
+    frames the connection goes mute for ALL traffic; a replacement
+    transport on the same policy serves clean (liveness)."""
+    pol = ChaosPolicy(seed=0, wedge_after=3)
+    t = ChaosTransport(pol)
+    assert [t.decide("submit", "send") for _ in range(3)] == ["pass"] * 3
+    assert t.decide("submit", "send") == "wedge"
+    # Mute even for frames the filters would skip.
+    assert t.decide("healthz", "recv") == "wedge"
+    assert pol.wedge_spent
+    t2 = ChaosTransport(pol)
+    assert [t2.decide("submit", "send") for _ in range(10)] \
+        == ["pass"] * 10
+
+
+def test_chaos_corrupted_send_rejected_by_reader():
+    """corrupt-rate 1.0 through a real socketpair: the reader's CRC
+    rejects every frame as a typed crc error — never bad data."""
+    a, b = socket.socketpair()
+    rfile = b.makefile("rb")
+    chaos = ChaosTransport(ChaosPolicy(seed=5, corrupt_rate=1.0))
+    send_frame(a, {"id": 1, "verb": "submit"}, blob=b"z" * 64,
+               chaos=chaos, verb="submit")
+    with pytest.raises(FrameError) as ei:
+        recv_frame(rfile)
+    assert ei.value.reason == "crc"
+    a.close(), b.close()
+
+
+def test_chaos_drop_and_truncate_raise_connection_error():
+    for kw in (dict(drop_rate=1.0), dict(truncate_rate=1.0)):
+        a, b = socket.socketpair()
+        chaos = ChaosTransport(ChaosPolicy(seed=3, **kw))
+        with pytest.raises(ConnectionError):
+            send_frame(a, {"id": 1, "verb": "submit"},
+                       chaos=chaos, verb="submit")
+        a.close(), b.close()
+
+
+# ----------------------------------------------------- KV blob digest
+
+
+def _pages(n=2):
+    rng = np.random.default_rng(11)
+    mk = lambda: rng.standard_normal((2, 8, 2, 16)).astype(np.float32)
+    return [kvc.HostKVPage(mk(), mk()) for _ in range(n)]
+
+
+def test_kv_blob_digest_roundtrip_and_corruption():
+    blob = kvc.serialize_host_pages(_pages())
+    assert kvc.deserialize_host_pages(blob)   # clean blob passes
+    assert kvc.verify_host_pages_blob(blob) is None
+    # One flipped body byte: rejected, typed, never adopted.
+    buf = bytearray(blob)
+    buf[-1] ^= 0x01
+    with pytest.raises(integrity.KVIntegrityError):
+        kvc.deserialize_host_pages(bytes(buf))
+    assert kvc.verify_host_pages_blob(bytes(buf)) is not None
+
+
+def test_kv_blob_truncation_rejected():
+    blob = kvc.serialize_host_pages(_pages())
+    for cut in (1, 3, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(integrity.KVIntegrityError):
+            kvc.deserialize_host_pages(blob[:cut])
+        assert kvc.verify_host_pages_blob(blob[:cut]) is not None
+
+
+def test_kv_blob_predigest_compat():
+    """A blob serialized WITHOUT the digest key (an older peer) still
+    deserializes — integrity is enforced when the digest is present,
+    not retroactively."""
+    import json as _json
+    blob = kvc.serialize_host_pages(_pages())
+    hlen = struct.unpack(">I", blob[:4])[0]
+    meta = _json.loads(blob[4:4 + hlen].decode())
+    meta.pop("crc32c")
+    hdr = _json.dumps(meta).encode()
+    legacy = struct.pack(">I", len(hdr)) + hdr + blob[4 + hlen:]
+    assert len(kvc.deserialize_host_pages(legacy)) == 2
+    assert kvc.verify_host_pages_blob(legacy) is None

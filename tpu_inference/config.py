@@ -822,6 +822,42 @@ class ServerConfig:
     # (recompute-resume, byte-identical under greedy) to make room.
     # 0 = classes ride the legacy single global cap.
     class_queue_depth: int = 0
+    # --- Byzantine transport (README "Failure model") ---
+    # Per-verb RPC deadline classes replacing the historical blanket
+    # 60 s waits: "fast" covers control-plane verbs that answer from
+    # memory (peek/cancel/healthz/stats/metrics/...), "slow" covers
+    # verbs that touch the engine loop or move KV bytes
+    # (submit/import-kv/drain). Boot handshake, shutdown, embed and
+    # profiler captures keep their own explicit budgets.
+    rpc_deadline_fast_s: float = 10.0
+    rpc_deadline_slow_s: float = 60.0
+    # Poison-request quarantine: a request whose attempts have crashed
+    # or wedged this many DISTINCT workers is failed terminally with a
+    # structured 500 (and a router-side blackbox capture) instead of
+    # marching through the fleet via failover. 0 disables the gate.
+    poison_max_workers: int = 3
+    # Transport fault injection (--chaos-rpc-*): seeded chaos shim
+    # around the frame codec on both sides of every worker connection.
+    # Rates are per-frame probabilities; faults are drawn from a
+    # private RNG keyed only by (seed, frame index) so a pinned seed
+    # reproduces the exact fault schedule. All off by default.
+    chaos_rpc_seed: int = 0
+    chaos_rpc_corrupt_rate: float = 0.0   # flip a byte (CRC catches it)
+    chaos_rpc_drop_rate: float = 0.0      # drop = connection reset
+    chaos_rpc_delay_rate: float = 0.0     # hold the frame delay_s
+    chaos_rpc_delay_s: float = 0.02
+    chaos_rpc_truncate_rate: float = 0.0  # torn write: prefix + reset
+    # Wedge one router->worker connection (socket open, writes stop
+    # landing) after this many frames; one-shot — the replacement
+    # connection after the deadline-driven recycle serves clean.
+    # 0 = no wedge. chaos_rpc_wedge_replica picks the victim.
+    chaos_rpc_wedge_after: int = 0
+    chaos_rpc_wedge_replica: int = 0
+    # Frame eligibility filters: verbs (empty = all; matched against
+    # the RPC verb / reply verb / event name) and direction
+    # ("send" = router->worker, "recv" = worker->router, "both").
+    chaos_rpc_verbs: tuple[str, ...] = ()
+    chaos_rpc_direction: str = "both"
 
 
 @dataclasses.dataclass
@@ -886,8 +922,9 @@ def framework_config_from_dict(d: dict) -> FrameworkConfig:
         if k in eng and eng[k] is not None:
             eng[k] = tuple(eng[k])
     srv = dict(d.get("server") or {})
-    if srv.get("worker_roles") is not None:
-        srv["worker_roles"] = tuple(srv["worker_roles"])
+    for k in ("worker_roles", "chaos_rpc_verbs"):
+        if srv.get(k) is not None:
+            srv[k] = tuple(srv[k])
     return FrameworkConfig(
         model=model_config_from_dict(d["model"]),
         engine=EngineConfig(**eng),

@@ -492,6 +492,12 @@ class InferenceEngine:
         # ("every non-clean handoff") holds for worker-side failures
         # too, not just the router-side stale-blob/no-adopter paths.
         self.adopt_fallbacks = 0
+        # Byzantine transport (README "Failure model"): KV blobs whose
+        # embedded CRC-32C digest failed verification on an adopt or
+        # import path — rejected and counted here, never adopted. The
+        # worker folds this into healthz and the fleet sums it into
+        # tpu_inf_kv_integrity_rejections_total.
+        self.kv_integrity_rejections = 0
         # Cross-thread migration imports (the worker's import-kv RPC
         # lands on an RPC thread; the host tier is engine-thread only):
         # queued here, applied by the scheduler loop before admission so

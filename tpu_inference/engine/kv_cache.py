@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_inference import integrity
 from tpu_inference.config import EngineConfig, ModelConfig
 
 
@@ -561,8 +562,14 @@ def serialize_host_pages(pages: List[HostKVPage]) -> bytes:
         if meta["scaled"]:
             parts.append(np.ascontiguousarray(hp.k_scale).tobytes())
             parts.append(np.ascontiguousarray(hp.v_scale).tobytes())
+    body = b"".join(parts)
+    # Per-blob digest (README "Failure model"): CRC-32C over the raw
+    # page bytes, carried inside the header so every adopt/import path
+    # can verify end-to-end — across processes, sockets, and any future
+    # storage hop — independent of the frame-level checksum.
+    meta["crc32c"] = integrity.crc32c(body)
     header = json.dumps(meta).encode()
-    return struct.pack(">I", len(header)) + header + b"".join(parts)
+    return struct.pack(">I", len(header)) + header + body
 
 
 def deserialize_host_pages(blob: bytes) -> List[HostKVPage]:
@@ -572,10 +579,27 @@ def deserialize_host_pages(blob: bytes) -> List[HostKVPage]:
     import json
     import struct
 
+    if len(blob) < 4:
+        raise integrity.KVIntegrityError(
+            f"KV blob truncated ({len(blob)} bytes)")
     (hlen,) = struct.unpack(">I", blob[:4])
-    meta = json.loads(blob[4:4 + hlen].decode())
+    if 4 + hlen > len(blob):
+        raise integrity.KVIntegrityError(
+            f"KV blob header overruns blob ({hlen} > {len(blob) - 4})")
+    try:
+        meta = json.loads(blob[4:4 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise integrity.KVIntegrityError(
+            f"KV blob header unparseable: {e}") from None
     if not meta:
         return []
+    want = meta.get("crc32c")
+    if want is not None:
+        got = integrity.crc32c(blob[4 + hlen:])
+        if got != want:
+            raise integrity.KVIntegrityError(
+                "KV blob digest mismatch "
+                f"(want 0x{want:08x} got 0x{got:08x})")
     k_dtype = _np_dtype(meta["k_dtype"])
     k_shape = tuple(meta["k_shape"])
     k_size = int(np.prod(k_shape)) * k_dtype.itemsize
@@ -603,3 +627,32 @@ def deserialize_host_pages(blob: bytes) -> List[HostKVPage]:
             vs = take(s_size, s_dtype, s_shape)
         out.append(HostKVPage(k, v, ks, vs))
     return out
+
+
+def verify_host_pages_blob(blob: bytes) -> Optional[str]:
+    """Structural + digest check WITHOUT materializing pages — the
+    router's cheap gate before forwarding a handoff/migrate blob to a
+    destination worker. Returns None when sound, else the rejection
+    reason. A pre-digest blob (no ``crc32c`` in its header) passes the
+    structure check only."""
+    import json
+    import struct
+
+    if not blob:
+        return None
+    if len(blob) < 4:
+        return f"KV blob truncated ({len(blob)} bytes)"
+    (hlen,) = struct.unpack(">I", blob[:4])
+    if 4 + hlen > len(blob):
+        return f"KV blob header overruns blob ({hlen} > {len(blob) - 4})"
+    try:
+        meta = json.loads(blob[4:4 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        return f"KV blob header unparseable: {e}"
+    want = meta.get("crc32c") if meta else None
+    if want is not None:
+        got = integrity.crc32c(blob[4 + hlen:])
+        if got != want:
+            return ("KV blob digest mismatch "
+                    f"(want 0x{want:08x} got 0x{got:08x})")
+    return None

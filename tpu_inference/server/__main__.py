@@ -330,6 +330,56 @@ def main() -> None:
     p.add_argument("--chaos-step-wedge-s", type=float, default=0.0,
                    help="engine fault injection: each dispatch sleeps "
                         "this long first (exercises the step watchdog)")
+    p.add_argument("--chaos-rpc-seed", type=int, default=0,
+                   help="transport fault injection: deterministic seed "
+                        "for the frame-level fault schedule (same seed "
+                        "=> same faults at the same frame indices)")
+    p.add_argument("--chaos-rpc-corrupt-rate", type=float, default=0.0,
+                   help="transport fault injection: flip one byte in "
+                        "this fraction of RPC frames (CRC rejects them; "
+                        "exercises reconnect + resync)")
+    p.add_argument("--chaos-rpc-drop-rate", type=float, default=0.0,
+                   help="transport fault injection: reset the "
+                        "connection instead of sending this fraction "
+                        "of frames")
+    p.add_argument("--chaos-rpc-delay-rate", type=float, default=0.0,
+                   help="transport fault injection: delay this "
+                        "fraction of frames by --chaos-rpc-delay-s")
+    p.add_argument("--chaos-rpc-delay-s", type=float, default=0.02,
+                   help="transport fault injection: per-delayed-frame "
+                        "sleep (seconds)")
+    p.add_argument("--chaos-rpc-truncate-rate", type=float, default=0.0,
+                   help="transport fault injection: torn write — send "
+                        "a prefix of the frame, then reset")
+    p.add_argument("--chaos-rpc-wedge-after", type=int, default=0,
+                   help="transport fault injection: after this many "
+                        "matching frames, the connection silently "
+                        "swallows ALL traffic until the deadline "
+                        "watchdog recycles it (0 = off; one-shot)")
+    p.add_argument("--chaos-rpc-wedge-replica", type=int, default=0,
+                   help="replica whose router connection arms the "
+                        "wedge (with --chaos-rpc-wedge-after)")
+    p.add_argument("--chaos-rpc-verbs", default="",
+                   help="comma-separated RPC verbs the transport chaos "
+                        "applies to ('' = every verb)")
+    p.add_argument("--chaos-rpc-direction", default="both",
+                   choices=("send", "recv", "both"),
+                   help="which direction transport chaos applies to: "
+                        "send = router->worker frames, recv = "
+                        "worker->router frames")
+    p.add_argument("--rpc-deadline-fast-s", type=float, default=10.0,
+                   help="deadline for control-plane RPCs (cancel, "
+                        "chaos, healthz, ...); timeouts emit "
+                        "structured rpc_timeout events and three "
+                        "consecutive ones recycle the connection")
+    p.add_argument("--rpc-deadline-slow-s", type=float, default=60.0,
+                   help="deadline for data-plane RPCs that move KV "
+                        "bytes or block on admission (submit, "
+                        "import-kv, drain)")
+    p.add_argument("--poison-max-workers", type=int, default=3,
+                   help="quarantine a request as poison (terminal 500) "
+                        "once its attempts have crashed or wedged this "
+                        "many DISTINCT workers (0 disables)")
     p.add_argument("--slo-ttft-ms", type=float, default=0.0,
                    help="rolling SLO target for time-to-first-token "
                         "(ms): requests past it count into "
@@ -522,6 +572,26 @@ def main() -> None:
                               admission_queue_depth=args.admission_queue_depth,
                               chaos_failure_rate=args.chaos_failure_rate,
                               chaos_delay_s=args.chaos_delay_s,
+                              chaos_rpc_seed=args.chaos_rpc_seed,
+                              chaos_rpc_corrupt_rate=(
+                                  args.chaos_rpc_corrupt_rate),
+                              chaos_rpc_drop_rate=args.chaos_rpc_drop_rate,
+                              chaos_rpc_delay_rate=(
+                                  args.chaos_rpc_delay_rate),
+                              chaos_rpc_delay_s=args.chaos_rpc_delay_s,
+                              chaos_rpc_truncate_rate=(
+                                  args.chaos_rpc_truncate_rate),
+                              chaos_rpc_wedge_after=(
+                                  args.chaos_rpc_wedge_after),
+                              chaos_rpc_wedge_replica=(
+                                  args.chaos_rpc_wedge_replica),
+                              chaos_rpc_verbs=tuple(
+                                  v for v in
+                                  args.chaos_rpc_verbs.split(",") if v),
+                              chaos_rpc_direction=args.chaos_rpc_direction,
+                              rpc_deadline_fast_s=args.rpc_deadline_fast_s,
+                              rpc_deadline_slow_s=args.rpc_deadline_slow_s,
+                              poison_max_workers=args.poison_max_workers,
                               blackbox_dir=args.blackbox_dir,
                               blackbox_retain=args.blackbox_retain),
                           step_ledger_depth=args.step_ledger_depth,

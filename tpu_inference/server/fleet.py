@@ -41,6 +41,7 @@ import itertools
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -58,11 +59,33 @@ from tpu_inference.engine.prefix_cache import _chain_hashes
 from tpu_inference.server.replicas import (FleetSaturated, FleetUnavailable,
                                            _RETRYABLE, _clone_request,
                                            aggregate_replica_stats)
-from tpu_inference.server.worker import recv_frame, send_frame
+from tpu_inference.server.transport import (ChaosPolicy, ChaosTransport,
+                                            FrameError, recv_frame,
+                                            send_frame)
 
 
 class WorkerGone(ConnectionError):
     """RPC failed because the worker's process/connection died."""
+
+
+# Per-verb deadline classes (README "Failure model"): every RPC site
+# resolves its budget from ServerConfig.rpc_deadline_{fast,slow}_s via
+# this table instead of hard-coding a blanket wait. "fast" verbs answer
+# from memory; "slow" verbs touch the engine loop or move KV bytes.
+# hello/shutdown/embed/profile keep explicit budgets at their call
+# sites (boot compile, exit drain, batch forward, profiler capture).
+_SLOW_RPC_VERBS = ("submit", "import-kv", "drain")
+
+# Consecutive same-connection RPC timeouts before the router declares
+# the connection wedged and recycles it (reconnect, not restart) —
+# a silent socket heals without paying a worker boot.
+_WEDGE_TIMEOUTS = 3
+
+# How long a failed re-route keeps re-picking before the request fails
+# "unavailable". Covers the connection-level failover window (redial +
+# hello, bounded by the 5 s connect timeout) and most of a worker
+# restart, so a momentary client gap never kills a request outright.
+_REROUTE_GRACE_S = 10.0
 
 
 class WorkerClient:
@@ -71,7 +94,9 @@ class WorkerClient:
     handler on this client's reader thread."""
 
     def __init__(self, path: str, proc: subprocess.Popen,
-                 connect_timeout: float = 1800.0):
+                 connect_timeout: float = 1800.0, replica: int = -1,
+                 deadlines: Optional[dict] = None,
+                 chaos: Optional[ChaosTransport] = None):
         import socket as _socket
 
         deadline = time.monotonic() + connect_timeout
@@ -98,8 +123,16 @@ class WorkerClient:
         self._pending: Dict[int, dict] = {}
         self._plock = threading.Lock()
         self.alive = True
+        self.replica = replica
+        self.deadlines = deadlines or {}
+        self.chaos = chaos
+        # Why the reader died, for the group's supervision accounting:
+        # "" (clean/unknown) | "frame_error" | "stream_gap".
+        self.lost_reason = ""
+        self._consec_timeouts = 0
         self.on_event: Optional[Callable] = None     # set by the group
         self.on_lost: Optional[Callable] = None
+        self.on_timeout: Optional[Callable] = None   # (verb, timeout_s)
         self._reader = threading.Thread(target=self._read_loop,
                                         name="fleet-worker-reader",
                                         daemon=True)
@@ -110,17 +143,35 @@ class WorkerClient:
     def close(self) -> None:
         self.alive = False
         try:
+            # shutdown() — not just close() — is what actually wakes
+            # the reader thread parked in recv(): closing the fd alone
+            # leaves it blocked forever, on_lost never fires, and a
+            # wedged connection would never be recycled.
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
 
-    def rpc(self, verb: str, timeout: float = 60.0, blob: bytes = b"",
-            **kw) -> dict:
-        """Send one request frame and wait for its reply. Raises
-        WorkerGone on a dead connection, RuntimeError on an error
-        reply."""
+    def resolve_deadline(self, verb: str) -> float:
+        if verb in _SLOW_RPC_VERBS:
+            return float(self.deadlines.get("slow", 60.0))
+        return float(self.deadlines.get("fast", 10.0))
+
+    def rpc(self, verb: str, timeout: Optional[float] = None,
+            blob: bytes = b"", **kw) -> dict:
+        """Send one request frame and wait for its reply. ``timeout``
+        None resolves the verb's deadline class. Raises WorkerGone on a
+        dead connection, TimeoutError past the deadline (emitting a
+        structured ``rpc_timeout`` event and recycling the connection
+        after _WEDGE_TIMEOUTS consecutive ones), RuntimeError on an
+        error reply."""
         if not self.alive:
             raise WorkerGone("connection closed")
+        if timeout is None:
+            timeout = self.resolve_deadline(verb)
         rid = next(self._ids)
         waiter = {"evt": threading.Event(), "reply": None}
         with self._plock:
@@ -129,8 +180,9 @@ class WorkerClient:
         msg.update(kw)
         try:
             with self._wlock:
-                send_frame(self.sock, msg, blob)
-        except OSError as e:
+                send_frame(self.sock, msg, blob, chaos=self.chaos,
+                           verb=verb, direction="send")
+        except (OSError, ConnectionError) as e:
             with self._plock:
                 self._pending.pop(rid, None)
             raise WorkerGone(str(e))
@@ -139,7 +191,22 @@ class WorkerClient:
                 self._pending.pop(rid, None)
             if not self.alive:
                 raise WorkerGone("connection lost mid-RPC")
-            raise TimeoutError(f"worker RPC {verb!r} timed out")
+            self._consec_timeouts += 1
+            telemetry.log_event("rpc_timeout", level="warning",
+                                verb=verb, replica=self.replica,
+                                timeout_s=round(float(timeout), 3),
+                                consecutive=self._consec_timeouts)
+            if self.on_timeout is not None:
+                self.on_timeout(verb, float(timeout))
+            if self._consec_timeouts >= _WEDGE_TIMEOUTS:
+                # The socket is open but mute — a wedged connection.
+                # Close it: the reader's on_lost runs the reconnect
+                # path (the process is alive), not a worker restart.
+                self.lost_reason = self.lost_reason or "wedged"
+                self.close()
+            raise TimeoutError(f"worker RPC {verb!r} timed out "
+                               f"after {timeout:.1f}s")
+        self._consec_timeouts = 0
         reply = waiter["reply"]
         if reply is None or not reply[0].get("ok", False):
             err = (reply[0].get("error", "worker error") if reply
@@ -163,6 +230,15 @@ class WorkerClient:
                 if waiter is not None:
                     waiter["reply"] = (obj, blob)
                     waiter["evt"].set()
+        except FrameError as e:
+            # Malformed frame (desync, truncation, checksum, garbage
+            # lengths): the stream cannot be trusted past this point —
+            # recycle the connection; the process itself may be fine.
+            self.lost_reason = self.lost_reason or "frame_error"
+            telemetry.log_event("frame_error", level="warning",
+                                replica=self.replica,
+                                reason=getattr(e, "reason", ""),
+                                error=str(e))
         except (ConnectionError, OSError, json.JSONDecodeError):
             pass
         finally:
@@ -240,7 +316,7 @@ class _Tracked:
     __slots__ = ("template", "on_token", "on_finish", "worker", "client",
                  "generation", "attempts", "tokens", "seq_local",
                  "resume_stream_len", "t_submit", "handoff_blob",
-                 "handoff_meta")
+                 "handoff_meta", "failed_workers")
 
     def __init__(self, template: Sequence, on_token, on_finish):
         self.template = template
@@ -267,6 +343,12 @@ class _Tracked:
         # past the export, resubmission falls back to recompute-resume.
         self.handoff_blob: Optional[bytes] = None
         self.handoff_meta: Optional[dict] = None
+        # Poison-quarantine evidence: replica indices whose worker this
+        # request's attempts CRASHED or WEDGED (not mere step errors —
+        # those retry via the normal path). At poison_max_workers
+        # distinct victims the request is failed terminally instead of
+        # marching through the fleet.
+        self.failed_workers: set = set()
 
 
 class _EngineInfo:
@@ -346,6 +428,35 @@ class ProcessEngineGroup:
         # no adopter) instead of adopting cleanly.
         self.pd_handoffs = 0
         self.pd_handoff_recomputes = 0
+        # Byzantine-transport counters (README "Failure model"):
+        # connection-level failovers (reconnect+resync, no restart),
+        # structured RPC deadline hits, malformed frames the router
+        # rejected, corrupt KV blobs rejected router-side, and
+        # poison-quarantined requests.
+        self.reconnects = 0
+        self.rpc_timeouts = 0
+        self.frame_errors = 0
+        self.kv_rejections = 0
+        self.poison_requests = 0
+        # Per-verb deadline classes every RPC site resolves through
+        # (satellite: the blanket-60 s audit).
+        self._deadlines = {"fast": cfg.server.rpc_deadline_fast_s,
+                           "slow": cfg.server.rpc_deadline_slow_s}
+        # Transport chaos policy (config knobs now, /debug/chaos rpc
+        # updates later). One ChaosPolicy per replica so the wedge
+        # targets exactly chaos_rpc_wedge_replica and per-replica seeds
+        # decorrelate; wedge_spent on the policy makes the wedge
+        # one-shot across that replica's reconnects.
+        self._chaos_rpc_kw = self._chaos_kw_from_cfg(cfg.server)
+        self._chaos_policies: Dict[int, ChaosPolicy] = {}
+        # Router-side crash flight recorder: poison quarantines and
+        # corrupt-blob rejections capture the router's view (replica -1
+        # under the shared blackbox dir) so the offending payload's
+        # metadata survives for postmortem.
+        self._flight = telemetry.attach_router_flight_recorder(
+            cfg.server.blackbox_dir,
+            retain=cfg.server.blackbox_retain,
+            stats_fn=self.supervision_counters)
         # Elastic fleet (README "Elastic fleet"): autoscaler, rolling
         # upgrades, and per-class admission state.
         self.scale_ups = 0
@@ -457,6 +568,33 @@ class ProcessEngineGroup:
                   "export, no adopter, or a worker-side adoption "
                   "failure) instead of a clean adoption",
                   fn=self._pd_recomputes_total)
+        r.counter("tpu_inf_worker_reconnects_total",
+                  "Connection-level failovers: the socket died or a "
+                  "frame was invalid while the worker process stayed "
+                  "up, so the router reconnected and resynced instead "
+                  "of paying a restart",
+                  fn=lambda: self.reconnects)
+        r.counter("tpu_inf_rpc_timeouts_total",
+                  "Worker RPCs that exceeded their per-verb deadline "
+                  "class (each also emits a structured rpc_timeout "
+                  "event with verb + replica)",
+                  fn=lambda: self.rpc_timeouts)
+        r.counter("tpu_inf_frame_errors_total",
+                  "Malformed RPC frames the router rejected (bad "
+                  "magic/CRC/length) — each one recycles its "
+                  "connection",
+                  fn=lambda: self.frame_errors)
+        r.counter("tpu_inf_kv_integrity_rejections_total",
+                  "Corrupt KV blobs rejected by digest verification "
+                  "(router gate + worker adopt/import paths); every "
+                  "rejection fell back to recompute-resume, never a "
+                  "silent adoption",
+                  fn=self._kv_rejections_total)
+        r.counter("tpu_inf_poison_requests_total",
+                  "Requests quarantined after crashing or wedging "
+                  "poison_max_workers distinct workers (terminal "
+                  "structured 500 + router blackbox capture)",
+                  fn=lambda: self.poison_requests)
         self._pd_handoff_s_hist = r.histogram(
             "tpu_inf_pd_handoff_seconds",
             "Prefill->decode handoff wall: worker-side KV export + "
@@ -525,6 +663,48 @@ class ProcessEngineGroup:
                 fn=lambda hh=h: float(hh.state == QUARANTINED),
                 replica=str(h.replica))
 
+    def _kv_rejections_total(self) -> int:
+        """Router-side rejections plus every worker's adopt/import
+        rejections (healthz-cached; live counts, no carry needed —
+        a corrupt blob implies a live incarnation that rejected it)."""
+        return self.kv_rejections + sum(
+            (h.last_health or {}).get("kv_integrity_rejections", 0)
+            for h in self.workers)
+
+    @staticmethod
+    def _chaos_kw_from_cfg(s) -> dict:
+        return {"seed": s.chaos_rpc_seed,
+                "corrupt_rate": s.chaos_rpc_corrupt_rate,
+                "drop_rate": s.chaos_rpc_drop_rate,
+                "delay_rate": s.chaos_rpc_delay_rate,
+                "delay_s": s.chaos_rpc_delay_s,
+                "truncate_rate": s.chaos_rpc_truncate_rate,
+                "wedge_after": s.chaos_rpc_wedge_after,
+                "wedge_replica": s.chaos_rpc_wedge_replica,
+                "verbs": tuple(s.chaos_rpc_verbs),
+                "direction": s.chaos_rpc_direction}
+
+    def _make_chaos(self, replica: int) -> Optional[ChaosTransport]:
+        """Router-side chaos shim for one worker connection. The policy
+        persists per replica (wedge_spent survives reconnects — the
+        wedge is one-shot by design); each connection gets a fresh
+        transport over it. None when chaos is off or aimed only at the
+        worker->router direction."""
+        kw = dict(self._chaos_rpc_kw)
+        if kw["direction"] not in ("send", "both"):
+            return None
+        wedge_after = kw.pop("wedge_after")
+        wedge = wedge_after if kw.pop("wedge_replica") == replica else 0
+        pol = self._chaos_policies.get(replica)
+        if pol is None:
+            pol = ChaosPolicy(wedge_after=wedge, **kw)
+            pol.seed += replica  # decorrelate per-worker schedules
+            if pol.active:
+                self._chaos_policies[replica] = pol
+        if not pol.active:
+            return None
+        return ChaosTransport(pol)
+
     def _live_workers(self) -> List[WorkerHandle]:
         """Workers that count toward fleet size: everything except the
         intentionally-retired (scale-down/rollout) and the crash-loop
@@ -592,10 +772,15 @@ class ProcessEngineGroup:
             proc.stdin.write(json.dumps(
                 self._envelope(h.replica)).encode())
             proc.stdin.close()
-            client = WorkerClient(h.socket_path, proc)
+            client = WorkerClient(h.socket_path, proc,
+                                  replica=h.replica,
+                                  deadlines=self._deadlines,
+                                  chaos=self._make_chaos(h.replica))
             client.on_event = lambda c, obj, blob, hh=h: self._on_event(
                 hh, c, obj, blob)
             client.on_lost = lambda c, hh=h: self._on_conn_lost(hh, c)
+            client.on_timeout = \
+                lambda verb, t, hh=h: self._note_rpc_timeout(hh, verb, t)
             client.start_reader()
             hello = client.rpc("hello", timeout=1800.0)
         except BaseException:
@@ -731,13 +916,10 @@ class ProcessEngineGroup:
             if h.state != UP or h.client is None:
                 continue
             try:
-                h.last_metrics = h.client.rpc(
-                    "metrics", timeout=10.0)["samples"]
-                h.last_stats = h.client.rpc(
-                    "stats", timeout=10.0)["stats"]
-                h.last_health = h.client.rpc("healthz", timeout=10.0)
-                h.last_steps = h.client.rpc(
-                    "steps", timeout=10.0)["steps"]
+                h.last_metrics = h.client.rpc("metrics")["samples"]
+                h.last_stats = h.client.rpc("stats")["stats"]
+                h.last_health = h.client.rpc("healthz")
+                h.last_steps = h.client.rpc("steps")["steps"]
             except (WorkerGone, TimeoutError, RuntimeError):
                 pass
 
@@ -767,13 +949,143 @@ class ProcessEngineGroup:
         h.restart_at = time.monotonic() + backoff
         h.state = RESTARTING
 
+    def _note_rpc_timeout(self, h: WorkerHandle, verb: str,
+                          timeout_s: float) -> None:
+        with self._lock:
+            self.rpc_timeouts += 1
+
     def _on_conn_lost(self, h: WorkerHandle, client: WorkerClient) -> None:
         if self._stopping or h.client is not client:
+            return
+        if getattr(client, "lost_reason", "") == "frame_error":
+            with self._lock:
+                self.frame_errors += 1
+        # Distinguish "socket died / frame invalid" from "process
+        # died": while the worker process is alive and serving, a
+        # broken connection is a transport fault — pay a reconnect
+        # (worker.serve accepts again on the same socket path), not a
+        # full restart with its boot + warmup bill.
+        if (h.state == UP and h.proc is not None
+                and h.proc.poll() is None):
+            threading.Thread(target=self._reconnect_worker,
+                             args=(h, client),
+                             name="fleet-reconnect",
+                             daemon=True).start()
             return
         # Reader died first (socket reset); the monitor would catch the
         # process exit too — whoever flips the state first acts.
         if h.state in (UP, DRAINING):
             self._on_worker_down(h, "connection lost")
+
+    def _reconnect_worker(self, h: WorkerHandle,
+                          old_client: WorkerClient) -> None:
+        """Connection-level failover: dial the live worker again, swap
+        the client under the lock, then resync every request that was
+        riding the dead connection. Falls back to the full worker-down
+        path if the redial fails (the process may have died between
+        poll() and connect)."""
+        with self._lock:
+            if h.client is not old_client or h.state != UP:
+                return  # another actor (restart/rollout) already won
+        old_client.close()
+        try:
+            client = WorkerClient(h.socket_path, h.proc,
+                                  connect_timeout=5.0,
+                                  replica=h.replica,
+                                  deadlines=self._deadlines,
+                                  chaos=self._make_chaos(h.replica))
+            client.on_event = lambda c, obj, blob, hh=h: self._on_event(
+                hh, c, obj, blob)
+            client.on_lost = lambda c, hh=h: self._on_conn_lost(hh, c)
+            client.on_timeout = \
+                lambda verb, t, hh=h: self._note_rpc_timeout(hh, verb, t)
+            client.start_reader()
+            client.rpc("hello")
+        except (WorkerGone, TimeoutError, RuntimeError, OSError) as e:
+            telemetry.log_event("worker_reconnect_failed",
+                                level="warning", replica=h.replica,
+                                reason=getattr(old_client,
+                                               "lost_reason", ""),
+                                error=str(e))
+            if h.state in (UP, DRAINING):
+                self._on_worker_down(h, f"reconnect failed: {e}")
+            return
+        with self._lock:
+            if h.client is not old_client or h.state != UP:
+                client.close()
+                return
+            # Re-resolve chaos at swap time: /debug/chaos may have
+            # retuned (e.g. disarmed) the injection while this redial
+            # was in flight — installing the policy read at dial time
+            # would resurrect a stale fault schedule on the fresh
+            # connection.
+            client.chaos = self._make_chaos(h.replica)
+            h.client = client
+            self.reconnects += 1
+        telemetry.log_event("worker_reconnect", level="warning",
+                            replica=h.replica,
+                            reason=getattr(old_client, "lost_reason",
+                                           "") or "connection lost")
+        self._resync_worker(h, old_client)
+
+    def _resync_worker(self, h: WorkerHandle,
+                       old_client: WorkerClient) -> None:
+        """Requests that were streaming over the dead connection:
+        cancel the worker-side ghost (idempotent — the attempt may
+        still be decoding into the void) and re-dispatch from the
+        router's token record, preferring the SAME worker (its KV
+        pages are warm); recompute-resume keeps the stream
+        byte-identical under greedy."""
+        with self._lock:
+            victims = [e for e in self._tracked.values()
+                       if e.worker is h and e.client is old_client]
+            for entry in victims:
+                entry.generation += 1
+                entry.worker = entry.client = None
+                entry.attempts += 1
+                self.retries_attempted += 1
+        for entry in victims:
+            rid = entry.template.request_id
+            if h.client is not None and h.client.alive:
+                try:
+                    h.client.rpc("cancel", rid=rid,
+                                 idem=f"c{rid}.{entry.generation}")
+                except (WorkerGone, TimeoutError, RuntimeError):
+                    pass
+            if self._quarantine_if_poison(entry):
+                continue
+            if h.routable and self._dispatch(entry, h, (0, 0)):
+                continue
+            self._retry_or_fail(entry, exclude=h)
+
+    def _quarantine_if_poison(self, entry: _Tracked) -> bool:
+        """Poison-request gate (README "Failure model"): once this
+        request's attempts have crashed or wedged poison_max_workers
+        DISTINCT workers, fail it terminally — a structured 500 with a
+        blackbox capture — instead of feeding it the rest of the
+        fleet. Returns True when the request was quarantined."""
+        limit = self.server_cfg.poison_max_workers
+        if limit <= 0 or len(entry.failed_workers) < limit:
+            return False
+        rid = entry.template.request_id
+        with self._lock:
+            if self._tracked.pop(rid, None) is None:
+                return True  # already finished/quarantined elsewhere
+            self.poison_requests += 1
+        telemetry.log_event(
+            "poison_quarantined", level="error",
+            request_id=entry.template.trace_id or str(rid),
+            workers=sorted(entry.failed_workers),
+            attempts=entry.attempts, streamed=len(entry.tokens))
+        if self._flight is not None:
+            self._flight.capture("poison_request", min_interval_s=0.0)
+        self._finish_trace(entry, "poison")
+        ghost = entry.seq_local
+        ghost.generated = list(entry.tokens)
+        ghost.done, ghost.finish_reason = True, "poison"
+        ghost.finish_time = time.perf_counter()
+        entry.on_finish(ghost)
+        return True
 
     def _on_worker_down(self, h: WorkerHandle, reason: str) -> None:
         """A worker incarnation died (kill -9, crash, or post-drain
@@ -1264,27 +1576,44 @@ class ProcessEngineGroup:
                 entry.handoff_blob = entry.handoff_meta = None
                 with self._lock:
                     self.pd_handoff_recomputes += 1
+        # Idempotency token, unique per dispatch attempt: a duplicate
+        # submit frame (retry over a fresh connection after a lost ack)
+        # replays the recorded ack instead of admitting a second live
+        # attempt.
+        idem = f"s{t.request_id}.{entry.attempts}.{entry.generation}"
         try:
-            h.client.rpc("submit", timeout=60.0, seq=payload, blob=blob)
+            h.client.rpc("submit", seq=payload, blob=blob, idem=idem)
             return True
+        except (WorkerGone, RuntimeError) as e:
+            telemetry.log_event(
+                "dispatch_refused", level="warning", replica=h.replica,
+                request_id=t.request_id, error=str(e) or type(e).__name__)
+            return False
         except TimeoutError:
-            # The RPC may still be QUEUED behind a busy reader thread:
-            # without a cancel the worker would eventually execute it
-            # and decode a ghost alongside the re-routed copy. Best
-            # effort — if the worker is truly dead the cancel fails too.
+            # The worker wedged with this attempt (or the RPC is still
+            # QUEUED behind a busy reader): count the victim toward the
+            # poison gate, and cancel so the worker cannot later decode
+            # a ghost alongside the re-routed copy. Best effort — if
+            # the worker is truly dead the cancel fails too.
+            entry.failed_workers.add(h.replica)
             try:
-                h.client.rpc("cancel", timeout=5.0,
-                             rid=t.request_id)
+                h.client.rpc("cancel", timeout=5.0, rid=t.request_id,
+                             idem=f"c{idem}")
             except (WorkerGone, TimeoutError, RuntimeError):
                 pass
-            return False
-        except (WorkerGone, RuntimeError):
             return False
 
     def _retry_or_fail(self, entry: _Tracked,
                        exclude: Optional[WorkerHandle] = None) -> None:
         """Re-route one attempt after a refused/failed dispatch; fail
-        cleanly when no worker remains."""
+        cleanly when no worker remains.
+
+        An empty pool or a refused dispatch is often a transient gap,
+        not an outage — the target's connection is mid-reconnect after
+        a wedge recycle, or the supervisor is restarting the process.
+        Re-pick inside a short grace window before declaring the fleet
+        unavailable; each round re-checks the claim so a competing
+        failover path never double-runs the request."""
         if exclude is not None:
             with self._lock:
                 if entry.worker is not exclude:
@@ -1294,16 +1623,31 @@ class ProcessEngineGroup:
                     # again here would run the request twice.
                     return
                 entry.worker = entry.client = None
-        phase = self._entry_phase(entry)
-        pool = [h for h in self._phase_pool(phase) if h is not exclude]
-        if not pool:
-            pool = ([h for h in self._routable() if h is not exclude]
-                    or self._routable())
-        if pool:
-            h, hit, _ = self._pick(pool, entry.template, phase=phase)
-            if self._dispatch(entry, h, hit):
+        last = exclude
+        deadline = time.monotonic() + _REROUTE_GRACE_S
+        while not self._stopping:
+            if self._quarantine_if_poison(entry):
                 return
+            phase = self._entry_phase(entry)
+            pool = [h for h in self._phase_pool(phase) if h is not last]
+            if not pool:
+                pool = ([h for h in self._routable() if h is not last]
+                        or self._routable())
+            if pool:
+                h, hit, _ = self._pick(pool, entry.template, phase=phase)
+                if self._dispatch(entry, h, hit):
+                    return
+                with self._lock:
+                    if entry.worker is not h:
+                        return      # a competing path took over
+                    entry.worker = entry.client = None
+                last = h
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.25)
         rid = entry.template.request_id
+        telemetry.log_event("request_unavailable", level="warning",
+                            request_id=rid, attempts=entry.attempts)
         with self._lock:
             self._tracked.pop(rid, None)
         self._finish_trace(entry, "unavailable")
@@ -1327,7 +1671,8 @@ class ProcessEngineGroup:
             # block on a slow worker; a lost cancel only costs the
             # worker a few wasted tokens before its own reap.
             try:
-                client.rpc("cancel", timeout=10.0, rid=request_id)
+                client.rpc("cancel", rid=request_id,
+                           idem=f"c{request_id}.x")
             except (WorkerGone, TimeoutError, RuntimeError):
                 pass
 
@@ -1371,7 +1716,26 @@ class ProcessEngineGroup:
             if entry is None:
                 return
             tok = int(obj["t"])
-            entry.tokens.append(tok)
+            k = obj.get("k")
+            if k is not None and int(k) != len(entry.tokens):
+                # Stream-index gap: a frame went missing (or arrived
+                # twice) between this worker and us. Appending would
+                # silently corrupt the completion — recycle the
+                # connection instead and let resync re-route the
+                # request from its last known-good prefix.
+                client.lost_reason = client.lost_reason or "stream_gap"
+                bad = client
+            else:
+                bad = None
+                entry.tokens.append(tok)
+        if bad is not None:
+            telemetry.log_event(
+                "stream_gap", level="error", replica=h.replica,
+                request_id=obj["rid"], expected=len(entry.tokens),
+                got=int(k))
+            bad.close()
+            return
+        with self._lock:
             meta = entry.handoff_meta
             if (entry.handoff_blob is not None and meta is not None
                     and len(entry.tokens) > meta["n_generated"]):
@@ -1467,6 +1831,26 @@ class ProcessEngineGroup:
                 sl.first_token_time - float(obj["prefill_s"]))
         entry.on_finish(sl)
 
+    def _checked_blob(self, blob: bytes, path: str, rid: int) -> bytes:
+        """Gate a KV blob on its end-to-end digest before it can be
+        re-dispatched or imported. A corrupt blob is rejected AND
+        counted — never adopted silently — and the caller falls back to
+        recompute-resume from the router's token record
+        (byte-identical under greedy), exactly like a missing blob."""
+        if not blob:
+            return blob
+        err = kvc.verify_host_pages_blob(blob)
+        if err is None:
+            return blob
+        with self._lock:
+            self.kv_rejections += 1
+        telemetry.log_event(
+            "kv_blob_rejected", level="error", path=path,
+            request_id=rid, bytes=len(blob), error=err)
+        if self._flight is not None:
+            self._flight.capture("kv_corruption", min_interval_s=0.0)
+        return b""
+
     def _on_handoff(self, h, client, obj, blob) -> None:
         """A prefill worker settled a prompt's prefill and exported the
         LIVE sequence (README "P/D disaggregation"): KV pages including
@@ -1490,6 +1874,7 @@ class ProcessEngineGroup:
         n_gen = int(obj.get("n_generated", 0))
         entry.handoff_meta = {"ctx_len": int(obj.get("ctx_len", 0)),
                               "n_generated": n_gen}
+        blob = self._checked_blob(blob, "handoff", rid)
         entry.handoff_blob = blob or None
         if n_gen != len(entry.tokens):
             # Out of sync with the export (events are FIFO per
@@ -1566,6 +1951,7 @@ class ProcessEngineGroup:
                 request_id=entry.template.trace_id or str(rid),
                 worker_generated=n_gen, router_streamed=len(entry.tokens))
         digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
+        blob = self._checked_blob(blob, "migrate", rid)
         phase = self._entry_phase(entry)
         others = ([w for w in self._phase_pool(phase) if w is not h]
                   or [w for w in self._routable() if w is not h])
@@ -1579,8 +1965,10 @@ class ProcessEngineGroup:
         if (blob and digests and self.server_cfg.fleet_migrate
                 and dest.client is not None):
             try:
-                r = dest.client.rpc("import-kv", timeout=60.0, blob=blob,
-                                    digests=[d.hex() for d in digests])
+                r = dest.client.rpc(
+                    "import-kv", blob=blob,
+                    digests=[d.hex() for d in digests],
+                    idem=f"i{rid}.{entry.generation}")
                 with self._lock:
                     self.migrated_pages += int(r.get("adopted", 0))
                     self.migrated_bytes += len(blob)
@@ -1636,9 +2024,12 @@ class ProcessEngineGroup:
                 # against a racing migrate-event handler.
                 e.worker = e.client = None
                 e.attempts += 1
+                e.failed_workers.add(h.replica)
                 self.retries_attempted += 1
                 self.failovers += 1
         for entry in victims:
+            if self._quarantine_if_poison(entry):
+                continue
             phase = self._entry_phase(entry)
             others = ([w for w in self._phase_pool(phase) if w is not h]
                       or [w for w in self._routable() if w is not h])
@@ -1671,7 +2062,31 @@ class ProcessEngineGroup:
         ``{"replica": i, "kill": "kill9"}`` SIGKILLs the worker process
         (supervisor restarts it; in-flight requests fail over from the
         router's token record) and ``{"kill": "sigterm"}`` triggers the
-        graceful drain-and-migrate path."""
+        graceful drain-and-migrate path. ``{"rpc": {...}}`` retunes the
+        router<->worker frame-level fault injection (transport chaos)
+        at runtime: the kwargs mirror the --chaos-rpc-* knobs, apply to
+        every subsequently sent frame on both sides, and reset the
+        per-replica deterministic schedules."""
+        rpc = body.get("rpc")
+        if rpc is not None:
+            for k, v in dict(rpc).items():
+                if k in self._chaos_rpc_kw and v is not None:
+                    self._chaos_rpc_kw[k] = (tuple(v) if k == "verbs"
+                                             else v)
+            with self._lock:
+                # Drop cached policies so new rates rebuild the
+                # deterministic schedule from frame 0 (and a re-armed
+                # wedge can fire again).
+                self._chaos_policies.clear()
+            for h in self.workers:
+                if h.client is not None and h.client.alive:
+                    h.client.chaos = self._make_chaos(h.replica)
+                    try:
+                        h.client.rpc("chaos", rpc=dict(rpc))
+                    except (WorkerGone, TimeoutError, RuntimeError):
+                        pass
+            return {"rpc": {k: (list(v) if isinstance(v, tuple) else v)
+                            for k, v in self._chaos_rpc_kw.items()}}
         kill = body.get("kill")
         if kill is not None:
             if kill not in ("kill9", "sigkill", "sigterm", "drain"):
@@ -1699,8 +2114,7 @@ class ProcessEngineGroup:
             if h.client is not None and h.client.alive:
                 try:
                     state = h.client.rpc(
-                        "chaos", timeout=10.0,
-                        **(fields if h in targets else {}))
+                        "chaos", **(fields if h in targets else {}))
                     state = {k: v for k, v in state.items()
                              if k not in ("id", "ok")}
                 except (WorkerGone, TimeoutError, RuntimeError):
@@ -1716,7 +2130,7 @@ class ProcessEngineGroup:
         if h.client is None:
             raise ValueError(f"worker {replica} not running")
         kw = {} if migrate is None else {"migrate": migrate}
-        h.client.rpc("drain", timeout=30.0, **kw)
+        h.client.rpc("drain", **kw)
 
     # --------------------------------------------------- elastic fleet
 
@@ -2019,6 +2433,12 @@ class ProcessEngineGroup:
                 "class_shed": dict(self.class_shed),
                 "class_deferred": {c: len(q)
                                    for c, q in self._deferred.items()},
+                # Byzantine transport (README "Failure model").
+                "worker_reconnects": self.reconnects,
+                "rpc_timeouts": self.rpc_timeouts,
+                "frame_errors": self.frame_errors,
+                "kv_integrity_rejections": self._kv_rejections_total(),
+                "poison_requests": self.poison_requests,
             }
 
     def health_snapshot(self) -> dict:
@@ -2027,7 +2447,7 @@ class ProcessEngineGroup:
             hz = dict(h.last_health) if h.state == UP else {}
             if h.state == UP and h.client is not None:
                 try:
-                    hz = h.client.rpc("healthz", timeout=10.0)
+                    hz = h.client.rpc("healthz")
                     hz.pop("id", None), hz.pop("ok", None)
                     h.last_health = hz
                 except (WorkerGone, TimeoutError, RuntimeError):
@@ -2048,7 +2468,8 @@ class ProcessEngineGroup:
                       "load", "draining", "host_cache",
                       "swap_in_resumes", "prefill_backlog",
                       "ladder_occupancy", "pd_handoffs", "pd_adoptions",
-                      "pd_adopt_fallbacks", "slo"):
+                      "pd_adopt_fallbacks", "slo",
+                      "kv_integrity_rejections"):
                 if k in hz:
                     d[k] = hz[k]
             replicas.append(d)

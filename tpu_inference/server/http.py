@@ -950,6 +950,17 @@ class InferenceServer:
                     return await finish(stopped=True)
                 await write_line(emit)
             else:
+                if payload.finish_reason == "poison" and not prepared:
+                    # Terminal quarantine: this request crashed/wedged
+                    # poison_max_workers distinct workers. A structured
+                    # 500 WITHOUT Retry-After — resubmitting it would
+                    # only burn more of the fleet (README "Failure
+                    # model").
+                    raise web.HTTPInternalServerError(
+                        text=json.dumps({
+                            "error": "request quarantined as poison",
+                            "request_id": seq.trace_id}),
+                        content_type="application/json")
                 if (payload.finish_reason in ("error", "unavailable")
                         and not consumed and not prepared):
                     # The replica died (or was quarantined) before a
@@ -1014,6 +1025,15 @@ class InferenceServer:
                     self.group.cancel(seq.request_id)
                     return respond(seq, stopped=True)
             else:
+                if payload.finish_reason == "poison":
+                    # Terminal quarantine (mirrors the streaming path):
+                    # structured 500, no Retry-After — the request
+                    # itself is the fault, not the fleet's state.
+                    raise web.HTTPInternalServerError(
+                        text=json.dumps({
+                            "error": "request quarantined as poison",
+                            "request_id": seq.trace_id}),
+                        content_type="application/json")
                 if (payload.finish_reason in ("error", "unavailable")
                         and not consumed):
                     # Replica failure before any token, failover budget

@@ -181,6 +181,10 @@ class _Tracked:
     attempts: int = 0                   # failover resubmissions so far
     generation: int = 0                 # bumped to orphan stale callbacks
     t_submit: float = 0.0               # perf_counter at submit (root span)
+    # DISTINCT replica indices whose attempt at this request errored or
+    # wedged — the poison-quarantine gate's evidence (README "Failure
+    # model").
+    failed_replicas: set = dataclasses.field(default_factory=set)
 
 
 class EngineGroup:
@@ -220,6 +224,7 @@ class EngineGroup:
         self.failovers = 0              # stranded-by-wedge resubmissions
         self.requests_shed = 0          # 429: queue cap
         self.requests_unavailable = 0   # 503: no routable replica
+        self.poison_requests = 0        # terminally quarantined (500)
         # Routing accounting. The rotation counter advances once per
         # tie-broken decision; the counters move on every dispatch
         # (initial or failover). Plain ints mutated from HTTP/engine
@@ -266,6 +271,15 @@ class EngineGroup:
         r.counter("tpu_inf_requests_unavailable_total",
                   "Requests rejected with no routable replica (HTTP 503)",
                   fn=lambda: self.requests_unavailable)
+        r.counter("tpu_inf_poison_requests_total",
+                  "Requests quarantined after crashing/wedging "
+                  "poison_max_workers distinct replicas (HTTP 500)",
+                  fn=lambda: self.poison_requests)
+        r.counter("tpu_inf_kv_integrity_rejections_total",
+                  "KV blobs rejected on a failed end-to-end digest "
+                  "check (recompute fallback, never adopted silently)",
+                  fn=lambda: sum(e.kv_integrity_rejections
+                                 for e in self.engines))
         r.counter("tpu_inf_route_prefix_hits_total",
                   "Dispatches routed with a non-zero prefix-cache peek "
                   "(the request landed on a warm replica)",
@@ -678,7 +692,14 @@ class EngineGroup:
         with self._lock:
             if entry.generation != gen:     # stranded failover took over
                 return
-            retryable = (seq.finish_reason in _RETRYABLE
+            if seq.finish_reason in _RETRYABLE:
+                entry.failed_replicas.add(
+                    self.schedulers.index(entry.sched))
+            limit = self.server_cfg.poison_max_workers
+            poison = (seq.finish_reason in _RETRYABLE and limit > 0
+                      and len(entry.failed_replicas) >= limit)
+            retryable = (not poison
+                         and seq.finish_reason in _RETRYABLE
                          and entry.delivered == 0
                          and entry.attempts
                          < self.server_cfg.failover_max_retries)
@@ -690,11 +711,23 @@ class EngineGroup:
                 self.retries_attempted += 1
             else:
                 self._tracked.pop(rid, None)
+                if poison:
+                    self.poison_requests += 1
                 if entry.attempts and seq.finish_reason in ("stop", "length"):
                     self.retries_succeeded += 1
         if target is not None:
             self._dispatch(entry, _clone_request(entry.template), *target)
             return
+        if poison:
+            # Every attempt errored a DIFFERENT replica: quarantine the
+            # request terminally (structured 500) before it burns the
+            # rest of the fleet.
+            telemetry.log_event(
+                "poison_quarantined", level="error",
+                request_id=entry.template.trace_id or str(rid),
+                replicas=sorted(entry.failed_replicas),
+                attempts=entry.attempts)
+            seq.finish_reason = "poison"
         self._finish_trace(entry, seq.finish_reason)
         entry.on_finish(seq)
 
@@ -725,12 +758,17 @@ class EngineGroup:
             # _attempt_finished): the generation bump atomically orphans
             # both late wake-up callbacks AND any _attempt_finished
             # racing from the wedged engine thread.
+            limit = self.server_cfg.poison_max_workers
             for rid, entry in list(self._tracked.items()):
                 if entry.sched is not sched:
                     continue
                 entry.generation += 1
+                entry.failed_replicas.add(self.schedulers.index(sched))
+                poison = (limit > 0
+                          and len(entry.failed_replicas) >= limit)
                 target = self._retry_target(sched, entry.template)
-                can_retry = (entry.delivered == 0
+                can_retry = (not poison
+                             and entry.delivered == 0
                              and entry.attempts
                              < self.server_cfg.failover_max_retries
                              and target is not None)
@@ -740,8 +778,10 @@ class EngineGroup:
                     self.failovers += 1
                 else:
                     self._tracked.pop(rid, None)
-                actions.append((rid, entry, can_retry, target))
-        for rid, entry, can_retry, target in actions:
+                    if poison:
+                        self.poison_requests += 1
+                actions.append((rid, entry, can_retry, target, poison))
+        for rid, entry, can_retry, target, poison in actions:
             sched.cancel(rid)               # reap-on-wake; frees queue slot
             telemetry.log_event(
                 "request_failover", level="warning",
@@ -750,9 +790,16 @@ class EngineGroup:
             if can_retry:
                 self._dispatch(entry, _clone_request(entry.template), *target)
             else:
+                if poison:
+                    telemetry.log_event(
+                        "poison_quarantined", level="error",
+                        request_id=entry.template.trace_id or str(rid),
+                        replicas=sorted(entry.failed_replicas),
+                        attempts=entry.attempts)
                 ghost = _clone_request(entry.template)
                 ghost.done = True
-                ghost.finish_reason = ("unavailable" if target is None
+                ghost.finish_reason = ("poison" if poison
+                                       else "unavailable" if target is None
                                        else "error")
                 ghost.finish_time = time.perf_counter()
                 self._finish_trace(entry, ghost.finish_reason)
@@ -827,6 +874,9 @@ class EngineGroup:
                 "failovers": self.failovers,
                 "requests_shed": self.requests_shed,
                 "requests_unavailable": self.requests_unavailable,
+                "poison_requests": self.poison_requests,
+                "kv_integrity_rejections": sum(
+                    e.kv_integrity_rejections for e in self.engines),
                 "route_prefix_hits": self.route_prefix_hits,
                 "route_cold": self.route_cold,
                 "preemptions": sum(e.preemptions_total

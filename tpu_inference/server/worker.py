@@ -6,7 +6,8 @@ is ``server/fleet.py``'s router). The worker owns exactly one dp replica
 batching scheduler thread — and serves a small length-prefixed JSON RPC
 over a local unix socket:
 
-    frame   = [u32 json_len][u32 blob_len][json][blob]
+    frame   = [u32 magic][u32 json_len][u32 blob_len][u32 crc32c]
+              [json][blob]                    (see server/transport.py)
     request = {"id": n, "verb": ..., ...}        -> {"id": n, "ok": ...}
     event   = {"ev": "token" | "finish" | "migrate" | "drained", ...}
 
@@ -38,6 +39,7 @@ frame codec without paying for jax; everything heavy loads inside
 from __future__ import annotations
 
 import argparse
+import itertools
 import json
 import os
 import queue
@@ -47,46 +49,25 @@ import struct
 import sys
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, Tuple
 
 # ---------------------------------------------------------------------------
-# Frame codec (shared with server/fleet.py)
+# Frame codec — ONE implementation, shared with server/fleet.py. Lives
+# in server/transport.py (checksummed v2 format + the chaos shim);
+# re-exported here because both the router and older tests import the
+# codec from this module.
 # ---------------------------------------------------------------------------
 
-# A frame larger than this is a protocol error, not a workload: the
-# biggest legitimate payload is a drain export of one sequence's pages
-# (max_pages_per_seq * page bytes, well under this).
-MAX_FRAME = 1 << 31
-
-
-def send_frame(sock: socket.socket, obj: Dict[str, Any],
-               blob: bytes = b"") -> None:
-    payload = json.dumps(obj).encode()
-    sock.sendall(struct.pack(">II", len(payload), len(blob))
-                 + payload + blob)
-
-
-def _read_exact(rfile, n: int) -> bytes:
-    data = rfile.read(n)
-    if data is None or len(data) < n:
-        raise ConnectionError("peer closed mid-frame")
-    return data
-
-
-def recv_frame(rfile) -> Tuple[Dict[str, Any], bytes]:
-    """Read one frame from a buffered reader (``sock.makefile('rb')``).
-    Raises ConnectionError at EOF."""
-    hdr = rfile.read(8)
-    if not hdr:
-        raise ConnectionError("peer closed")
-    if len(hdr) < 8:
-        raise ConnectionError("peer closed mid-header")
-    jlen, blen = struct.unpack(">II", hdr)
-    if jlen > MAX_FRAME or blen > MAX_FRAME:
-        raise ConnectionError(f"oversized frame ({jlen}+{blen} bytes)")
-    obj = json.loads(_read_exact(rfile, jlen).decode())
-    blob = _read_exact(rfile, blen) if blen else b""
-    return obj, blob
+from tpu_inference.integrity import KVIntegrityError  # noqa: E402
+from tpu_inference.server.transport import (  # noqa: F401,E402
+    MAX_FRAME,
+    ChaosPolicy,
+    ChaosTransport,
+    FrameError,
+    recv_frame,
+    send_frame,
+)
 
 
 class _Conn:
@@ -109,9 +90,13 @@ class _Conn:
         self._writer.start()
         self._reader.start()
 
-    def send(self, obj: Dict[str, Any], blob: bytes = b"") -> None:
+    def send(self, obj: Dict[str, Any], blob: bytes = b"",
+             verb: str = "") -> None:
+        """Queue one outbound frame. ``verb`` tags it for the chaos
+        shim's per-verb filter (reply frames carry their request verb,
+        events their event name)."""
         if self.alive:
-            self.outq.put((obj, blob))
+            self.outq.put((obj, blob, verb))
 
     def flush(self, timeout: float = 5.0) -> None:
         """Wait for every ALREADY-queued frame to finish its sendall
@@ -140,8 +125,12 @@ class _Conn:
                 item[1].set()
                 continue
             try:
-                send_frame(self.sock, item[0], item[1])
-            except OSError:
+                # Worker->router frames are the chaos shim's "recv"
+                # direction (named from the router's point of view).
+                send_frame(self.sock, item[0], item[1],
+                           chaos=self.worker.chaos_rpc,
+                           verb=item[2], direction="recv")
+            except (OSError, ConnectionError):
                 self.alive = False
                 return
 
@@ -188,6 +177,38 @@ class EngineWorker:
         # rid -> the connection that submitted it (migrate events go
         # back to the submitting router connection).
         self._req_conn: Dict[int, _Conn] = {}
+        # Byzantine-transport defenses (README "Failure model"):
+        # worker-side chaos shim for worker->router frames, the
+        # idempotency-replay cache (token -> recorded reply, so a verb
+        # retried over a new connection cannot double-apply). Corrupt-KV
+        # rejections count on engine.kv_integrity_rejections (healthz).
+        self.chaos_rpc = self._build_chaos_rpc()
+        self._idem: "OrderedDict[str, dict]" = OrderedDict()
+        self._idem_lock = threading.Lock()
+
+    def _build_chaos_rpc(self, over: Dict[str, Any] = None):
+        """Worker-side chaos transport from config knobs (+ runtime
+        overrides via the chaos verb). The wedge fault is router-side
+        only — its detection signal (per-verb RPC deadlines) lives in
+        the router, so the worker never arms ``wedge_after``."""
+        s = self.cfg.server
+        kw = {"seed": getattr(s, "chaos_rpc_seed", 0),
+              "corrupt_rate": getattr(s, "chaos_rpc_corrupt_rate", 0.0),
+              "drop_rate": getattr(s, "chaos_rpc_drop_rate", 0.0),
+              "delay_rate": getattr(s, "chaos_rpc_delay_rate", 0.0),
+              "delay_s": getattr(s, "chaos_rpc_delay_s", 0.02),
+              "truncate_rate": getattr(s, "chaos_rpc_truncate_rate", 0.0),
+              "verbs": getattr(s, "chaos_rpc_verbs", ()),
+              "direction": getattr(s, "chaos_rpc_direction", "both")}
+        for k, v in (over or {}).items():
+            if k in kw and v is not None:
+                kw[k] = tuple(v) if k == "verbs" else v
+        if kw["direction"] not in ("recv", "both"):
+            return None
+        # Decorrelate from the router side's schedule (seed + replica).
+        kw["seed"] = int(kw["seed"]) + 7919 * (self.replica + 1)
+        pol = ChaosPolicy(**kw)
+        return ChaosTransport(pol) if pol.active else None
 
     # ------------------------------------------------------------- boot
 
@@ -287,11 +308,12 @@ class EngineWorker:
             if conn in self._conns:
                 self._conns.remove(conn)
 
-    def _broadcast(self, obj: Dict[str, Any], blob: bytes = b"") -> None:
+    def _broadcast(self, obj: Dict[str, Any], blob: bytes = b"",
+                   verb: str = "") -> None:
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
-            c.send(obj, blob)
+            c.send(obj, blob, verb)
 
     # --------------------------------------------------------- dispatch
 
@@ -301,12 +323,34 @@ class EngineWorker:
     # behind a migration import or an embed batch on the same worker.
     _SLOW_VERBS = ("import_kv", "embed", "shutdown", "profile")
 
+    # Verbs with side effects the router may retry over a fresh
+    # connection: the idempotency token dedups exact duplicates so a
+    # retransmitted frame replays the recorded reply instead of
+    # re-applying (submit admitting a second live attempt, import-kv
+    # re-offering pages).
+    _IDEM_VERBS = ("submit", "cancel", "import_kv")
+    _IDEM_CAP = 512
+
     def handle(self, conn: _Conn, obj: Dict[str, Any],
                blob: bytes) -> None:
         rid = obj.get("id")
         verb = str(obj.get("verb")).replace("-", "_")
+        idem = obj.get("idem") if verb in self._IDEM_VERBS else None
 
         def run() -> None:
+            if idem is not None:
+                with self._idem_lock:
+                    prev = self._idem.get(idem)
+                if prev is not None:
+                    out = {"id": rid}
+                    out.update(prev)
+                    if verb == "submit" and "rid" in prev:
+                        # The first submit applied; rebind the stream
+                        # to the retrying connection so in-flight
+                        # tokens reach the live router socket.
+                        self._req_conn[int(prev["rid"])] = conn
+                    conn.send(out, verb=verb)
+                    return
             try:
                 fn = getattr(self, "_verb_" + verb, None)
                 if fn is None:
@@ -315,10 +359,17 @@ class EngineWorker:
                 if reply is not None:
                     out = {"id": rid, "ok": True}
                     out.update(reply)
-                    conn.send(out)
+                    if idem is not None and out.get("ok"):
+                        with self._idem_lock:
+                            self._idem[idem] = {k: v for k, v
+                                                in out.items()
+                                                if k != "id"}
+                            while len(self._idem) > self._IDEM_CAP:
+                                self._idem.popitem(last=False)
+                    conn.send(out, verb=verb)
             except Exception as e:  # noqa: BLE001 — RPC errors reply
                 conn.send({"id": rid, "ok": False, "error": str(e),
-                           "kind": type(e).__name__})
+                           "kind": type(e).__name__}, verb=verb)
 
         if verb in self._SLOW_VERBS:
             threading.Thread(target=run, name=f"worker-{verb}",
@@ -365,7 +416,8 @@ class EngineWorker:
                    "n_generated": len(seq.generated),
                    "ctx_len": ctx_len,
                    "export_s": round(time.perf_counter() - t0, 6),
-                   "digests": [d.hex() for d in digests]}, blob)
+                   "digests": [d.hex() for d in digests]}, blob,
+                  verb="handoff")
         return True
 
     def _verb_hello(self, conn, obj, blob) -> dict:
@@ -426,6 +478,10 @@ class EngineWorker:
             from tpu_inference.engine import kv_cache as kvc
             try:
                 pages = kvc.deserialize_host_pages(blob)
+            except KVIntegrityError:
+                # Corrupt blob: rejected AND counted — never adopted.
+                self.engine.kv_integrity_rejections += 1
+                pages = []
             except Exception:  # noqa: BLE001 — recompute-resume fallback
                 pages = []
             if pages:
@@ -439,10 +495,42 @@ class EngineWorker:
             # bouncing forever).
             seq.handoff_after_prefill = True
         rid = seq.request_id
+        # A resubmitted rid (router retry after a reconnect resync or a
+        # lost ack) must never leave TWO live attempts decoding the
+        # same request — cancel the ghost before admitting this one.
+        def _rid_live() -> bool:
+            with self.sched._lock:
+                return (rid in self.sched._callbacks or any(
+                    p.seq.request_id == rid
+                    for p in self.sched._waiting))
+
+        if _rid_live():
+            self.sched.cancel(rid)
+            # cancel() only FLAGS a running attempt done — the engine
+            # loop reaps it next tick. Admitting the same rid before
+            # the reap would leave two registered attempts: the ghost
+            # keeps streaming stale tokens through the new binding
+            # (the router sees a stream gap). Wait the reap out.
+            deadline = time.monotonic() + 5.0
+            while _rid_live() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            if _rid_live():
+                return {"error": f"request {rid} still draining "
+                                 "its previous attempt"}
         self._req_conn[rid] = conn
 
+        # "k" is the token's absolute stream index, counted here: the
+        # engine appends to seq.generated as it steps but may deliver
+        # several buffered tokens in one burst (e.g. after a batch-shape
+        # recompile), so len(generated)-1 at callback time would stamp
+        # the last index on every token of the burst. The counter starts
+        # at the resume prefix so a migrated/handoff resume continues
+        # the router's stream where it left off.
+        knext = itertools.count(len(seq.generated))
+
         def on_token(sq, tok: int) -> None:
-            conn.send({"ev": "token", "rid": rid, "t": int(tok)})
+            conn.send({"ev": "token", "rid": rid, "t": int(tok),
+                       "k": next(knext)}, verb="token")
 
         def on_finish(sq) -> None:
             self._req_conn.pop(rid, None)
@@ -456,7 +544,7 @@ class EngineWorker:
                 # handoff frame) ship on their own event instead.
                 if spans:
                     conn.send({"ev": "spans", "rid": rid, "trace": tid,
-                               "spans": spans})
+                               "spans": spans}, verb="spans")
                 return
             fin = sq.finish_time or time.perf_counter()
             first = sq.first_token_time or fin
@@ -475,7 +563,7 @@ class EngineWorker:
                 # router's trace assembly (README "Observability").
                 "trace": tid,
                 "spans": spans,
-            })
+            }, verb="finish")
 
         self.sched.submit(seq, on_token, on_finish)
         return {"rid": rid}
@@ -541,6 +629,9 @@ class EngineWorker:
             "pd_handoffs": self.sched.stats.pd_handoffs,
             "pd_adoptions": e.adoptions_in,
             "pd_adopt_fallbacks": e.adopt_fallbacks,
+            # Byzantine transport: corrupt KV blobs this worker
+            # rejected at adopt/import time (never adopted silently).
+            "kv_integrity_rejections": e.kv_integrity_rejections,
         }
         # Rolling SLO view (quantiles + breaches; windows stay in the
         # stats snapshot — healthz is the human-sized surface).
@@ -604,11 +695,19 @@ class EngineWorker:
             e.chaos_step_wedge_s = float(wedge)
         if pressure is not None:
             e.request_page_pressure(int(pressure))
+        rpc = obj.get("rpc")
+        if rpc is not None:
+            # Transport-level chaos (README "Failure model"): rebuild
+            # the worker-side shim; the router forwards the same knobs
+            # it applied to its own side.
+            self.chaos_rpc = self._build_chaos_rpc(rpc)
         t = e._pressure_target
         return {"step_failure_rate": e.chaos_step_failure_rate,
                 "step_wedge_s": e.chaos_step_wedge_s,
                 "page_pressure": (e.chaos_page_pressure if t is None
-                                  else t)}
+                                  else t),
+                "rpc": (self.chaos_rpc.policy.snapshot()
+                        if self.chaos_rpc is not None else None)}
 
     def _verb_embed(self, conn, obj, blob) -> dict:
         vecs = self.engine.embed_many([list(b) for b in obj["batch"]])
@@ -620,7 +719,15 @@ class EngineWorker:
         router's subsequent resubmit is guaranteed to see the pages."""
         from tpu_inference.engine import kv_cache as kvc
         digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
-        pages = kvc.deserialize_host_pages(blob) if blob else []
+        try:
+            pages = kvc.deserialize_host_pages(blob) if blob else []
+        except KVIntegrityError as e:
+            # Reject-and-count: a corrupt drain export must never land
+            # in the host tier; the router's resubmission falls back to
+            # recompute-resume (byte-identical under greedy).
+            self.engine.kv_integrity_rejections += 1
+            return {"offered": 0, "applied": False, "adopted": 0,
+                    "rejected": str(e)}
         n = min(len(digests), len(pages))
         before = self.engine.migrate_in_pages
         done = self.engine.request_import_host(
@@ -747,7 +854,7 @@ class EngineWorker:
                     if host_pages else b"")
             target = self._req_conn.get(seq.request_id)
             if target is not None and target.alive:
-                target.send(ev, blob)
+                target.send(ev, blob, verb="migrate")
                 migrated += 1
         self._broadcast({
             "ev": "drained", "replica": self.replica,
@@ -755,7 +862,7 @@ class EngineWorker:
             "stats": sched.stats.snapshot(engine),
             "metrics": telemetry.dump_registry(
                 engine.telemetry.registry),
-        })
+        }, verb="drained")
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:

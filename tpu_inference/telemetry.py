@@ -1687,6 +1687,24 @@ def attach_flight_recorder(tel: "EngineTelemetry", root_dir: str,
     return fr
 
 
+def attach_router_flight_recorder(
+        root_dir: str, *, retain: int = 8,
+        config: Optional[dict] = None,
+        stats_fn: Optional[Callable[[], dict]] = None,
+        spans_fn: Optional[Callable[[], list]] = None,
+        ) -> Optional[FlightRecorder]:
+    """Router-side (process-fleet) capture sink: replica -1, so its
+    ``replica--1/`` directory sorts apart from the workers' in the
+    shared blackbox root. Poison quarantines and corrupt-KV rejections
+    are router verdicts — the evidence (which workers failed, what the
+    supervision counters said) lives here, not in any one worker's
+    blackbox. No-op when the operator left ``blackbox_dir`` empty."""
+    if not root_dir:
+        return None
+    return FlightRecorder(root_dir, -1, retain=retain, config=config,
+                          spans_fn=spans_fn, stats_fn=stats_fn)
+
+
 # ---------------------------------------------------------------------------
 # Engine-side bundle
 # ---------------------------------------------------------------------------
