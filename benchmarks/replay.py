@@ -200,6 +200,8 @@ def start_server(args) -> tuple:
         enable_prefix_cache=getattr(args, "enable_prefix_cache", True),
         host_cache_pages=getattr(args, "host_cache_pages", 0),
         admission=getattr(args, "admission", "reserve"),
+        preempt_watermark_pages=getattr(
+            args, "preempt_watermark_pages", 4),
         # Rolling SLO targets (README "Observability"): feed the
         # windowed quantile gauges + breach counters the artifact and
         # the autoscaler read.
@@ -215,6 +217,17 @@ def start_server(args) -> tuple:
             "route_hit_weight": getattr(args, "route_hit_weight", 1.0),
             "route_host_hit_weight":
                 getattr(args, "route_host_hit_weight", 0.5),
+            # Fleet KV fabric (README "KV fabric"): shared cross-
+            # replica prefix pool + warm worker boot for the
+            # --compare-fabric arms.
+            "fabric_cache_pages":
+                getattr(args, "fabric_cache_pages", 0),
+            "fabric_publish_min_pages":
+                getattr(args, "fabric_publish_min_pages", 1),
+            "fabric_warmboot_pages":
+                getattr(args, "fabric_warmboot_pages", 64),
+            "route_fabric_hit_weight":
+                getattr(args, "route_fabric_hit_weight", 0.25),
             # Process fleet (README "Process fleet"): backend + worker
             # supervision knobs for the subprocess arms.
             "fleet": getattr(args, "fleet", "in-process"),
@@ -489,6 +502,44 @@ def main() -> dict:
     p.add_argument("--elastic-burst-batch", type=int, default=28,
                    help="compare-elastic: batch requests in the peak "
                         "wave (the lane the interactives preempt)")
+    p.add_argument("--compare-fabric", action="store_true",
+                   help="fleet-KV-fabric lane (README 'KV fabric'): "
+                        "many users sharing one long system prompt hit "
+                        "a dp=2 subprocess fleet three times — fabric "
+                        "off, fabric on, and fabric on with a mid-run "
+                        "scale-up whose new worker warm-boots from the "
+                        "pool — grading that the shared prefix is "
+                        "prefilled ONCE fleet-wide (a second replica's "
+                        "first turn is fabric-warm with zero recomputed "
+                        "prefix tokens), returning-turn TTFT p95 "
+                        "improves >=1.3x over fabric-off, the warmboot "
+                        "worker serves its first request with fabric-"
+                        "sourced warmth, and greedy outputs stay byte-"
+                        "identical across every arm")
+    p.add_argument("--fabric-users", type=int, default=10,
+                   help="compare-fabric: concurrent returning users in "
+                        "the graded wave (each prompt = shared system "
+                        "prompt + a distinct tail)")
+    p.add_argument("--fabric-wave2-users", type=int, default=14,
+                   help="compare-fabric: users in the second wave (the "
+                        "one that spills onto the warmboot worker in "
+                        "the scale-up arm)")
+    p.add_argument("--fabric-prefix-pages", type=int, default=9,
+                   help="compare-fabric: shared system-prompt length in "
+                        "full KV pages (page_size tokens each)")
+    p.add_argument("--fabric-tokens", type=int, default=8,
+                   help="compare-fabric: greedy generation budget per "
+                        "request")
+    p.add_argument("--fabric-pool-pages", type=int, default=256,
+                   help="compare-fabric: router fabric pool capacity "
+                        "for the fabric-on arms (--fabric-cache-pages)")
+    p.add_argument("--fabric-warmboot-pages", type=int, default=64,
+                   help="compare-fabric: MRU pool pages pushed into a "
+                        "newly spawned worker before it is routable")
+    p.add_argument("--route-fabric-hit-weight", type=float, default=0.25,
+                   help="prefix-affinity: routing-score pages one "
+                        "fabric-pool hit page is worth (fourth "
+                        "temperature)")
     p.add_argument("--pd-streams", type=int, default=4,
                    help="compare-pd: steady decode streams per phase")
     p.add_argument("--pd-decode-tokens", type=int, default=192,
@@ -530,14 +581,15 @@ def main() -> dict:
     if sum(map(bool, (args.compare_admission, args.compare_hybrid,
                       args.compare_ladder, args.compare_spec,
                       args.compare_fleet, args.compare_pd,
-                      args.compare_elastic,
+                      args.compare_elastic, args.compare_fabric,
                       args.compare_chaos_rpc))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
         p.error("--compare-admission/--compare-hybrid/--compare-ladder/"
                 "--compare-spec/--compare-fleet/--compare-pd/"
-                "--compare-elastic/--compare-chaos-rpc are mutually "
-                "exclusive; run them as separate invocations")
+                "--compare-elastic/--compare-fabric/--compare-chaos-rpc "
+                "are mutually exclusive; run them as separate "
+                "invocations")
 
     if args.smoke:
         # One switch pins every knob to the CPU-affordable shape so the
@@ -640,6 +692,33 @@ def main() -> dict:
                 # parked, yet the interactive class holds it with
                 # margin.
                 args.slo_ttft_ms = 600.0
+        if args.compare_fabric:
+            # Many users share one 256-token system prompt across a
+            # dp=2 subprocess fleet: prompts are prefix_pages *
+            # page_size shared tokens + a short distinct tail, and the
+            # prefill buckets are split so a fabric-warm prefill (tail
+            # only) runs the small bucket while a cold one pays the
+            # big one. Host tier ON (fabric pulls restore through it);
+            # no warmup (up to 7 worker boots across the three arms —
+            # each arm runs an unmeasured compile-warm pass first).
+            # The raised preempt watermark makes chaos page pressure —
+            # the lane's deterministic stand-in for a saturated
+            # replica — actually flip the routing pressure bit: a
+            # pressured worker's free+evictable (its whole prefix
+            # cache) stays under 128 once every free page is held,
+            # while the unpressured replica (384-page pool, ~200 pages
+            # of worst-case wave footprint) never dips below it.
+            args.dp = 2
+            args.page_size, args.max_pages_per_seq = 8, 40
+            args.num_pages = 384
+            args.host_cache_pages = 128
+            args.decode_steps_per_call = 4
+            args.no_warmup = True
+            args.fabric_prefix_pages = 32
+            args.fabric_users = 6
+            args.fabric_wave2_users = 6
+            args.prefill_buckets = (16, 64, 320)
+            args.preempt_watermark_pages = 128
         if args.compare_pd:
             # dp=2 subprocess topologies, room for the 448-token long
             # prompts (ctx 640 at page_size 16), host tier on. K=2
@@ -680,6 +759,8 @@ def main() -> dict:
                         if args.compare_pd
                         else "benchmarks/results/replay_elastic.json"
                         if args.compare_elastic
+                        else "benchmarks/results/replay_fabric.json"
+                        if args.compare_fabric
                         else "benchmarks/results/replay_chaos_rpc.json"
                         if args.compare_chaos_rpc
                         else "benchmarks/results/replay_smoke.json")
@@ -731,6 +812,8 @@ def main() -> dict:
         return _compare_pd(args)
     if args.compare_elastic:
         return _compare_elastic(args)
+    if args.compare_fabric:
+        return _compare_fabric(args)
     if args.compare_chaos_rpc:
         return _compare_chaos_rpc(args)
 
@@ -1674,6 +1757,370 @@ def _compare_chaos_rpc(args) -> dict:
             and chaos["worker_reconnects"] >= 1
             and chaos["worker_restarts"] == 0
             and inflation <= 20.0),
+    }
+    out = {"config": cfg_snapshot, **arms, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(arms)
+    return result
+
+
+async def _fabric_burst(port: int, model: str, reqs: list,
+                        n_predict: int) -> list:
+    """Fire the given (trace_id, prompt) requests at once, greedy and
+    non-streamed. Client timing is recorded but the lane grades the
+    SERVER-side per-request spans (/debug/requests), matched back by
+    the X-Request-Id each request carries."""
+    import aiohttp
+
+    url = f"http://127.0.0.1:{port}/api/generate"
+    timeout = aiohttp.ClientTimeout(total=1800)
+
+    async def one(session, tid: str, prompt: str) -> dict:
+        payload = {"model": model, "prompt": prompt, "temperature": 0.0,
+                   "stream": False,
+                   "options": {"num_predict": n_predict}}
+        t0 = time.perf_counter()
+        async with session.post(url, json=payload,
+                                headers={"X-Request-Id": tid}) as resp:
+            resp.raise_for_status()
+            rec = await resp.json()
+        return {"trace_id": tid, "reply": rec.get("response", ""),
+                "e2e_s": time.perf_counter() - t0,
+                "output_tokens": rec.get("eval_count", 0)}
+
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        return list(await asyncio.gather(
+            *[one(session, t, pr) for t, pr in reqs]))
+
+
+def _fabric_spans(port: int, prefix: str) -> list:
+    """The server-side request spans whose trace id starts with
+    ``prefix``, ordered by enqueue time (finished_unix - e2e_s: the
+    spans carry no enqueue stamp, but every wave fires concurrently so
+    the difference recovers arrival order)."""
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/requests?n=128",
+            timeout=60) as r:
+        spans = json.loads(r.read())
+    out = [s for s in spans
+           if str(s.get("trace_id", "")).startswith(prefix)]
+    out.sort(key=lambda s: s.get("finished_unix", 0.0)
+             - s.get("e2e_s", 0.0))
+    return out
+
+
+def _fabric_arm(args, label: str, fabric_on: bool,
+                warmboot: bool = False) -> dict:
+    """Boot a dp=2 subprocess fleet (fabric pool on or off), run the
+    pinned shared-system-prompt workload — one seed turn, then two
+    concurrent returning-user waves — optionally scaling up a third
+    worker between the waves (the warm-boot grade), and summarize from
+    the server-side spans."""
+    import hashlib
+
+    print(f"[replay] fabric arm: {label}", file=sys.stderr)
+    args.fleet = "subprocess"
+    args.fabric_cache_pages = (args.fabric_pool_pages if fabric_on
+                               else 0)
+    page = args.page_size
+    prefix_tokens = args.fabric_prefix_pages * page
+    # Byte tokenizer: chars == tokens, so the shared system prompt is
+    # exactly fabric-prefix-pages FULL pages and every user's distinct
+    # tail starts on the next page boundary — all users share the same
+    # prefix digest chain.
+    shared = ("You are a terse, careful assistant. Cite sources. "
+              * ((prefix_tokens // 49) + 1))[:prefix_tokens]
+    srv, port, stop = start_server(args)
+    group = srv.group
+    records = []
+
+    def _pressure(replica: int) -> None:
+        # Chaos page pressure is the lane's deterministic stand-in for
+        # a saturated replica: the worker holds every free page, the
+        # raised preempt watermark keeps free+evictable under it, and
+        # the router's pressure bit routes the next wave AROUND the
+        # replica — the saturation moment the fabric exists for.
+        group.apply_chaos({"replica": replica,
+                           "page_pressure": args.num_pages})
+        deadline = time.perf_counter() + 15
+        while time.perf_counter() < deadline:
+            reps = group.health_snapshot()["replicas"]
+            if (replica < len(reps)
+                    and reps[replica].get("under_pressure")):
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"replica {replica} never reported under_pressure")
+
+    def _pool_settle(still: float = 0.8, timeout: float = 15.0) -> None:
+        # Publishes ride async event frames; wait until the pool's
+        # page count has been still for a beat so a later growth wait
+        # can't count straggling earlier publishes.
+        deadline = time.perf_counter() + timeout
+        last, t_last = group.fabric.used, time.perf_counter()
+        while time.perf_counter() < deadline:
+            now = group.fabric.used
+            if now != last:
+                last, t_last = now, time.perf_counter()
+            elif time.perf_counter() - t_last >= still:
+                return
+            time.sleep(0.05)
+
+    try:
+        # Compile warmth (the arms boot without warmup): distinct cold
+        # prompts ride the rotating tie-break so every replica
+        # compiles BOTH prefill buckets the measured waves use — the
+        # big bucket (a cold shared-prefix prefill) and the small one
+        # (a warm tail-only prefill). Without this, the fabric-off
+        # arm's first cross-replica turn would pay compile + prefill
+        # while the fabric-on arm's paid only compile — a contrast
+        # that isn't the fabric's.
+        dp = getattr(args, "dp", 1)
+        warm_len = prefix_tokens + 2 * page
+        longs = [(f"[w{i}] warm " + "compile pad " * 64)[:warm_len]
+                 for i in range(dp)]
+        for prompt in longs + [f"[w{i + dp}] warm" for i in range(dp)]:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/generate",
+                data=json.dumps({"model": args.model,
+                                 "prompt": prompt,
+                                 "temperature": 0.0, "stream": False,
+                                 "options": {"num_predict": 4}}).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=600).read()
+        # Decode-ladder warmth: a concurrent burst fills every decode
+        # lane on both replicas so the batch rungs compile here — not
+        # scattered across the measured waves, where a rung compile
+        # would dwarf the prefill contrast being graded.
+        asyncio.run(_fabric_burst(
+            port, args.model,
+            [(f"wmc{i:02d}", f"[c{i:02d}] spin") for i in range(4 * dp)],
+            12))
+        if fabric_on:
+            _pool_settle()
+        pool_baseline = group.fabric.used
+        # Seed turn: ONE user prefills the shared system prompt,
+        # somewhere. With the fabric on, its settled pages publish to
+        # the router pool — the only fleet-wide prefill of the prefix.
+        records += asyncio.run(_fabric_burst(
+            port, args.model, [("seed", shared + " u00")],
+            args.fabric_tokens))
+        seed_span = (_fabric_spans(port, "seed") or [{}])[0]
+        seed_replica = int(seed_span.get("routed_replica", 0))
+        if fabric_on:
+            # Wait until the pool grew by the whole prefix before
+            # grading the returning wave.
+            deadline = time.perf_counter() + 15
+            while (time.perf_counter() < deadline
+                   and group.fabric.used
+                   < pool_baseline + args.fabric_prefix_pages):
+                time.sleep(0.05)
+        # Saturate the replica that prefilled the prefix, then the
+        # returning wave: users sharing the system prompt arrive at
+        # once and ALL route to the other replica — which either
+        # recomputes the prefix (fabric off) or pulls it from the pool
+        # (fabric on). This wave's server-side TTFT p95 is the graded
+        # stat.
+        _pressure(seed_replica)
+        # Swap-path warmth (unmeasured): repeat each warm long with a
+        # fresh tail. With the seed replica saturated these land on the
+        # OTHER replica: the primer whose prefix lived on the pressured
+        # replica restores it through the host tier (fabric on) or
+        # recomputes it (fabric off) — compiling the swap-in scatter
+        # and the first publish's offload gather on the measured
+        # replica BEFORE the graded wave (the shared prefix itself
+        # stays un-pulled: the wave's fabric hit is still the first).
+        records += asyncio.run(_fabric_burst(
+            port, args.model,
+            [(f"pr{i:02d}", longs[i] + f" p{i:02d}") for i in range(dp)],
+            args.fabric_tokens))
+        t0 = time.perf_counter()
+        w1 = [(f"w1u{i:02d}", shared + f" u{i:02d}")
+              for i in range(1, args.fabric_users + 1)]
+        records += asyncio.run(_fabric_burst(
+            port, args.model, w1, args.fabric_tokens))
+        wave1_wall = time.perf_counter() - t0
+        new_replica = None
+        wb_host_pages = 0
+        if warmboot:
+            # Saturate EVERY original replica, then scale up: _spawn
+            # pushes the fabric hot set into the new worker BEFORE it
+            # becomes routable, so the second wave lands on a worker
+            # that never prefilled a byte yet serves its first request
+            # already warm.
+            _pool_settle()
+            for h in list(group.workers):
+                if h.replica != seed_replica:
+                    _pressure(h.replica)
+            group._scale_up("bench-warmboot")
+            new_replica = max(h.replica for h in group.workers)
+            deadline = time.perf_counter() + 90
+            while (time.perf_counter() < deadline
+                   and not all(h.state == "up" for h in group.workers)):
+                time.sleep(0.1)
+            for h, w in zip(group.workers,
+                            group.health_snapshot()["replicas"]):
+                if h.replica == new_replica:
+                    wb_host_pages = int(
+                        (w.get("host_cache") or {}).get("pages_used", 0))
+        # Second wave: more returning users. In the scale-up arm every
+        # old replica is saturated, so the wave lands on the
+        # warm-booted worker; in the base arms it lands on the replica
+        # wave 1 warmed.
+        w2 = [(f"w2u{i:02d}", shared + f" u{i:02d}")
+              for i in range(50, 50 + args.fabric_wave2_users)]
+        records += asyncio.run(_fabric_burst(
+            port, args.model, w2, args.fabric_tokens))
+        w1_spans = _fabric_spans(port, "w1u")
+        w2_spans = _fabric_spans(port, "w2u")
+        fabric_snap = group.fabric.snapshot()
+        sup = group.supervision_counters()
+    finally:
+        group.stop(drain=False)
+        stop()
+
+    h = hashlib.sha256()
+    for r in sorted(records, key=lambda r: r["trace_id"]):
+        h.update(f"{r['trace_id']}:".encode())
+        h.update(r["reply"].encode())
+        h.update(b"\x00")
+
+    def _prefix_recomputed(span: dict) -> int:
+        return max(0, prefix_tokens - int(span.get("cached_tokens", 0)))
+
+    cross = [s for s in w1_spans
+             if s.get("routed_replica") != seed_replica]
+    wb_spans = ([s for s in w2_spans
+                 if s.get("routed_replica") == new_replica]
+                if new_replica is not None else [])
+    wb_first = wb_spans[0] if wb_spans else None
+    return {
+        "label": label, "fabric_on": fabric_on, "warmboot": warmboot,
+        "requests": len(records),
+        "outputs_sha256": h.hexdigest(),
+        "prefix_tokens": prefix_tokens,
+        "wave1_wall_s": round(wave1_wall, 3),
+        # Server-side TTFT (enqueue -> first token) of the graded
+        # returning wave.
+        "returning_ttft_s": _percentiles(
+            [s.get("ttft_s", 0.0) for s in w1_spans], ps=(50, 95)),
+        "wave2_ttft_s": _percentiles(
+            [s.get("ttft_s", 0.0) for s in w2_spans], ps=(50, 95)),
+        "seed_replica": seed_replica,
+        # Returning turns the router spilled onto a replica that never
+        # prefilled the shared prompt — the fabric's reason to exist.
+        "cross_replica_turns": len(cross),
+        "cross_fabric_hit_pages": sum(
+            int(s.get("route_fabric_hit_pages", 0)) for s in cross),
+        "cross_host_restored_pages": sum(
+            int(s.get("host_restored_pages", 0)) for s in cross),
+        # Shared-prefix tokens the wave recomputed anywhere (0 =
+        # prefilled once fleet-wide).
+        "prefix_recomputed_tokens": sum(
+            _prefix_recomputed(s) for s in w1_spans),
+        "cross_first_turn": (None if not cross else {
+            "trace_id": cross[0].get("trace_id"),
+            "replica": cross[0].get("routed_replica"),
+            "route_fabric_hit_pages":
+                int(cross[0].get("route_fabric_hit_pages", 0)),
+            "host_restored_pages":
+                int(cross[0].get("host_restored_pages", 0)),
+            "cached_tokens": int(cross[0].get("cached_tokens", 0)),
+            "prefix_recomputed_tokens": _prefix_recomputed(cross[0]),
+        }),
+        # Warm-boot grade (scale-up arm only): host pages the new
+        # worker held BEFORE serving anything, and its first request's
+        # warmth (all of it fabric-sourced — the worker never prefilled
+        # a byte before this).
+        "warmboot_replica": new_replica,
+        "warmboot_host_pages": wb_host_pages,
+        "warmboot_requests": len(wb_spans),
+        "warmboot_first_turn": (None if wb_first is None else {
+            "trace_id": wb_first.get("trace_id"),
+            "route_hit_pages": int(wb_first.get("route_hit_pages", 0)),
+            "route_fabric_hit_pages":
+                int(wb_first.get("route_fabric_hit_pages", 0)),
+            "host_restored_pages":
+                int(wb_first.get("host_restored_pages", 0)),
+            "cached_tokens": int(wb_first.get("cached_tokens", 0)),
+            "prefix_recomputed_tokens": _prefix_recomputed(wb_first),
+        }),
+        "fabric": fabric_snap,
+        "route_fabric_hits": sup.get("route_fabric_hits", 0),
+        "fabric_puts": sup.get("fabric_puts", 0),
+        "fabric_hits": sup.get("fabric_hits", 0),
+        "kv_integrity_rejections": sup.get("kv_integrity_rejections", 0),
+    }
+
+
+def _compare_fabric(args) -> dict:
+    """The fleet-KV-fabric artifact (README "KV fabric"): many users
+    sharing one long system prompt, served three ways — fabric off
+    (every replica pays its own prefix prefill), fabric on (the prefix
+    is prefilled ONCE fleet-wide and every other replica pulls it from
+    the router pool), and fabric on with a mid-run scale-up whose new
+    worker warm-boots from the pool and serves its first request
+    already warm. Outputs must stay byte-identical across every arm:
+    the fabric moves settled KV bytes, it never changes them."""
+    cfg_snapshot = {k: v for k, v in vars(args).items()
+                    if not k.startswith("_")}
+    arms = {}
+    arms["fabric_off"] = _fabric_arm(args, "fabric_off", False)
+    arms["fabric_on"] = _fabric_arm(args, "fabric_on", True)
+    arms["fabric_warmboot"] = _fabric_arm(
+        args, "fabric_warmboot", True, warmboot=True)
+    args.fleet = "in-process"
+
+    off, on, wb = (arms["fabric_off"], arms["fabric_on"],
+                   arms["fabric_warmboot"])
+    shas = {a["outputs_sha256"] for a in arms.values()}
+    ratio = (off["returning_ttft_s"]["p95"]
+             / max(on["returning_ttft_s"]["p95"], 1e-9))
+    wb_first = wb.get("warmboot_first_turn") or {}
+    comparison = {
+        "users": args.fabric_users,
+        "prefix_tokens": on["prefix_tokens"],
+        # Byte-identity across all arms: pooled pages are the same
+        # bit-exact serialized KV the point-to-point paths move.
+        "outputs_identical": len(shas) == 1,
+        # The fleet-wide prefill-once claim: with the fabric on, no
+        # returning turn recomputes a shared-prefix token anywhere —
+        # the cross-replica turns adopt pooled pages instead.
+        "prefix_recomputed_tokens_off": off["prefix_recomputed_tokens"],
+        "prefix_recomputed_tokens_on": on["prefix_recomputed_tokens"],
+        "cross_replica_turns_on": on["cross_replica_turns"],
+        "cross_fabric_hit_pages_on": on["cross_fabric_hit_pages"],
+        "prefix_prefilled_once": bool(
+            on["cross_replica_turns"] >= 1
+            and on["cross_fabric_hit_pages"] >= args.fabric_prefix_pages
+            and on["prefix_recomputed_tokens"] == 0
+            and (on["cross_first_turn"] or {}).get(
+                "route_fabric_hit_pages", 0) > 0),
+        # Returning-turn TTFT p95, fabric off vs on (>= 1.3x is the
+        # artifact's acceptance claim; CPU-noise makes it a committed-
+        # artifact grade, not a live tier-1 assert).
+        "returning_ttft_p95_off_s": off["returning_ttft_s"]["p95"],
+        "returning_ttft_p95_on_s": on["returning_ttft_s"]["p95"],
+        "returning_ttft_ratio": round(ratio, 4),
+        "fabric_ttft_wins": bool(ratio >= 1.3),
+        # Warm worker boot: the scaled-up worker held pooled pages
+        # before its first request, and that request's warmth is
+        # fabric-sourced (the worker had prefilled nothing).
+        "warmboot_host_pages": wb["warmboot_host_pages"],
+        "warmboot_requests": wb["warmboot_requests"],
+        "warmboot_first_hit_pages": wb_first.get("route_hit_pages", 0),
+        "warmboot_wins": bool(
+            wb["warmboot_host_pages"] > 0
+            and wb["warmboot_requests"] >= 1
+            and wb_first.get("route_hit_pages", 0) > 0
+            and wb_first.get("prefix_recomputed_tokens", 1) == 0),
+        "fabric_wins": bool(
+            len(shas) == 1
+            and on["cross_replica_turns"] >= 1
+            and on["prefix_recomputed_tokens"] == 0
+            and on["fabric_hits"] > 0 and on["fabric_puts"] > 0),
     }
     out = {"config": cfg_snapshot, **arms, "comparison": comparison}
     print(json.dumps(comparison, indent=1))

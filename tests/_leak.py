@@ -55,3 +55,29 @@ def assert_pool_clean(engine) -> None:
         f"evictable counter drifted: {alloc.evictable_count} after clear")
     bound = [i for i, s in enumerate(engine.slots) if s is not None]
     assert not bound, f"decode slots still bound after drain: {bound}"
+
+
+def assert_fabric_clean(pool) -> None:
+    """Fleet-fabric accounting invariant (server/kv_fabric.FabricPool):
+    the page/byte counters must agree with the entries actually
+    resident, occupancy must respect capacity, and clear() must return
+    the pool to empty — a pooled blob that outlives its accounting is
+    router-process memory that ratchets until OOM."""
+    with pool._lock:
+        entries = dict(pool._entries)
+    assert pool.used == len(entries), (
+        f"fabric page accounting drifted: pool says {pool.used}, "
+        f"table holds {len(entries)}")
+    assert pool.bytes_used == sum(e.nbytes for e in entries.values()), \
+        "fabric byte accounting drifted"
+    assert pool.bytes_used == sum(
+        len(e.blob) for e in entries.values()), \
+        "fabric entry nbytes disagrees with its blob"
+    assert 0 <= pool.used <= max(pool.capacity, 0), (
+        f"fabric pool over capacity: {pool.used}/{pool.capacity}")
+    snap = pool.snapshot()
+    assert snap["pages_used"] == pool.used
+    assert snap["bytes_used"] == pool.bytes_used
+    pool.clear()
+    assert pool.used == 0, "fabric pages leaked after clear"
+    assert pool.bytes_used == 0, "fabric bytes leaked after clear"

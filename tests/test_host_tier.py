@@ -446,7 +446,9 @@ def test_one_hash_pass_per_routed_request(monkeypatch):
 def test_router_scores_three_temperatures():
     """HBM-warm > host-warm > cold: with equal load, the router prefers
     the replica holding the prompt in HBM, then the one holding it in
-    the host tier, then a cold one."""
+    the host tier, then a cold one (the fourth, fabric-warm temperature
+    has its own suite in test_kv_fabric.py; with an empty pool the
+    fabric term is zero here)."""
     from tpu_inference.server.replicas import EngineGroup
 
     ecfg = _ecfg(num_pages=64, max_pages_per_seq=8, max_batch_size=2)
@@ -465,12 +467,13 @@ def test_router_scores_three_temperatures():
 
     seq = Sequence(request_id=9, prompt_tokens=list(prompt),
                    max_new_tokens=4)
-    sched, (hbm, host) = group._pick(group.schedulers, seq)
+    sched, (hbm, host, fab) = group._pick(group.schedulers, seq)
     assert sched is group.schedulers[0] and hbm > 0 and host == 0
+    assert fab == 0                          # empty fabric pool
     # Without replica 0, host-warm replica 1 beats cold replica 2.
     seq2 = Sequence(request_id=10, prompt_tokens=list(prompt),
                     max_new_tokens=4)
-    sched, (hbm, host) = group._pick(group.schedulers[1:], seq2)
+    sched, (hbm, host, _) = group._pick(group.schedulers[1:], seq2)
     assert sched is group.schedulers[1] and host > 0 and hbm == 0
     # The digests were cached on the sequences (one hash pass).
     assert seq.prefix_digests is not None
@@ -479,7 +482,7 @@ def test_router_scores_three_temperatures():
     group.server_cfg = cfgs.ServerConfig(route_host_hit_weight=0.0)
     seq3 = Sequence(request_id=11, prompt_tokens=list(prompt),
                     max_new_tokens=4)
-    _, (hbm3, host3) = group._pick(group.schedulers[1:], seq3)
+    _, (hbm3, host3, _) = group._pick(group.schedulers[1:], seq3)
     assert hbm3 == 0                         # never misreported as HBM
 
 

@@ -1,0 +1,392 @@
+"""Fleet KV fabric (README "KV fabric"): the router-side shared
+prefix-page pool and its fourth routing temperature.
+
+Covers the subsystem at three levels:
+
+- pure pool units: capacity/LRU bounds with byte accounting, digest
+  dedup (re-publish stores once, a stale entry is superseded by fresh
+  bytes), contiguous-from-page-0 match depth, MRU-first hot set for
+  warm worker boot, capacity-0 no-op, and crc32c integrity on get for
+  every kv_quant host-page layout (a corrupt pooled blob is dropped +
+  counted + treated as a miss, never adopted silently).
+- shared scoring formulas: the four cache temperatures order HBM-warm
+  < host-warm < fabric-warm < cold, the pressure shift preserves
+  relative order but puts a fully-warm pressured replica behind a cold
+  idle one, and the fabric term only covers pages beyond a candidate's
+  own warm depth.
+- engine publish hook: settled prefix pages ship to the armed publish
+  callable once — steady traffic over the same prompt dedups.
+- BOTH fleet backends end-to-end: a prefix prefilled on replica A is
+  pulled from the pool by a prefill routed to replica B (page pressure
+  on A stands in for saturation), byte-identically, with the same
+  supervision/healthz accounting under --fleet in-process and
+  subprocess.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests._leak import assert_fabric_clean
+from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                  ParallelConfig, ServerConfig, tiny_llama)
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.engine.engine import InferenceEngine, Sequence
+from tpu_inference.server import kv_fabric
+from tpu_inference.server.kv_fabric import FabricPool
+
+# Same tiny worker geometry as test_fleet, except the preempt
+# watermark is raised so chaos page pressure (holding every free page)
+# drops free+evictable below it even while the pressured replica's own
+# prefix cache stays resident — the deterministic stand-in for a
+# saturated replica the routed tests steer around.
+ENGINE_KW = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
+                 max_batch_size=2, prefill_buckets=(16,),
+                 host_cache_pages=32, preempt_watermark_pages=40)
+FABRIC_KW = dict(fabric_cache_pages=64, fabric_publish_min_pages=1)
+
+# 33 tokens = 4 full pages of shared prefix (digest cap (33-1)//8) + a
+# straggler token, under vocab 512.
+PROMPT = [(3 * i + 1) % 500 for i in range(33)]
+
+
+def _cfg(dp=2, **server_kw) -> FrameworkConfig:
+    server_kw.setdefault("fleet", "subprocess")
+    server_kw.setdefault("worker_restart_max", 10)
+    server_kw.setdefault("worker_restart_backoff_s", 0.1)
+    return FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(**ENGINE_KW),
+        parallel=ParallelConfig(dp=dp),
+        server=ServerConfig(model_name="t", tokenizer="byte",
+                            warmup=False, **server_kw))
+
+
+def _page(quant: str, tag: int) -> kvc.HostKVPage:
+    rng = np.random.default_rng(100 + tag)
+    if quant == "none":
+        mk = lambda: rng.standard_normal((2, 8, 2, 16)).astype(np.float32)
+        return kvc.HostKVPage(mk(), mk())
+    code_dt = np.uint8 if quant == "int4" else np.int8
+    d = 8 if quant == "int4" else 16
+    mk = lambda: rng.integers(0, 255, (2, 8, 2, d)).astype(code_dt)
+    sc = lambda: rng.standard_normal((2, 8, 2)).astype(np.float32)
+    return kvc.HostKVPage(mk(), mk(), sc(), sc())
+
+
+def _digests(n: int):
+    return [bytes([i]) * 16 for i in range(n)]
+
+
+# ------------------------------------------------------------ pool units
+
+
+def test_pool_capacity_lru_and_accounting():
+    """The pool never exceeds its page capacity: overflow evicts LRU
+    entries (a get refreshes recency), and page/byte accounting stays
+    exact through the churn."""
+    pool = FabricPool(4)
+    d = _digests(6)
+    for i in range(4):
+        pool.put_blob(d[i], kvc.serialize_host_pages([_page("none", i)]))
+    assert pool.used == 4 and pool.puts == 4 and pool.evictions == 0
+    # Touch d[0]: it becomes MRU, so the next overflow evicts d[1].
+    got = pool.get_pages([d[0]])
+    assert len(got) == 1 and got[0][0] == d[0] and pool.hits == 1
+    pool.put_blob(d[4], kvc.serialize_host_pages([_page("none", 4)]))
+    assert pool.used == 4 and pool.evictions == 1
+    assert pool.match_depth([d[1]]) == 0, "LRU victim should be d[1]"
+    assert pool.match_depth([d[0]]) == 1
+    # MRU-first hot set for warm worker boot.
+    hot = pool.hot_set(2)
+    assert [h[0] for h in hot] == [d[4], d[0]]
+    assert pool.hot_set(0) == []
+    assert_fabric_clean(pool)
+
+
+def test_pool_dedup_and_supersede():
+    """Re-publishing a digest stores ONE entry (second replica
+    publishing the same prefix costs nothing extra), and a fresh blob
+    supersedes a stale one — a later get returns the new bytes."""
+    pool = FabricPool(8)
+    d = _digests(1)[0]
+    page_a, page_b = _page("none", 1), _page("none", 2)
+    blob_a = kvc.serialize_host_pages([page_a])
+    blob_b = kvc.serialize_host_pages([page_b])
+    pool.put_blob(d, blob_a)
+    pool.put_blob(d, blob_a)
+    assert pool.used == 1 and pool.superseded == 1
+    assert pool.bytes_used == len(blob_a)
+    pool.put_blob(d, blob_b)
+    assert pool.used == 1 and pool.superseded == 2
+    got = pool.get_pages([d])
+    np.testing.assert_array_equal(got[0][1].k, page_b.k)
+    np.testing.assert_array_equal(got[0][1].v, page_b.v)
+    assert_fabric_clean(pool)
+
+
+def test_pool_match_depth_contiguous_and_side_effect_free():
+    """match_depth counts only the contiguous run from page 0 (a chain
+    with a hole is warm only up to the hole) and never touches the
+    hit/miss counters — it is the router's per-candidate scoring peek."""
+    pool = FabricPool(8)
+    d = _digests(4)
+    pool.put_blob(d[0], kvc.serialize_host_pages([_page("none", 0)]))
+    pool.put_blob(d[2], kvc.serialize_host_pages([_page("none", 2)]))
+    assert pool.match_depth(d[:3]) == 1          # hole at d[1]
+    assert pool.match_depth([d[0]]) == 1
+    assert pool.match_depth([d[3]]) == 0
+    assert pool.match_depth([]) == 0
+    assert pool.hits == 0 and pool.misses == 0
+    assert_fabric_clean(pool)
+
+
+def test_pool_capacity_zero_noop():
+    """fabric_cache_pages=0 (the default) disables the pool without a
+    special case at any call site: puts drop, lookups miss clean."""
+    pool = FabricPool(0)
+    d = _digests(1)[0]
+    pool.put_blob(d, kvc.serialize_host_pages([_page("none", 0)]))
+    assert pool.used == 0 and pool.puts == 0
+    assert pool.match_depth([d]) == 0
+    assert pool.get_pages([]) == []
+    assert pool.hot_set(4) == []
+    assert pool.snapshot()["capacity_pages"] == 0
+    assert_fabric_clean(pool)
+
+
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_pool_get_rejects_corrupt_blob(quant):
+    """Integrity on the read path, pinned per kv_quant layout: a pooled
+    blob corrupted in router memory fails its crc32c on get, is
+    dropped + counted (kv_rejections) + treated as a miss, and the
+    clean entries still round-trip bit-exactly."""
+    pool = FabricPool(8)
+    d = _digests(3)
+    pages = [_page(quant, i) for i in range(3)]
+    assert pool.put_pages(list(zip(d, pages))) == 3
+    # Flip one payload byte of the middle entry, in place.
+    with pool._lock:
+        e = pool._entries[d[1]]
+    raw = bytearray(e.blob)
+    raw[len(raw) // 2] ^= 0xFF
+    e.blob = bytes(raw)
+    got = pool.get_pages(d)
+    assert [g[0] for g in got] == [d[0]], \
+        "corrupt entry must end the run, not be adopted"
+    assert pool.kv_rejections == 1 and pool.misses == 1
+    assert pool.used == 2 and pool.match_depth(d) == 1
+    np.testing.assert_array_equal(got[0][1].k, pages[0].k)
+    np.testing.assert_array_equal(got[0][1].v, pages[0].v)
+    if quant != "none":
+        np.testing.assert_array_equal(got[0][1].k_scale, pages[0].k_scale)
+    # The untouched later entry is still servable on its own chain.
+    pool.reject(d[2])
+    assert pool.kv_rejections == 2 and pool.used == 1
+    assert_fabric_clean(pool)
+
+
+# ------------------------------------------------------ scoring helpers
+
+
+def test_routing_score_four_temperatures():
+    """THE shared formulas (both backends import these): warmth
+    discounts order HBM < host < fabric < cold; the pressure shift
+    keeps relative order but puts a fully-warm pressured replica
+    behind a cold idle one; the fabric term covers only pages beyond a
+    candidate's own warm depth."""
+    cfg = ServerConfig(model_name="t", tokenizer="byte")
+    pp = 8
+
+    def score(hbm=0, host=0, fabric=0, load=0.0, pressured=False):
+        return kv_fabric.prefill_route_score(
+            cfg, prompt_pages=pp, hbm=hbm, host=host, fabric=fabric,
+            load=load, pressured=pressured)
+
+    hbm_s, host_s = score(hbm=pp), score(host=pp)
+    fab_s, cold_s = score(fabric=pp), score()
+    assert hbm_s < host_s < fab_s < cold_s
+    # Pressure: order-preserving shift, and warm+pressured loses to
+    # cold+idle at the default weights.
+    assert score(hbm=pp, pressured=True) < score(host=pp, pressured=True)
+    assert score(hbm=pp, pressured=True) > cold_s
+    # Load blends in page units.
+    assert score(load=2.0) > score(load=1.0) > score()
+
+    assert kv_fabric.fabric_extra_pages(10, 3, 8) == 5
+    assert kv_fabric.fabric_extra_pages(2, 5, 8) == 0
+    assert kv_fabric.fabric_extra_pages(50, 0, 8) == 8
+    assert kv_fabric.fabric_extra_pages(0, 0, 8) == 0
+
+    dec = lambda **kw: kv_fabric.decode_route_score(
+        cfg, **{"hbm": 0, "host": 0, "fabric": 0, "load": 0.0,
+                "occupancy": 0.0, "pressured": False, **kw})
+    assert dec(hbm=4) < dec(host=4) < dec(fabric=4) < dec()
+    assert dec(pressured=True) > dec()
+    assert kv_fabric.cold_route_key(False, 5.0) \
+        < kv_fabric.cold_route_key(True, 0.0)
+
+
+# -------------------------------------------------- engine publish hook
+
+
+def test_engine_publish_hook_and_dedup():
+    """The engine ships settled full prefix pages to the armed publish
+    callable exactly once per digest: a second pass over the same
+    prompt publishes nothing new, and fabric_published_pages tracks
+    the total."""
+    engine = InferenceEngine(tiny_llama(vocab_size=512),
+                             EngineConfig(**ENGINE_KW), seed=0)
+    published = []
+    engine.fabric_publish = published.extend
+    engine.fabric_publish_min_pages = 2
+    out1 = engine.generate([list(PROMPT)], max_new_tokens=8)[0]
+    assert len(published) >= 4, "full prompt prefix pages must publish"
+    digests = [d for d, _ in published]
+    assert len(set(digests)) == len(digests)
+    for _, p in published:
+        assert isinstance(p, kvc.HostKVPage)
+    n1 = len(published)
+    assert engine.fabric_published_pages == n1
+    out2 = engine.generate([list(PROMPT)], max_new_tokens=8)[0]
+    assert out2 == out1
+    assert len(published) == n1, "republish of the same prefix"
+    # A short prompt below fabric_publish_min_pages never publishes.
+    engine.generate([[5, 6, 7]], max_new_tokens=4)
+    assert len(published) == n1
+
+
+# ---------------------------------------------- both backends end-to-end
+
+
+def _submit(group, rid, prompt, max_new):
+    toks, done, box = [], threading.Event(), {}
+    seq = Sequence(request_id=rid, prompt_tokens=list(prompt),
+                   max_new_tokens=max_new)
+    group.submit(seq, lambda s, t: toks.append(t),
+                 lambda s: (box.update(seq=s), done.set()))
+    return toks, done, box
+
+
+def _finish(done, box, timeout=180.0):
+    assert done.wait(timeout), "request did not finish"
+    return box["seq"]
+
+
+def _wait(predicate, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _fabric_flow(group, *, pressure, unpressure, is_pressured):
+    """The cross-replica warm-once flow both backends must serve
+    identically: prefill the prefix on whichever replica the router
+    picks, saturate that replica, then prove the SAME prompt served by
+    the other replica adopts pooled pages (route_fabric_hit_pages) and
+    stays byte-identical."""
+    toks1, done, box = _submit(group, 9100, PROMPT, 8)
+    fin1 = _finish(done, box)
+    assert fin1.finish_reason == "length"
+    seed_replica = fin1.routed_replica
+    assert seed_replica in (0, 1)
+    _wait(lambda: group.fabric.used >= 4, msg="fabric publish")
+
+    pressure(seed_replica)
+    _wait(lambda: is_pressured(seed_replica),
+          msg="pressured replica visible")
+    try:
+        toks2, done, box = _submit(group, 9101, PROMPT, 8)
+        fin2 = _finish(done, box)
+    finally:
+        unpressure(seed_replica)
+    assert fin2.routed_replica == 1 - seed_replica, \
+        "wave must route AROUND the pressured prefiller"
+    assert fin2.route_fabric_hit_pages >= 1, \
+        "the cross-replica turn must adopt pooled pages"
+    assert fin2.route_hit_pages >= fin2.route_fabric_hit_pages
+    assert toks2 == toks1, "fabric restore must be byte-identical"
+
+    sup = group.supervision_counters()
+    assert sup["route_fabric_hits"] >= 1
+    assert sup["fabric_puts"] >= 4 and sup["fabric_hits"] >= 1
+    hs = group.health_snapshot()
+    snap = hs["fabric"]
+    assert snap["capacity_pages"] == 64
+    assert snap["pages_used"] >= 4 and snap["kv_rejections"] == 0
+    assert set(snap) == set(group.fabric.snapshot())
+    return seed_replica
+
+
+@pytest.fixture(scope="module")
+def fabric_fleet():
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(dp=2, **FABRIC_KW))
+    group.start()
+    yield group
+    group.stop(drain=False)
+
+
+def test_fabric_warm_once_subprocess(fabric_fleet):
+    group = fabric_fleet
+    _wait(lambda: all(h.state == "up" for h in group.workers),
+          timeout=60.0, msg="fleet up")
+
+    def is_pressured(i):
+        reps = group.health_snapshot()["replicas"]
+        return bool(reps[i].get("under_pressure"))
+
+    seed = _fabric_flow(
+        group,
+        pressure=lambda i: group.apply_chaos(
+            {"replica": i, "page_pressure": 64}),
+        unpressure=lambda i: group.apply_chaos(
+            {"replica": i, "page_pressure": 0}),
+        is_pressured=is_pressured)
+    # The publisher's own accounting is visible in /healthz.
+    reps = group.health_snapshot()["replicas"]
+    assert reps[seed].get("fabric_published_pages", 0) >= 4
+
+    # Metric surface: fabric series exported once (no duplicate
+    # series/labels), pool gauges live.
+    from tests import _prom
+
+    _, samples = _prom.parse(group.prometheus_text())
+    seen = {}
+    for name, labels, value in samples:
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in seen, f"duplicate series {key}"
+        seen[key] = value
+    for name in ("tpu_inf_fabric_pages_used", "tpu_inf_fabric_bytes_used",
+                 "tpu_inf_fabric_puts_total", "tpu_inf_fabric_hits_total",
+                 "tpu_inf_fabric_misses_total",
+                 "tpu_inf_fabric_evictions_total",
+                 "tpu_inf_route_fabric_hits_total"):
+        assert any(k[0] == name for k in seen), f"missing {name}"
+
+
+def test_fabric_warm_once_in_process():
+    from tpu_inference.server.http import build_engine_group
+
+    group = build_engine_group(
+        _cfg(dp=2, fleet="in-process", **FABRIC_KW)).start()
+    try:
+        def pressure(i):
+            group.schedulers[i].engine.request_page_pressure(64)
+
+        def unpressure(i):
+            group.schedulers[i].engine.request_page_pressure(0)
+
+        _fabric_flow(
+            group, pressure=pressure, unpressure=unpressure,
+            is_pressured=lambda i:
+                group.schedulers[i].engine.under_pressure)
+        assert_fabric_clean(group.fabric)
+    finally:
+        group.stop(drain=False)

@@ -654,3 +654,66 @@ def test_replay_smoke_compare_routing(tmp_path, monkeypatch):
             > c["cached_prompt_pages_least_loaded"])
     assert (c["ttft_p95_prefix_affinity_s"]
             < c["ttft_p95_least_loaded_s"])
+
+
+def test_replay_smoke_compare_fabric(tmp_path, monkeypatch):
+    """Tier-1 fleet-KV-fabric smoke (CPU, dp=2, three subprocess
+    fleets): the fabric lane replays the shared-system-prompt multi-
+    user mix with the router-side fabric pool off, on, and on with a
+    mid-run scale-up whose new worker boots fabric-warm. Live
+    assertions are the DETERMINISTIC claims: byte-identical greedy
+    outputs across all three arms (the fabric is a placement/transport
+    decision, never a behavior change), the shared prefix prefilled
+    ONCE fleet-wide in the fabric arms (replica B's first turn is
+    fabric-warm with zero recomputed prefix tokens, adopting >=
+    prefix-size pooled pages), the warmboot worker entering service
+    with pooled pages already resident and serving its first request
+    with fabric hits > 0, and zero integrity rejections. The TTFT
+    ratio is graded on the committed artifact, not re-timed on a
+    loaded CI box (replay's tok_s_within_5pct stance)."""
+    root, replay = _load_replay()
+    out = tmp_path / "replay_fabric.json"
+    monkeypatch.chdir(root)
+    monkeypatch.setattr(sys, "argv",
+                        ["replay.py", "--smoke", "--compare-fabric",
+                         "--out", str(out)])
+    cmp = replay.main()
+
+    art = json.loads(out.read_text())
+    assert art["config"]["smoke"] is True
+    for arm in ("fabric_off", "fabric_on", "fabric_warmboot"):
+        s = art[arm]
+        assert s["requests"] > 0, (arm, s)
+        assert s["kv_integrity_rejections"] == 0, (arm, s)
+    assert art["fabric_off"]["fabric"]["capacity_pages"] == 0
+    assert art["fabric_on"]["fabric"]["capacity_pages"] > 0
+    # Byte-identity across all three arms.
+    assert cmp["outputs_identical"], cmp
+    # The shared prefix was prefilled ONCE fleet-wide: the fabric arm
+    # re-prefilled zero prefix tokens while the off arm re-prefilled
+    # the whole prefix once per returning user, and the cross-replica
+    # first turn adopted the full pooled prefix.
+    assert cmp["prefix_prefilled_once"], cmp
+    assert cmp["prefix_recomputed_tokens_on"] == 0
+    assert (cmp["prefix_recomputed_tokens_off"]
+            >= cmp["prefix_tokens"])
+    assert cmp["cross_replica_turns_on"] >= 1
+    assert (cmp["cross_fabric_hit_pages_on"]
+            * art["config"]["page_size"] >= cmp["prefix_tokens"])
+    # The scaled-up worker booted fabric-warm and served its first
+    # request from pooled pages, recomputing nothing.
+    assert cmp["warmboot_wins"], cmp
+    assert cmp["warmboot_host_pages"] >= 1
+    assert cmp["warmboot_first_hit_pages"] >= 1
+    assert cmp["fabric_wins"], cmp
+
+    # The committed artifact carries the same claims PLUS the latency
+    # win: returning-user TTFT p95 at least 1.3x better fabric-on.
+    committed = json.loads(open(os.path.join(
+        root, "benchmarks", "results", "replay_fabric.json")).read())
+    c = committed["comparison"]
+    assert c["fabric_wins"] and c["outputs_identical"]
+    assert c["prefix_prefilled_once"] and c["warmboot_wins"]
+    assert c["prefix_recomputed_tokens_on"] == 0
+    assert c["returning_ttft_ratio"] >= 1.3
+    assert c["fabric_ttft_wins"]

@@ -726,6 +726,29 @@ class ServerConfig:
     # so warmth cannot herd every conversation onto one overloaded
     # replica. Not a CLI flag; tune in config when page_size is unusual.
     route_load_pages: float = 1.0
+    # --- Fleet KV fabric (README "KV fabric") ---
+    # Router-side digest-keyed LRU pool of serialized KV prefix pages
+    # shared across EVERY replica: a prefix prefilled on any replica
+    # warms all of them (pages pull into a replica's host tier before
+    # its prefill). Capacity in pages; 0 = fabric off. CLI:
+    # --fabric-cache-pages.
+    fabric_cache_pages: int = 0
+    # Minimum contiguous settled prefix pages a sequence must hold
+    # before its engine publishes them to the fabric — keeps one-page
+    # scraps from churning the pool. CLI: --fabric-publish-min-pages.
+    fabric_publish_min_pages: int = 1
+    # Pages of the fabric's hot (MRU) set pushed into an autoscale/
+    # rollout worker via import-kv before it enters the routable pool,
+    # so scaled-up capacity serves its first request warm. 0 = boot
+    # cold. CLI: --fabric-warmboot-pages.
+    fabric_warmboot_pages: int = 64
+    # Pages of prefill compute one FABRIC-covered page is worth in the
+    # routing score — the fourth cache temperature, between host-warm
+    # (route_host_hit_weight) and cold (0): a fabric page saves the
+    # prefill compute but pays a pool pull + host->device swap-in.
+    # Only pages beyond a candidate's own warm depth earn it. CLI:
+    # --route-fabric-hit-weight.
+    route_fabric_hit_weight: float = 0.25
     # --- Process fleet (README "Process fleet") ---
     # Fleet backend: "in-process" = dp EngineSchedulers as threads of the
     # server process (server/replicas.py EngineGroup — one process, one

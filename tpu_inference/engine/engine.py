@@ -20,6 +20,7 @@ SURVEY.md §0); capability parity is defined by BASELINE.json configs 1-4.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import random as _chaos_random
 import threading
@@ -310,6 +311,10 @@ class Sequence:
     # Of route_hit_pages, how many were host-tier (warm but needing a
     # swap-in) at decision time — the router's third temperature.
     route_host_hit_pages: int = 0
+    # Pages the router pulled from the fleet KV fabric into this
+    # replica's host tier before dispatch (README "KV fabric") — the
+    # fourth temperature: warmth another replica prefilled.
+    route_fabric_hit_pages: int = 0
     # Phase accounting accrued by the engine: wall time of device
     # dispatches this request participated in, and its share of the
     # host-side bubble between decode calls. Shared dispatches accrue
@@ -498,6 +503,19 @@ class InferenceEngine:
         # worker folds this into healthz and the fleet sums it into
         # tpu_inf_kv_integrity_rejections_total.
         self.kv_integrity_rejections = 0
+        # Fleet KV fabric publish (README "KV fabric"): when armed (the
+        # worker's boot() or the in-process group sets fabric_publish to
+        # a callable taking [(digest, HostKVPage)]), _publish_to_cache
+        # also offloads the settled prefix run and ships it to the
+        # router's fabric pool, so a prefix prefilled here warms every
+        # replica. _fabric_published is a bounded dedup set so steady
+        # traffic over the same system prompt doesn't re-serialize the
+        # same pages every release.
+        self.fabric_publish = None
+        self.fabric_publish_min_pages = 1
+        self._fabric_published: "collections.OrderedDict[bytes, None]" = \
+            collections.OrderedDict()
+        self.fabric_published_pages = 0
         # Cross-thread migration imports (the worker's import-kv RPC
         # lands on an RPC thread; the host tier is engine-thread only):
         # queued here, applied by the scheduler loop before admission so
@@ -2155,6 +2173,41 @@ class InferenceEngine:
         digests = None if seq.resume_base else seq.prefix_digests
         self.prefix_cache.insert(in_kv[:seq.ctx_len], seq.pages,
                                  digests=digests)
+        self._publish_to_fabric(seq, digests)
+
+    def _publish_to_fabric(self, seq: Sequence, digests) -> None:
+        """Ship the settled prefix run to the fleet fabric pool (README
+        "KV fabric"): the contiguous full-page prompt prefix, keyed by
+        its chain digests, offloaded to host layout and handed to the
+        armed publish callable. Bounded below by
+        fabric_publish_min_pages (tiny prefixes aren't worth fleet
+        space) and deduped against _fabric_published so steady traffic
+        over one system prompt serializes it once, not per release."""
+        if self.fabric_publish is None or not digests:
+            return
+        full = len(self._tokens_in_kv(seq, drop_last=True)[:seq.ctx_len]) \
+            // self.engine_cfg.page_size
+        k = min(len(digests), full, len(seq.pages))
+        while k > 0 and not all(seq.pages[i] for i in range(k)):
+            k -= 1
+        if k < max(1, self.fabric_publish_min_pages):
+            return
+        fresh = [i for i in range(k)
+                 if digests[i] not in self._fabric_published]
+        if not fresh:
+            return
+        try:
+            host_pages = kvc.offload_pages(
+                self.kv, [seq.pages[i] for i in fresh])
+            self.fabric_publish(
+                [(digests[i], p) for i, p in zip(fresh, host_pages)])
+        except Exception:
+            return                        # publish is best-effort
+        for i in fresh:
+            self._fabric_published[digests[i]] = None
+        while len(self._fabric_published) > 4096:
+            self._fabric_published.popitem(last=False)
+        self.fabric_published_pages += len(fresh)
 
     def release(self, seq: Sequence) -> None:
         """Free a finished sequence's pages and slot, publishing its full

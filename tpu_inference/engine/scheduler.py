@@ -764,6 +764,7 @@ class EngineScheduler:
             routed_replica=seq.routed_replica,
             route_hit_pages=seq.route_hit_pages,
             route_host_hit_pages=seq.route_host_hit_pages,
+            route_fabric_hit_pages=seq.route_fabric_hit_pages,
             host_restored_pages=seq.host_restored_pages,
             preemptions=seq.preemptions,
             prompt_tokens=len(seq.prompt_tokens),
@@ -862,6 +863,10 @@ class EngineScheduler:
             # Of route_hit_pages, the pages that were HOST-tier-warm at
             # decision time (the router's third temperature).
             "route_host_hit_pages": seq.route_host_hit_pages,
+            # Pages pulled from the fleet KV fabric into this replica's
+            # host tier before dispatch (the fourth temperature: warmth
+            # another replica prefilled; README "KV fabric").
+            "route_fabric_hit_pages": seq.route_fabric_hit_pages,
             "finished_unix": round(time.time(), 3),
             "prompt_tokens": len(seq.prompt_tokens),
             "cached_tokens": seq.cached_tokens,

@@ -290,6 +290,28 @@ def main() -> None:
                         "(default) = size from available RAM "
                         "(/proc/meminfo MemAvailable; capacity is a "
                         "cap — RAM is consumed only as pages demote)")
+    p.add_argument("--fabric-cache-pages", type=int, default=0,
+                   help="fleet KV fabric: router-side shared pool "
+                        "capacity in pages (README 'KV fabric'); "
+                        "settled prefix pages published by any replica "
+                        "warm prefills on EVERY replica, and autoscaled "
+                        "workers boot warm from the pool; 0 = off")
+    p.add_argument("--fabric-publish-min-pages", type=int, default=1,
+                   help="fleet KV fabric: publish a prefix to the pool "
+                        "only once at least this many settled pages are "
+                        "available (filters short one-off prompts)")
+    p.add_argument("--fabric-warmboot-pages", type=int, default=64,
+                   help="fleet KV fabric: push up to this many MRU pool "
+                        "pages into a newly spawned worker BEFORE it "
+                        "becomes routable (warm boot for autoscale "
+                        "scale-ups, restarts, and rollouts); 0 = off")
+    p.add_argument("--route-fabric-hit-weight", type=float, default=0.25,
+                   help="prefix-affinity: pages of prefill work one "
+                        "fabric-pool hit page is worth (fourth "
+                        "temperature: HBM-warm > host-warm > "
+                        "fabric-warm > cold — a fabric page saves the "
+                        "compute but pays deserialize + host->device "
+                        "swap-in; 0 ignores fabric warmth)")
     p.add_argument("--admission-queue-depth", type=int, default=0,
                    help="shed load (429 + Retry-After) when every "
                         "routable replica has this many requests queued "
@@ -547,6 +569,13 @@ def main() -> None:
                               route_hit_weight=args.route_hit_weight,
                               route_host_hit_weight=(
                                   args.route_host_hit_weight),
+                              fabric_cache_pages=args.fabric_cache_pages,
+                              fabric_publish_min_pages=(
+                                  args.fabric_publish_min_pages),
+                              fabric_warmboot_pages=(
+                                  args.fabric_warmboot_pages),
+                              route_fabric_hit_weight=(
+                                  args.route_fabric_hit_weight),
                               fleet=args.fleet,
                               worker_roles=worker_roles,
                               pd_prefill_nice=args.pd_prefill_nice,

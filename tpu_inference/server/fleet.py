@@ -56,6 +56,7 @@ from tpu_inference.config import (FrameworkConfig, class_rank,
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import Sequence
 from tpu_inference.engine.prefix_cache import _chain_hashes
+from tpu_inference.server import kv_fabric
 from tpu_inference.server.replicas import (FleetSaturated, FleetUnavailable,
                                            _RETRYABLE, _clone_request,
                                            aggregate_replica_stats)
@@ -417,6 +418,7 @@ class ProcessEngineGroup:
         self.requests_unavailable = 0
         self.route_prefix_hits = 0
         self.route_cold = 0
+        self.route_fabric_hits = 0      # dispatches that pulled fabric pages
         self.migrations = 0             # drain exports received
         self.migrated_pages = 0
         self.migrated_bytes = 0
@@ -500,8 +502,13 @@ class ProcessEngineGroup:
         self._recorder = telemetry.SpanRecorder(replica=-1)
         self._rr = 0
         self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0,
-                              "host_hit_pages": 0}
+                              "host_hit_pages": 0, "fabric_hit_pages": 0}
                              for _ in range(self.dp)]
+        # Fleet KV fabric (README "KV fabric"): router-resident pool of
+        # serialized prefix pages — workers publish via fabric_put event
+        # frames; pulls ship to the routed worker's host tier over the
+        # import-kv RPC before its submit.
+        self.fabric = kv_fabric.FabricPool(cfg.server.fabric_cache_pages)
         self._fleet_registry = telemetry.Registry()
         self._build_registry()
 
@@ -541,6 +548,15 @@ class ProcessEngineGroup:
             "tpu_inf_route_hit_pages",
             "Peeked prefix-cache hit pages per warm-routed dispatch",
             buckets=telemetry.COUNT_BUCKETS)
+        r.counter("tpu_inf_route_fabric_hits_total",
+                  "Dispatches that pulled fabric pages into the routed "
+                  "replica's host tier (fourth-temperature warmth)",
+                  fn=lambda: self.route_fabric_hits)
+        self._route_fabric_hit_pages_hist = r.histogram(
+            "tpu_inf_route_fabric_hit_pages",
+            "Fabric pages pulled per fabric-warm dispatch",
+            buckets=telemetry.COUNT_BUCKETS)
+        telemetry.register_fabric(r, self.fabric)
         r.counter("tpu_inf_fleet_migrations_total",
                   "In-flight requests migrated off a draining worker",
                   fn=lambda: self.migrations)
@@ -667,7 +683,7 @@ class ProcessEngineGroup:
         """Router-side rejections plus every worker's adopt/import
         rejections (healthz-cached; live counts, no carry needed —
         a corrupt blob implies a live incarnation that rejected it)."""
-        return self.kv_rejections + sum(
+        return self.kv_rejections + self.fabric.kv_rejections + sum(
             (h.last_health or {}).get("kv_integrity_rejections", 0)
             for h in self.workers)
 
@@ -793,6 +809,12 @@ class ProcessEngineGroup:
         h.pid = hello.get("pid")
         h.info = hello
         h.started_unix = time.time()
+        # Warm worker boot (README "KV fabric"): the fabric's hot set
+        # lands in the fresh worker's host tier BEFORE the UP flip
+        # makes it routable, so an autoscaled/restarted/upgraded worker
+        # serves its first request with fabric hits instead of booting
+        # stone-cold. No-op while the pool is empty (initial boot).
+        self._fabric_warmboot(h, client)
         h.state = UP
         h.consecutive_failures = 0
         self.warmup_total_s += hello.get("warmup_s", 0.0)
@@ -801,6 +823,39 @@ class ProcessEngineGroup:
         telemetry.log_event(
             "worker_up", level="info", replica=h.replica,
             pid=h.pid, incarnation=h.incarnation)
+
+    def _fabric_warmboot(self, h: WorkerHandle,
+                         client: WorkerClient) -> int:
+        """Push the fabric pool's MRU hot set (capped by
+        --fabric-warmboot-pages) into a just-booted worker's host tier
+        over import-kv. Each pooled blob re-verifies before shipping —
+        a corrupt entry is dropped and counted, never shipped. Best
+        effort: any failure leaves the worker cold but serviceable."""
+        hot = self.fabric.hot_set(self.server_cfg.fabric_warmboot_pages)
+        pairs = []
+        for d, b in hot:
+            try:
+                pairs.append((d, kvc.deserialize_host_pages(b)[0]))
+            except kvc.integrity.KVIntegrityError:
+                self.fabric.reject(d)
+        if not pairs:
+            return 0
+        try:
+            r = client.rpc(
+                "import-kv",
+                blob=kvc.serialize_host_pages([p for _, p in pairs]),
+                digests=[d.hex() for d, _ in pairs],
+                idem=f"wb{h.replica}.{h.incarnation}")
+        except (WorkerGone, TimeoutError, RuntimeError) as e:
+            telemetry.log_event("fabric_warmboot_failed",
+                                level="warning", replica=h.replica,
+                                error=str(e))
+            return 0
+        adopted = int(r.get("adopted", 0))
+        telemetry.log_event(
+            "fabric_warmboot", level="info", replica=h.replica,
+            offered=len(pairs), adopted=adopted)
+        return adopted
 
     def _ensure_started(self) -> None:
         with self._start_lock:
@@ -1054,7 +1109,7 @@ class ProcessEngineGroup:
                     pass
             if self._quarantine_if_poison(entry):
                 continue
-            if h.routable and self._dispatch(entry, h, (0, 0)):
+            if h.routable and self._dispatch(entry, h, (0, 0, 0)):
                 continue
             self._retry_or_fail(entry, exclude=h)
 
@@ -1272,63 +1327,63 @@ class ProcessEngineGroup:
     def _pick(self, cands: List[WorkerHandle],
               seq: Optional[Sequence] = None,
               phase: Optional[str] = None
-              ) -> Tuple[WorkerHandle, Tuple[int, int], int]:
-        """Choose a worker; returns (handle, (hbm, host) peeked pages,
-        load at decision time). Candidate peeks fan out concurrently
-        (_peek_many). For prefill work (and the mixed fleet) the score
-        is the same three-temperature formula as EngineGroup._pick
-        (replicas.py — the in-process fleet is the documented contract):
-        queue depth + prompt pages minus the prefix peek. For
+              ) -> Tuple[WorkerHandle, Tuple[int, int, int], int]:
+        """Choose a worker; returns (handle, (hbm, host, fabric_extra)
+        peeked pages, load at decision time). Candidate peeks fan out
+        concurrently (_peek_many); the fabric depth comes from the
+        router's OWN pool index — no extra RPC. The scores are
+        kv_fabric.prefill_route_score / decode_route_score — THE
+        four-temperature formulas shared with EngineGroup._pick
+        (replicas.py — the in-process fleet is the documented
+        contract), so the two backends cannot drift. For
         ``phase="decode"`` under a P/D split the score flips to the
-        decode side's costs — ladder occupancy + load, minus host-warm
-        pages (a handoff lands on the least-loaded decode worker, warmth
-        breaking ties):
-
-            route_load_pages * load
-              + route_occupancy_pages * ladder_occupancy
-              - route_hit_weight * hbm - route_host_hit_weight * host
-              (+ a pressure penalty)
-        """
+        decode side's costs — ladder occupancy + load, minus the
+        warmth discounts (a handoff lands on the least-loaded decode
+        worker, warmth breaking ties)."""
         cfg = self.server_cfg
         digests: List[bytes] = []
         prompt_pages = 0
         if seq is not None and cfg.routing == "prefix_affinity":
             digests, prompt_pages = self._digests_for(seq)
+        fdepth = self.fabric.match_depth(digests)
         peeks = self._peek_many(cands, digests)
         if phase == "decode" and self.pd_enabled:
             scored = []
             for h, p in zip(cands, peeks):
                 occ = float(p.get("occupancy") or 0.0)
-                score = (cfg.route_load_pages * p["load"]
-                         + cfg.route_occupancy_pages * occ
-                         - cfg.route_hit_weight * p["hbm"]
-                         - cfg.route_host_hit_weight * p["host"])
-                if p["pressure"]:
-                    score += cfg.route_occupancy_pages + 1
+                fx = kv_fabric.fabric_extra_pages(
+                    fdepth, p["hbm"] + p["host"], prompt_pages)
+                score = kv_fabric.decode_route_score(
+                    cfg, hbm=p["hbm"], host=p["host"], fabric=fx,
+                    load=p["load"], occupancy=occ,
+                    pressured=p["pressure"])
                 scored.append(((score, p["pressure"], p["load"]),
-                               h, (p["hbm"], p["host"]), p["load"]))
+                               h, (p["hbm"], p["host"], fx), p["load"]))
             best = min(key for key, _, _, _ in scored)
             return self._rotate([(h, hit, load)
                                  for key, h, hit, load in scored
                                  if key == best])
-        if digests and any(p["hbm"] + p["host"] for p in peeks):
+        if digests and (fdepth > 0
+                        or any(p["hbm"] + p["host"] for p in peeks)):
             scored = []
             for h, p in zip(cands, peeks):
-                score = (prompt_pages - cfg.route_hit_weight * p["hbm"]
-                         - cfg.route_host_hit_weight * p["host"]
-                         + cfg.route_load_pages * p["load"])
-                if p["pressure"]:
-                    score += prompt_pages + 1
+                fx = kv_fabric.fabric_extra_pages(
+                    fdepth, p["hbm"] + p["host"], prompt_pages)
+                score = kv_fabric.prefill_route_score(
+                    cfg, prompt_pages=prompt_pages, hbm=p["hbm"],
+                    host=p["host"], fabric=fx, load=p["load"],
+                    pressured=p["pressure"])
                 scored.append(((score, p["pressure"], p["load"]),
-                               h, (p["hbm"], p["host"]), p["load"]))
+                               h, (p["hbm"], p["host"], fx), p["load"]))
             best = min(key for key, _, _, _ in scored)
             return self._rotate([(h, hit, load)
                                  for key, h, hit, load in scored
                                  if key == best])
-        keyed = [((p["pressure"], p["load"]), h, p["load"])
+        keyed = [(kv_fabric.cold_route_key(p["pressure"], p["load"]),
+                  h, p["load"])
                  for h, p in zip(cands, peeks)]
         best = min(key for key, _, _ in keyed)
-        return self._rotate([(h, (0, 0), load)
+        return self._rotate([(h, (0, 0, 0), load)
                              for key, h, load in keyed if key == best])
 
     # ------------------------------------------------------- submission
@@ -1360,7 +1415,8 @@ class ProcessEngineGroup:
         h, hit, load = self._pick(pool, seq)
         self._recorder.add(
             "route", seq.trace_id, t_route, time.perf_counter(),
-            dest=h.replica, hbm_hit=hit[0], host_hit=hit[1], load=load)
+            dest=h.replica, hbm_hit=hit[0], host_hit=hit[1],
+            fabric_hit=hit[2], load=load)
         cap = self.server_cfg.admission_queue_depth
         if cap > 0 and load >= cap:
             # Affinity saturated a warm worker: least-loaded fallback
@@ -1384,7 +1440,7 @@ class ProcessEngineGroup:
                     vw = self._preempt_for_interactive()
                     if vw is None:
                         self._shed(seq, cls, load2, cap)
-                    h, hit = vw, (0, 0)
+                    h, hit = vw, (0, 0, 0)
                 else:
                     self._shed(seq, cls, load2, cap)
             else:
@@ -1397,11 +1453,48 @@ class ProcessEngineGroup:
         if not self._dispatch(entry, h, hit):
             self._retry_or_fail(entry, exclude=h)
 
-    def _peek_hit(self, h: WorkerHandle, seq: Sequence) -> Tuple[int, int]:
+    def _peek_hit(self, h: WorkerHandle,
+                  seq: Sequence) -> Tuple[int, int, int]:
         if self.server_cfg.routing != "prefix_affinity":
-            return (0, 0)
-        p = self._peek(h, self._digests_for(seq)[0])
-        return (p["hbm"], p["host"])
+            return (0, 0, 0)
+        digests, prompt_pages = self._digests_for(seq)
+        p = self._peek(h, digests)
+        fx = kv_fabric.fabric_extra_pages(
+            self.fabric.match_depth(digests), p["hbm"] + p["host"],
+            prompt_pages)
+        return (p["hbm"], p["host"], fx)
+
+    def _fabric_pull(self, h: WorkerHandle, t: Sequence, warm: int,
+                     fabric_extra: int, entry: "_Tracked") -> int:
+        """Ship the fabric run beyond ``warm`` pages into worker ``h``'s
+        host tier (import-kv). get_pages crc-verifies every blob — a
+        corrupt or evicted-since-peek entry just shortens the run — and
+        the pages re-serialize into one import blob whose embedded
+        digests the worker re-verifies on adoption. Returns the pages
+        actually shipped and applied (0 on any transport failure: the
+        dispatch proceeds cold — the fabric is an accelerator, never a
+        correctness dependency)."""
+        if h.client is None:
+            return 0
+        digests = self._digests_for(t)[0]
+        entries = self.fabric.get_pages(
+            digests[warm:warm + fabric_extra])
+        if not entries:
+            return 0
+        try:
+            blob = kvc.serialize_host_pages([p for _, p in entries])
+            r = h.client.rpc(
+                "import-kv", blob=blob,
+                digests=[d.hex() for d, _ in entries],
+                idem=f"f{t.request_id}.{entry.attempts}."
+                     f"{entry.generation}")
+            if not r.get("applied"):
+                return 0
+        except (WorkerGone, TimeoutError, RuntimeError) as e:
+            telemetry.log_event("fabric_pull_failed", level="warning",
+                                replica=h.replica, error=str(e))
+            return 0
+        return len(entries)
 
     def _shed(self, seq: Sequence, cls: str, load: int, cap: int) -> None:
         """Terminal 429: count it (globally and per class) and raise.
@@ -1514,19 +1607,29 @@ class ProcessEngineGroup:
                 self._retry_or_fail(entry, exclude=h)
 
     def _dispatch(self, entry: _Tracked, h: WorkerHandle,
-                  hit: Tuple[int, int]) -> bool:
+                  hit: Tuple[int, int, int]) -> bool:
         """Submit one attempt to one worker. Returns False when the
         worker refused (dead/draining) so the caller can re-route."""
         t = entry.template
         gen_tokens = list(entry.tokens)
         with self._lock:
             entry.worker, entry.client = h, h.client
-        hbm, host = hit
-        total_hit = hbm + host
+        hbm, host, fabric_extra = hit
+        # Fabric pull (README "KV fabric"): pages the router's pool
+        # covers beyond this worker's own warm depth ship to its host
+        # tier over the import-kv RPC BEFORE the submit — the verb
+        # replies only after the engine loop applied the import, so
+        # this request's prefill is guaranteed to see them.
+        fabric_pulled = 0
+        if fabric_extra > 0:
+            fabric_pulled = self._fabric_pull(
+                h, t, hbm + host, fabric_extra, entry)
+        total_hit = hbm + host + fabric_pulled
         sl = entry.seq_local
         sl.routed_replica = h.replica
         sl.route_hit_pages = total_hit
         sl.route_host_hit_pages = host
+        sl.route_fabric_hit_pages = fabric_pulled
         sl.attempt = entry.attempts
         stats = self._route_stats[h.replica]
         if total_hit > 0:
@@ -1538,6 +1641,10 @@ class ProcessEngineGroup:
         else:
             self.route_cold += 1
             stats["cold"] += 1
+        if fabric_pulled > 0:
+            self.route_fabric_hits += 1
+            stats["fabric_hit_pages"] += fabric_pulled
+            self._route_fabric_hit_pages_hist.observe(fabric_pulled)
         if gen_tokens:
             self.resume_resubmits += 1
             entry.resume_stream_len = (
@@ -1545,6 +1652,9 @@ class ProcessEngineGroup:
                     self.engine_cfg.max_context - 1))
         payload = {
             "request_id": t.request_id,
+            "route_hit_pages": total_hit,
+            "route_host_hit_pages": host,
+            "route_fabric_hit_pages": fabric_pulled,
             "prompt_tokens": list(t.prompt_tokens),
             "max_new_tokens": t.max_new_tokens,
             "temperature": t.temperature, "top_p": t.top_p,
@@ -1699,8 +1809,34 @@ class ProcessEngineGroup:
                                   obj.get("spans") or ())
         elif ev == "migrate":
             self._on_migrate(h, client, obj, blob)
+        elif ev == "fabric_put":
+            self._on_fabric_put(h, obj, blob)
         elif ev == "drained":
             self._on_drained(h, client, obj)
+
+    def _on_fabric_put(self, h: WorkerHandle, obj: dict,
+                       blob: bytes) -> None:
+        """Ingest a worker's published prefix pages into the fabric
+        pool (README "KV fabric"). The frame carries per-page blob
+        lengths so the event thread slices without deserializing;
+        integrity is enforced at get time (every pull re-verifies its
+        blob's crc32c), so a corrupt publish can occupy a slot but can
+        never be adopted. A frame whose lengths disagree with the blob
+        is dropped whole — never partially ingested."""
+        digests = obj.get("digests") or ()
+        lens = obj.get("lens") or ()
+        if len(digests) != len(lens) or sum(lens) != len(blob):
+            with self._lock:
+                self.frame_errors += 1
+            telemetry.log_event(
+                "fabric_put_malformed", level="warning",
+                replica=h.replica, digests=len(digests),
+                lens=len(lens), blob_bytes=len(blob))
+            return
+        off = 0
+        for d, n in zip(digests, lens):
+            self.fabric.put_blob(bytes.fromhex(d), blob[off:off + n])
+            off += n
 
     def _entry_for(self, rid: int, h: WorkerHandle,
                    client: WorkerClient) -> Optional[_Tracked]:
@@ -1851,6 +1987,29 @@ class ProcessEngineGroup:
             self._flight.capture("kv_corruption", min_interval_s=0.0)
         return b""
 
+    def _fabric_salvage(self, digests: List[bytes], blob: bytes,
+                        rid: int, path: str) -> int:
+        """Pool-mediated fallback for a point-to-point KV transfer
+        whose destination vanished (README "KV fabric" decision table):
+        park the export's full prompt-prefix pages in the fabric pool,
+        keyed by their chain digests, so the eventual resubmission's
+        fabric pull restores them instead of re-prefilling the whole
+        stream. Partial/suffix pages beyond the digest chain are not
+        poolable (chain digests key FULL pages only) and still ride the
+        recompute path. Returns pages parked."""
+        if self.fabric.capacity <= 0 or not blob or not digests:
+            return 0
+        try:
+            pages = kvc.deserialize_host_pages(blob)
+        except Exception:  # noqa: BLE001 — checked upstream; best-effort
+            return 0
+        n = self.fabric.put_pages(list(zip(digests, pages)))
+        if n:
+            telemetry.log_event(
+                "fabric_salvage", level="info", path=path,
+                request_id=rid, pages=n)
+        return n
+
     def _on_handoff(self, h, client, obj, blob) -> None:
         """A prefill worker settled a prompt's prefill and exported the
         LIVE sequence (README "P/D disaggregation"): KV pages including
@@ -1892,6 +2051,13 @@ class ProcessEngineGroup:
             pool = ([w for w in self._routable() if w is not h]
                     or self._routable())
         if not pool:
+            # Point-to-point handoff lost its destination: park the
+            # settled prefix in the fabric pool so whichever worker the
+            # grace-window retry eventually finds pulls it from the
+            # pool instead of re-prefilling the whole stream.
+            self._fabric_salvage(
+                self._digests_for(entry.template)[0], blob, rid,
+                "handoff")
             self._retry_or_fail(entry)     # already claimed above
             return
         dest, hit, _ = self._pick(pool, entry.template, phase="decode")
@@ -1956,6 +2122,11 @@ class ProcessEngineGroup:
         others = ([w for w in self._phase_pool(phase) if w is not h]
                   or [w for w in self._routable() if w is not h])
         if not others:
+            # Migration lost its destination: park the exported pages
+            # in the fabric pool (keyed by the digests the export
+            # carried) so the grace-window retry's dispatch pulls them
+            # back instead of recompute-prefilling the stream.
+            self._fabric_salvage(digests, blob, rid, "migrate")
             # No exclude: this entry is already claimed (detached) by
             # the block above and no dispatch was attempted — the guard
             # in _retry_or_fail only applies after a failed dispatch.
@@ -2144,7 +2315,8 @@ class ProcessEngineGroup:
             self.roles.append(role)
             self._route_stats.append({"hits": 0, "cold": 0,
                                       "hit_pages": 0,
-                                      "host_hit_pages": 0})
+                                      "host_hit_pages": 0,
+                                      "fabric_hit_pages": 0})
             self.workers.append(h)
         self._register_worker_gauges(h)
         return h
@@ -2398,6 +2570,11 @@ class ProcessEngineGroup:
                 "requests_unavailable": self.requests_unavailable,
                 "route_prefix_hits": self.route_prefix_hits,
                 "route_cold": self.route_cold,
+                # Fleet KV fabric (README "KV fabric"): same keys as
+                # the in-process backend's view.
+                "route_fabric_hits": self.route_fabric_hits,
+                "fabric_puts": self.fabric.puts,
+                "fabric_hits": self.fabric.hits,
                 "preemptions": sum(d.get("preemptions", 0)
                                    for d in stats),
                 "recompute_resumes": sum(d.get("recompute_resumes", 0)
@@ -2469,7 +2646,8 @@ class ProcessEngineGroup:
                       "swap_in_resumes", "prefill_backlog",
                       "ladder_occupancy", "pd_handoffs", "pd_adoptions",
                       "pd_adopt_fallbacks", "slo",
-                      "kv_integrity_rejections"):
+                      "kv_integrity_rejections",
+                      "fabric_published_pages"):
                 if k in hz:
                     d[k] = hz[k]
             replicas.append(d)
@@ -2493,6 +2671,9 @@ class ProcessEngineGroup:
             # Fleet-aggregated rolling SLO view: EXACT quantiles pooled
             # across worker windows (the autoscaler's input signal).
             "slo": self._fleet_slo(),
+            # Fleet KV fabric pool occupancy + churn (README "KV
+            # fabric"); same shape under both fleet backends.
+            "fabric": self.fabric.snapshot(),
             "supervision": self.supervision_counters(),
         }
 

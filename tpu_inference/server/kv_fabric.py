@@ -1,0 +1,267 @@
+"""Fleet KV fabric: a router-side, digest-keyed pool of serialized KV
+prefix pages shared by EVERY replica (README "KV fabric").
+
+At million-user scale most traffic shares system prompts and few-shot
+prefixes, but a prefix prefilled on replica A is invisible to replica
+B — each replica pays its own prefill for the same bytes, and autoscaled
+workers boot stone-cold. Mooncake (Qin et al., 2024) showed a
+disaggregated fleet-shared KVCache pool is the single biggest lever for
+exactly this workload. The substrate already exists in this tree:
+``serialize_host_pages`` is a bit-exact, crc32c-carrying wire format
+for every kv_quant mode, the ``import-kv`` RPC moves pages between any
+two workers, and the prefix chain digests are self-contained keys. This
+module generalizes them into a fabric:
+
+- **FabricPool** — a capacity-bounded (in pages) LRU of per-page
+  serialized blobs living in the ROUTER process, identical under
+  ``--fleet in-process|subprocess``. Workers publish settled prefix
+  pages after prefill; a prefill routed anywhere pulls matching fabric
+  entries into that replica's host tier (the existing
+  ``request_import_host`` path) before prefilling — so a prefix
+  prefilled on ANY replica warms ALL replicas, byte-identically.
+- **Integrity** — every ``get`` re-verifies the per-blob crc32c before
+  adoption: a corrupt pool entry is dropped, counted, and treated as a
+  miss, never adopted silently (the Byzantine-transport stance).
+- **Routing score helpers** — THE prefill/decode scoring formulas both
+  fleet backends share (previously copy-pasted five times), grown a
+  fourth cache temperature: fabric-warm scores between host-warm and
+  cold, from the router's own local index — no extra RPC.
+
+Thread stance: one lock around the OrderedDict (puts arrive from event
+threads, gets from submit threads, scoring peeks from pickers); counter
+reads are GIL-atomic like the rest of the telemetry layer.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_inference.engine import kv_cache as kvc
+
+
+class _Entry:
+    __slots__ = ("blob", "nbytes")
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+        self.nbytes = len(blob)
+
+
+class FabricPool:
+    """Digest-keyed LRU pool of individually-serialized KV pages.
+
+    One entry = one page = one ``serialize_host_pages([page])`` blob, so
+    entries evict independently and every ``get`` can re-verify its own
+    crc32c. Digests are the prefix chain hashes (``_chain_hashes``):
+    self-contained keys, so any contiguous-from-page-0 subset resident
+    anywhere still matches.
+    """
+
+    def __init__(self, capacity_pages: int):
+        self.capacity = max(0, int(capacity_pages))
+        self._entries: "collections.OrderedDict[bytes, _Entry]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._bytes = 0
+        # Monotone counters (telemetry.register_fabric read-through).
+        self.hits = 0                  # pages served by get_pages
+        self.misses = 0                # lookups that ended short
+        self.puts = 0                  # pages accepted (incl. supersede)
+        self.superseded = 0            # puts that replaced a live entry
+        self.evictions = 0             # LRU capacity drops
+        self.kv_rejections = 0         # corrupt entries dropped on get
+
+    # ------------------------------------------------------------- put
+
+    def put_blob(self, digest: bytes, blob: bytes) -> None:
+        """Insert/supersede ONE page's serialized blob under its chain
+        digest. Re-publishing the same prefix from a second replica
+        stores once (byte-identical pages; the fresh blob supersedes),
+        and the entry moves to MRU either way."""
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                self.superseded += 1
+            while len(self._entries) >= self.capacity:
+                _, ev = self._entries.popitem(last=False)
+                self._bytes -= ev.nbytes
+                self.evictions += 1
+            e = _Entry(blob)
+            self._entries[digest] = e
+            self._bytes += e.nbytes
+            self.puts += 1
+
+    def put_pages(self, pairs: Sequence[Tuple[bytes, "kvc.HostKVPage"]]
+                  ) -> int:
+        """Publish (digest, HostKVPage) pairs — the in-process backend's
+        direct path (the subprocess router ingests pre-serialized blobs
+        from worker event frames instead). Returns pages stored."""
+        n = 0
+        for digest, page in pairs:
+            self.put_blob(digest, kvc.serialize_host_pages([page]))
+            n += 1
+        return n
+
+    # ------------------------------------------------------------- get
+
+    def match_depth(self, digests: Sequence[bytes]) -> int:
+        """Contiguous-from-page-0 pages resident for this digest chain.
+        Side-effect-free (no counters, no LRU touch): the router's
+        scoring peek, called once per candidate scan."""
+        if self.capacity <= 0 or not digests:
+            return 0
+        with self._lock:
+            n = 0
+            for d in digests:
+                if d not in self._entries:
+                    break
+                n += 1
+            return n
+
+    def get_pages(self, digests: Sequence[bytes]
+                  ) -> List[Tuple[bytes, "kvc.HostKVPage"]]:
+        """Pull the contiguous run of pages for ``digests``, verifying
+        each blob's crc32c before adoption. A corrupt entry is dropped
+        from the pool, counted under kv_rejections, and ends the run (a
+        miss — never adopted silently). Served entries move to MRU."""
+        out: List[Tuple[bytes, "kvc.HostKVPage"]] = []
+        for d in digests:
+            with self._lock:
+                e = self._entries.get(d)
+                if e is not None:
+                    self._entries.move_to_end(d)
+            if e is None:
+                self.misses += 1
+                break
+            try:
+                page = kvc.deserialize_host_pages(e.blob)[0]
+            except kvc.integrity.KVIntegrityError:
+                with self._lock:
+                    live = self._entries.pop(d, None)
+                    if live is not None:
+                        self._bytes -= live.nbytes
+                self.kv_rejections += 1
+                self.misses += 1
+                break
+            self.hits += 1
+            out.append((d, page))
+        return out
+
+    def reject(self, digest: bytes) -> None:
+        """Drop a corrupt entry discovered OUTSIDE get_pages (e.g. the
+        warmboot re-verify) — counted exactly like a get-time
+        integrity rejection, never adopted silently."""
+        with self._lock:
+            live = self._entries.pop(digest, None)
+            if live is not None:
+                self._bytes -= live.nbytes
+        self.kv_rejections += 1
+
+    def hot_set(self, max_pages: int) -> List[Tuple[bytes, bytes]]:
+        """The MRU-first (digest, blob) list for warm worker boot —
+        puts land in chain order, so MRU slices keep prefix chains
+        roughly intact. No counter side effects (the import's adoption
+        is what the warmboot grade counts)."""
+        if max_pages <= 0:
+            return []
+        with self._lock:
+            ds = list(self._entries)[-max_pages:]
+            ds.reverse()
+            return [(d, self._entries[d].blob) for d in ds]
+
+    # ------------------------------------------------------ accounting
+
+    @property
+    def used(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes_used(self) -> int:
+        return self._bytes
+
+    def snapshot(self) -> Dict[str, int]:
+        """Operator view for /healthz (both fleet backends emit the
+        identical shape under ``"fabric"``)."""
+        return {
+            "capacity_pages": self.capacity,
+            "pages_used": self.used,
+            "bytes_used": self.bytes_used,
+            "hits": self.hits,
+            "misses": self.misses,
+            "puts": self.puts,
+            "superseded": self.superseded,
+            "evictions": self.evictions,
+            "kv_rejections": self.kv_rejections,
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+# ---------------------------------------------------------------------------
+# Shared routing-score formulas (README "Cache-aware routing").
+#
+# Before this module the prefill and decode scores were copy-pasted five
+# times across server/replicas.py and server/fleet.py; the two fleet
+# backends could silently drift. These are now THE formulas — both
+# backends call them, and the fourth temperature (fabric-warm, weighted
+# between host-warm and cold) exists in exactly one place.
+# ---------------------------------------------------------------------------
+
+
+def fabric_extra_pages(fabric_depth: int, warm_depth: int,
+                       prompt_pages: int) -> int:
+    """Pages the fabric covers BEYOND a candidate's own warm depth
+    (HBM + host): only those earn the fabric discount — pages the
+    replica already holds are scored at their warmer tier."""
+    return max(0, min(int(fabric_depth), int(prompt_pages))
+               - int(warm_depth))
+
+
+def prefill_route_score(cfg, *, prompt_pages: int, hbm: float, host: float,
+                        fabric: float, load: float,
+                        pressured: bool) -> float:
+    """Expected prefill cost in pages, load-blended: prompt pages minus
+    warmth discounts (HBM at route_hit_weight, host at
+    route_host_hit_weight, fabric-covered remainder at
+    route_fabric_hit_weight — between host-warm and cold) plus queue
+    depth; KV-pressured candidates are shifted behind every unpressured
+    one without erasing relative order."""
+    score = (prompt_pages
+             - cfg.route_hit_weight * hbm
+             - cfg.route_host_hit_weight * host
+             - cfg.route_fabric_hit_weight * fabric
+             + cfg.route_load_pages * load)
+    if pressured:
+        score += prompt_pages + 1
+    return score
+
+
+def decode_route_score(cfg, *, hbm: float, host: float, fabric: float,
+                       load: float, occupancy: float,
+                       pressured: bool) -> float:
+    """Decode/P-D destination cost: load + lane occupancy minus the
+    same three warmth discounts (a decode destination holding the
+    sequence's pages adopts without a swap-in), pressure-shifted like
+    the prefill score."""
+    score = (cfg.route_load_pages * load
+             + cfg.route_occupancy_pages * occupancy
+             - cfg.route_hit_weight * hbm
+             - cfg.route_host_hit_weight * host
+             - cfg.route_fabric_hit_weight * fabric)
+    if pressured:
+        score += cfg.route_occupancy_pages + 1
+    return score
+
+
+def cold_route_key(pressured: bool, load: float) -> Tuple[bool, float]:
+    """The cold-fallthrough sort key (no peek data): unpressured first,
+    then least loaded — ties rotate via the caller's round-robin."""
+    return (bool(pressured), load)

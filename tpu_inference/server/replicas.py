@@ -43,6 +43,7 @@ from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import InferenceEngine, Sequence
 from tpu_inference.engine.prefix_cache import _chain_hashes
 from tpu_inference.engine.scheduler import EngineScheduler
+from tpu_inference.server import kv_fabric
 
 
 class AdmissionError(RuntimeError):
@@ -233,9 +234,21 @@ class EngineGroup:
         self._rr = 0                    # rotating tie-break cursor
         self.route_prefix_hits = 0      # dispatches with peeked hit > 0
         self.route_cold = 0             # dispatches with no cached prefix
+        self.route_fabric_hits = 0      # dispatches that pulled fabric pages
         self._route_stats = [{"hits": 0, "cold": 0, "hit_pages": 0,
-                              "host_hit_pages": 0}
+                              "host_hit_pages": 0, "fabric_hit_pages": 0}
                              for _ in engines]
+        # Fleet KV fabric (README "KV fabric"): the router-side
+        # digest-keyed pool of serialized prefix pages shared by every
+        # replica. In-process, publish is a direct call (each engine's
+        # fabric_publish is armed below); pulls land in the target
+        # engine's host tier via request_import_host before dispatch.
+        self.fabric = kv_fabric.FabricPool(self.server_cfg.fabric_cache_pages)
+        for e in engines:
+            if self.fabric.capacity > 0 and e.prefix_cache is not None:
+                e.fabric_publish = self.fabric.put_pages
+                e.fabric_publish_min_pages = \
+                    self.server_cfg.fabric_publish_min_pages
         self._watch_stop = threading.Event()
         self._watch_thread: Optional[threading.Thread] = None
         # Cross-replica trace assembly (README "Observability"): each
@@ -279,7 +292,8 @@ class EngineGroup:
                   "KV blobs rejected on a failed end-to-end digest "
                   "check (recompute fallback, never adopted silently)",
                   fn=lambda: sum(e.kv_integrity_rejections
-                                 for e in self.engines))
+                                 for e in self.engines)
+                  + self.fabric.kv_rejections)
         r.counter("tpu_inf_route_prefix_hits_total",
                   "Dispatches routed with a non-zero prefix-cache peek "
                   "(the request landed on a warm replica)",
@@ -292,6 +306,15 @@ class EngineGroup:
             "tpu_inf_route_hit_pages",
             "Peeked prefix-cache hit pages per warm-routed dispatch",
             buckets=telemetry.COUNT_BUCKETS)
+        r.counter("tpu_inf_route_fabric_hits_total",
+                  "Dispatches that pulled fabric pages into the routed "
+                  "replica's host tier (fourth-temperature warmth)",
+                  fn=lambda: self.route_fabric_hits)
+        self._route_fabric_hit_pages_hist = r.histogram(
+            "tpu_inf_route_fabric_hit_pages",
+            "Fabric pages pulled per fabric-warm dispatch",
+            buckets=telemetry.COUNT_BUCKETS)
+        telemetry.register_fabric(r, self.fabric)
         for i, health in enumerate(self.health):
             r.gauge("tpu_inf_replica_routable",
                     "1 when the replica accepts traffic (not quarantined)",
@@ -429,7 +452,8 @@ class EngineGroup:
         not under preemption pressure: a request routed to a pressured
         replica would likely trigger (or suffer) a preemption that a
         sibling with free pages avoids entirely."""
-        return (sched.engine.under_pressure, sched.load)
+        return kv_fabric.cold_route_key(sched.engine.under_pressure,
+                                        sched.load)
 
     def _rotate(self, ties: list):
         """Deterministic rotating pick among equal-key candidates.
@@ -470,29 +494,26 @@ class EngineGroup:
 
     def _pick(self, cands: List[EngineScheduler],
               seq: Optional[Sequence] = None
-              ) -> Tuple[EngineScheduler, Tuple[int, int]]:
+              ) -> Tuple[EngineScheduler, Tuple[int, int, int]]:
         """Choose a replica for one request; returns (scheduler,
-        (hbm_hit_pages, host_hit_pages) peeked on that scheduler).
+        (hbm_hit_pages, host_hit_pages, fabric_extra_pages) peeked on
+        that scheduler).
 
         prefix_affinity with a token-bearing request scores each
-        candidate in KV-page units across THREE temperatures — HBM-warm
-        > host-warm > cold (README "Tiered KV cache"):
-
-            prompt_pages - route_hit_weight * hbm_hit_pages
-              - route_host_hit_weight * host_hit_pages
-              + route_load_pages * load
-              + (prompt_pages + 1 if under preemption pressure)
-
-        i.e. the prefill work this replica would actually redo — a
+        candidate in KV-page units across FOUR temperatures — HBM-warm
+        > host-warm > fabric-warm > cold (README "KV fabric") — via
+        kv_fabric.prefill_route_score, THE formula both fleet backends
+        share: the prefill work this replica would actually redo (a
         host-tier page saves the prefill compute but still pays a
-        host->device swap-in, so it scores below an HBM page at the
-        default weights — plus a queue-depth blend, plus a pressure
-        penalty sized so that at the default hit weight a fully-warm
-        pressured replica still loses to a cold idle one (a pressured
-        replica likely preempts — and recompute-prefills — whatever
-        lands on it); a larger --route-hit-weight buys warmth back past
-        that. Ties break by the legacy (pressure, load) key, then
-        rotate. When NO candidate holds any prefix page in either tier
+        host->device swap-in; a fabric page additionally pays the pool
+        pull, so it scores below host at the default weights) plus a
+        queue-depth blend, plus a pressure penalty sized so that at the
+        default hit weight a fully-warm pressured replica still loses
+        to a cold idle one. The fabric term counts only the pages the
+        pool covers BEYOND the candidate's own warm depth, from the
+        router's own local index — no extra RPC. Ties break by the
+        legacy (pressure, load) key, then rotate. When NO candidate
+        holds any prefix page in either tier and the fabric holds none
         (or routing="least_loaded"), the score reduces to (pressure,
         load) + rotation — plain least-loaded. A single warm candidate
         is still peeked so the routing counters and span report the
@@ -506,22 +527,24 @@ class EngineGroup:
         cfg = self.server_cfg
         if seq is not None and cfg.routing == "prefix_affinity":
             digests, prompt_pages = self._digests_for(seq)
+            fdepth = self.fabric.match_depth(digests)
             hits = []
             for sched in cands:
                 pc = sched.engine.prefix_cache
                 hits.append(pc.peek_digests_tiered(digests)
                             if pc is not None else (0, 0))
-            if any(h + w for h, w in hits):
+            if any(h + w for h, w in hits) or fdepth > 0:
                 scored = []
                 for sched, (hbm, host) in zip(cands, hits):
-                    score = (prompt_pages - cfg.route_hit_weight * hbm
-                             - cfg.route_host_hit_weight * host
-                             + cfg.route_load_pages * sched.load)
+                    fx = kv_fabric.fabric_extra_pages(
+                        fdepth, hbm + host, prompt_pages)
                     pressured = sched.engine.under_pressure
-                    if pressured:
-                        score += prompt_pages + 1
+                    score = kv_fabric.prefill_route_score(
+                        cfg, prompt_pages=prompt_pages, hbm=hbm,
+                        host=host, fabric=fx, load=sched.load,
+                        pressured=pressured)
                     scored.append(((score, pressured, sched.load),
-                                   sched, (hbm, host)))
+                                   sched, (hbm, host, fx)))
                 best = min(key for key, _, _ in scored)
                 return self._rotate([(s, h) for key, s, h in scored
                                      if key == best])
@@ -529,22 +552,26 @@ class EngineGroup:
             # truth, not an accounting shortcut).
         keyed = [(self._route_key(sched), sched) for sched in cands]
         best = min(key for key, _ in keyed)
-        return self._rotate([(s, (0, 0)) for key, s in keyed
+        return self._rotate([(s, (0, 0, 0)) for key, s in keyed
                              if key == best])
 
     def _peek_replica(self, sched: EngineScheduler,
-                      seq: Sequence) -> Tuple[int, int]:
-        """One replica's peeked (hbm, host) hit pages for a request
-        (accounting on paths that chose by load, e.g. the admission-cap
-        fallback). Reuses the digest list _pick just cached on the
-        Sequence — the fallback fires on exactly the overloaded path
-        where a second full hash pass would hurt most."""
+                      seq: Sequence) -> Tuple[int, int, int]:
+        """One replica's peeked (hbm, host, fabric_extra) hit pages for
+        a request (accounting on paths that chose by load, e.g. the
+        admission-cap fallback). Reuses the digest list _pick just
+        cached on the Sequence — the fallback fires on exactly the
+        overloaded path where a second full hash pass would hurt most."""
         if self.server_cfg.routing != "prefix_affinity":
-            return (0, 0)
+            return (0, 0, 0)
         pc = sched.engine.prefix_cache
         if pc is None:
-            return (0, 0)
-        return pc.peek_digests_tiered(self._digests_for(seq)[0])
+            return (0, 0, 0)
+        digests, prompt_pages = self._digests_for(seq)
+        hbm, host = pc.peek_digests_tiered(digests)
+        fx = kv_fabric.fabric_extra_pages(
+            self.fabric.match_depth(digests), hbm + host, prompt_pages)
+        return (hbm, host, fx)
 
     def _least_loaded(self) -> EngineScheduler:
         routable = self._routable()
@@ -600,7 +627,8 @@ class EngineGroup:
         self._recorder.add(
             "route", seq.trace_id, t_route, time.perf_counter(),
             dest=self.schedulers.index(sched),
-            hbm_hit=hit_pages[0], host_hit=hit_pages[1])
+            hbm_hit=hit_pages[0], host_hit=hit_pages[1],
+            fabric_hit=hit_pages[2])
         cap = self.server_cfg.admission_queue_depth
         if cap > 0 and sched.load >= cap:
             # The affinity pick can saturate a warm replica while a cold
@@ -629,7 +657,7 @@ class EngineGroup:
 
     def _dispatch(self, entry: _Tracked, seq: Sequence,
                   sched: EngineScheduler,
-                  hit_pages: Tuple[int, int] = (0, 0)) -> None:
+                  hit_pages: Tuple[int, int, int] = (0, 0, 0)) -> None:
         gen = entry.generation
         entry.sched = sched
         # Mark the span: attempt >= 1 means this is a failover
@@ -637,13 +665,31 @@ class EngineGroup:
         seq.attempt = entry.attempts
         # Routing span + fleet accounting: every dispatch (initial or
         # failover resubmission) is one routing decision. hit_pages is
-        # the tiered peek (hbm, host) the router counted on.
+        # the tiered peek (hbm, host, fabric_extra) the router counted
+        # on.
         idx = self.schedulers.index(sched)
-        hbm_hit, host_hit = hit_pages
-        total_hit = hbm_hit + host_hit
+        hbm_hit, host_hit, fabric_extra = hit_pages
+        # Fabric pull (README "KV fabric"): pages the pool covers
+        # beyond this replica's own warm depth land in its host tier
+        # via request_import_host BEFORE dispatch — the engine loop
+        # applies pending imports ahead of admission, so this request's
+        # prefill sees them. crc-verified by get_pages; a corrupt or
+        # evicted-since-peek entry just shortens the run.
+        fabric_pulled = 0
+        if fabric_extra > 0:
+            digests = self._digests_for(seq)[0]
+            warm = hbm_hit + host_hit
+            entries = self.fabric.get_pages(
+                digests[warm:warm + fabric_extra])
+            if entries:
+                sched.engine.request_import_host(entries)
+                sched.kick()
+                fabric_pulled = len(entries)
         seq.routed_replica = idx
-        seq.route_hit_pages = total_hit
+        seq.route_hit_pages = hbm_hit + host_hit + fabric_pulled
         seq.route_host_hit_pages = host_hit
+        seq.route_fabric_hit_pages = fabric_pulled
+        total_hit = seq.route_hit_pages
         stats = self._route_stats[idx]
         if total_hit > 0:
             self.route_prefix_hits += 1
@@ -654,6 +700,10 @@ class EngineGroup:
         else:
             self.route_cold += 1
             stats["cold"] += 1
+        if fabric_pulled > 0:
+            self.route_fabric_hits += 1
+            stats["fabric_hit_pages"] += fabric_pulled
+            self._route_fabric_hit_pages_hist.observe(fabric_pulled)
 
         def tok(s: Sequence, t: int) -> None:
             if entry.generation != gen:     # stale attempt (failed over)
@@ -669,7 +719,7 @@ class EngineGroup:
     def _retry_target(self, failed: EngineScheduler,
                       template: Optional[Sequence] = None
                       ) -> Optional[Tuple[EngineScheduler,
-                                          Tuple[int, int]]]:
+                                          Tuple[int, int, int]]]:
         """Replica for a failover resubmission (and its peeked hit
         pages): affinity composes with failover — the replay prefers a
         sibling already holding the prompt's pages, but never the
@@ -863,6 +913,9 @@ class EngineGroup:
             # Fleet-aggregated rolling SLO view (pooled exact
             # quantiles; the autoscaler's input signal).
             "slo": self._fleet_slo(),
+            # Fleet KV fabric pool occupancy + churn (README "KV
+            # fabric"); same shape under both fleet backends.
+            "fabric": self.fabric.snapshot(),
             "supervision": self.supervision_counters(),
         }
 
@@ -876,9 +929,13 @@ class EngineGroup:
                 "requests_unavailable": self.requests_unavailable,
                 "poison_requests": self.poison_requests,
                 "kv_integrity_rejections": sum(
-                    e.kv_integrity_rejections for e in self.engines),
+                    e.kv_integrity_rejections for e in self.engines)
+                + self.fabric.kv_rejections,
                 "route_prefix_hits": self.route_prefix_hits,
                 "route_cold": self.route_cold,
+                "route_fabric_hits": self.route_fabric_hits,
+                "fabric_puts": self.fabric.puts,
+                "fabric_hits": self.fabric.hits,
                 "preemptions": sum(e.preemptions_total
                                    for e in self.engines),
                 "recompute_resumes": sum(e.resumes_total

@@ -254,6 +254,15 @@ class EngineWorker:
             routing=cfg.server.routing)
         if self.role == "prefill":
             self.sched.on_prefill_handoff = self._emit_handoff
+        # Fleet KV fabric (README "KV fabric"): arm the engine's
+        # publish hook — settled prefix pages broadcast to the router's
+        # pool as fabric_put event frames, so a prefix prefilled here
+        # warms every replica. The knobs ride the config envelope.
+        if (cfg.server.fabric_cache_pages > 0
+                and self.engine.prefix_cache is not None):
+            self.engine.fabric_publish = self._publish_fabric
+            self.engine.fabric_publish_min_pages = \
+                cfg.server.fabric_publish_min_pages
         # Crash flight recorder: per-replica dir under the OPERATOR's
         # --blackbox-dir ('' = off). The dir outlives this process, so
         # the fleet monitor can harvest evidence after a kill -9.
@@ -314,6 +323,21 @@ class EngineWorker:
             conns = list(self._conns)
         for c in conns:
             c.send(obj, blob, verb)
+
+    def _publish_fabric(self, pairs) -> None:
+        """Ship settled prefix pages to the router's fabric pool
+        (engine thread, via _publish_to_fabric). Each page is
+        serialized individually — the pool stores per-page blobs so
+        entries evict independently and every get re-verifies its own
+        crc32c — and the frame carries the per-blob lengths so the
+        router slices without a deserialize on its event thread."""
+        from tpu_inference.engine import kv_cache as kvc
+        blobs = [kvc.serialize_host_pages([p]) for _, p in pairs]
+        self._broadcast({"ev": "fabric_put",
+                         "digests": [d.hex() for d, _ in pairs],
+                         "lens": [len(b) for b in blobs],
+                         "replica": self.replica},
+                        b"".join(blobs), verb="fabric_put")
 
     # --------------------------------------------------------- dispatch
 
@@ -460,6 +484,16 @@ class EngineWorker:
             trace_id=s.get("trace_id", ""),
             priority_class=s.get("class", "interactive"),
             attempt=int(s.get("attempt", 0)))
+        # Router-side routing accounting rides the payload so this
+        # worker's /debug/requests timelines show which replica served
+        # the attempt and the fabric pull that warmed the dispatch
+        # (README "KV fabric").
+        seq.routed_replica = self.replica
+        seq.route_hit_pages = int(s.get("route_hit_pages", 0))
+        seq.route_host_hit_pages = int(
+            s.get("route_host_hit_pages", 0))
+        seq.route_fabric_hit_pages = int(
+            s.get("route_fabric_hit_pages", 0))
         generated = s.get("generated") or []
         if generated:
             # Fleet-side recompute-resume (README "Process fleet"): the
@@ -632,6 +666,9 @@ class EngineWorker:
             # Byzantine transport: corrupt KV blobs this worker
             # rejected at adopt/import time (never adopted silently).
             "kv_integrity_rejections": e.kv_integrity_rejections,
+            # Fleet KV fabric: settled prefix pages this worker has
+            # published to the router's pool.
+            "fabric_published_pages": e.fabric_published_pages,
         }
         # Rolling SLO view (quantiles + breaches; windows stay in the
         # stats snapshot — healthz is the human-sized surface).
