@@ -1,0 +1,171 @@
+"""Frame-codec hot-path micro-benchmark: µs per frame over a real
+socketpair, before/after the zero-copy-PR transport fixes.
+
+Two fixes under measurement (tpu_inference/server/transport.py):
+
+- **send**: the legacy path concatenated ``header + blob`` into a fresh
+  bytes object before ``sendall`` — one full extra copy of every KV
+  payload. The current path gather-writes the two buffers with
+  ``sendmsg`` (vectored I/O), zero concatenation.
+- **recv**: the legacy ``_read_exact`` accumulated ``sock.read(n)``
+  chunks in a list and joined them — up to 2x the payload in transient
+  allocations. The current path ``readinto``-fills ONE preallocated
+  buffer.
+
+Both paths are exercised here explicitly (the legacy variants are
+reconstructed inline) so the delta stays measurable after the fix
+lands. Frames echo through a real ``socket.socketpair`` with a reader
+thread, so syscall + copy cost is what's timed, not pickling.
+
+Run:
+    python benchmarks/micro_transport.py \
+        --out benchmarks/results/micro_transport.json
+
+Committed result (this box, Linux, CPython 3.10, 200 frames/arm —
+see benchmarks/results/micro_transport.json):
+
+    arm                            µs/frame @1MiB      MB/s
+    legacy (concat + join-read)          695.6        1507.5
+    current (sendmsg + readinto)         563.4        1861.1
+
+i.e. the fixed codec moves ~1.23x the bytes per second at 1 MiB (the
+remaining wall is the two hardware crc32c passes + the kernel copy).
+At 4 KiB frames the delta shrinks to fixed overhead (~27 -> ~21 µs),
+which is why the vectored path only engages when a blob is present.
+
+NB both arms share the crc32c backend fix that landed with this PR
+(tpu_inference/integrity.py picks up the google_crc32c C extension
+when present): the pure-Python table walk paid ~300 ms per 1 MiB frame
+— 500x this entire codec — and would have drowned the copy savings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_inference.server import transport
+from tpu_inference.server.transport import (_frame_head, _HEADER, _MAGIC,
+                                            crc32c, recv_frame, send_frame)
+
+
+# ---------------------------------------------------------- legacy arms
+
+
+def _legacy_send(sock, obj, blob: bytes) -> None:
+    """Pre-PR send path: encode_frame's header+blob concatenation."""
+    sock.sendall(_frame_head(obj, blob) + blob)
+
+
+def _legacy_read_exact(rfile, n: int) -> bytes:
+    """Pre-PR read path: chunk list + join (double allocation)."""
+    chunks, got = [], 0
+    while got < n:
+        b = rfile.read(n - got)
+        if not b:
+            raise ConnectionError("eof")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def _legacy_recv(rfile):
+    head = _legacy_read_exact(rfile, _HEADER.size)
+    magic, jlen, blen, crc = _HEADER.unpack(head)
+    assert magic == _MAGIC
+    jraw = _legacy_read_exact(rfile, jlen)
+    blob = _legacy_read_exact(rfile, blen) if blen else b""
+    assert crc32c(struct.pack(">II", jlen, blen) + jraw + blob) == crc
+    return json.loads(jraw), blob
+
+
+# ------------------------------------------------------------ the bench
+
+
+def _run_arm(arm: str, blob_bytes: int, frames: int) -> dict:
+    """Echo `frames` frames through a socketpair; returns µs/frame."""
+    a, b = socket.socketpair()
+    for s in (a, b):
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 1 << 20)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+    rfile = b.makefile("rb", buffering=256 * 1024)
+    blob = os.urandom(blob_bytes)
+    obj = {"verb": "submit", "id": 7, "idem": "bench"}
+    done = threading.Event()
+    got = [0]
+
+    warm = 5
+
+    def reader() -> None:
+        recv = _legacy_recv if arm == "legacy" else recv_frame
+        try:
+            for _ in range(frames + warm):
+                _, rb = recv(rfile)
+                got[0] += len(rb)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    send = (lambda o, bl: _legacy_send(a, o, bl)) if arm == "legacy" \
+        else (lambda o, bl: send_frame(a, o, bl))
+    # Warm both arms (allocator, JSON encoder) before timing.
+    for _ in range(warm):
+        send(obj, blob)
+    t0 = time.perf_counter()
+    for _ in range(frames):
+        send(obj, blob)
+    assert done.wait(60.0), "reader never finished"
+    wall = time.perf_counter() - t0
+    t.join(timeout=5.0)
+    assert got[0] == (frames + warm) * blob_bytes
+    rfile.close()
+    a.close()
+    b.close()
+    return {"arm": arm, "blob_bytes": blob_bytes, "frames": frames,
+            "us_per_frame": round(wall / frames * 1e6, 2),
+            "mb_per_s": round(blob_bytes * frames / wall / 1e6, 1)}
+
+
+def main() -> dict:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--frames", type=int, default=200)
+    p.add_argument("--sizes", default="4096,1048576",
+                   help="comma-separated blob sizes (bytes)")
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    assert transport is not None
+    rows = []
+    for size in (int(s) for s in args.sizes.split(",") if s):
+        for arm in ("legacy", "current"):
+            r = _run_arm(arm, size, args.frames)
+            rows.append(r)
+            print(f"{arm:8s} {size:>9d}B  {r['us_per_frame']:>9.2f} "
+                  f"µs/frame  {r['mb_per_s']:>8.1f} MB/s", flush=True)
+    out = {"metric": "micro_transport", "rows": rows,
+           "python": sys.version.split()[0], "platform": sys.platform}
+    big = [r for r in rows if r["blob_bytes"] >= 1 << 20]
+    if len(big) == 2:
+        legacy, cur = big[0], big[1]
+        out["speedup_at_1mib"] = round(
+            legacy["us_per_frame"] / cur["us_per_frame"], 3)
+        print(f"speedup @1MiB: {out['speedup_at_1mib']}x")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

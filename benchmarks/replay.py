@@ -228,6 +228,12 @@ def start_server(args) -> tuple:
                 getattr(args, "fabric_warmboot_pages", 64),
             "route_fabric_hit_weight":
                 getattr(args, "route_fabric_hit_weight", 0.25),
+            # Zero-copy KV data plane (README "KV data plane"): shm
+            # arena vs through-router relay for the --compare-kv-plane
+            # arms.
+            "kv_plane": getattr(args, "kv_plane", "relay"),
+            "shm_arena_bytes": getattr(args, "shm_arena_bytes",
+                                       256 * 1024 * 1024),
             # Process fleet (README "Process fleet"): backend + worker
             # supervision knobs for the subprocess arms.
             "fleet": getattr(args, "fleet", "in-process"),
@@ -536,6 +542,40 @@ def main() -> dict:
     p.add_argument("--fabric-warmboot-pages", type=int, default=64,
                    help="compare-fabric: MRU pool pages pushed into a "
                         "newly spawned worker before it is routable")
+    p.add_argument("--compare-kv-plane", action="store_true",
+                   help="zero-copy KV data plane lane (README 'KV data "
+                        "plane'): a 1-prefill + 1-decode subprocess "
+                        "fleet serves the same handoff-heavy burst "
+                        "twice — KV blobs relayed through router "
+                        "frames vs handed worker-to-worker through "
+                        "the shared-memory page arena — grading that "
+                        "the shm arm's router relays ~0 KV payload "
+                        "bytes for handoff/fabric verbs, the "
+                        "handoff+adopt wall p95 improves >=1.5x "
+                        "(committed-artifact grade), a kill -9 "
+                        "mid-wave reclaims the dead worker's slabs "
+                        "via the region epoch bump with recompute-"
+                        "resume fallback, and greedy outputs stay "
+                        "byte-identical across both arms")
+    p.add_argument("--kvp-users", type=int, default=8,
+                   help="compare-kv-plane: concurrent requests in the "
+                        "measured handoff wave (each carries a "
+                        "distinct multi-hundred-KB KV context)")
+    p.add_argument("--kvp-prompt-pages", type=int, default=30,
+                   help="compare-kv-plane: per-request prompt length "
+                        "in full KV pages — sizes the handoff blob "
+                        "the planes move")
+    p.add_argument("--kvp-tokens", type=int, default=8,
+                   help="compare-kv-plane: greedy generation budget "
+                        "per request")
+    p.add_argument("--kvp-pool-pages", type=int, default=256,
+                   help="compare-kv-plane: router fabric pool capacity "
+                        "(fabric ON in both arms so fabric_put blob "
+                        "traffic is part of the contrast)")
+    p.add_argument("--shm-arena-bytes", type=int, default=64 * 1024 * 1024,
+                   help="compare-kv-plane: shared-memory arena size "
+                        "for the shm arm (the server flag of the same "
+                        "name)")
     p.add_argument("--route-fabric-hit-weight", type=float, default=0.25,
                    help="prefix-affinity: routing-score pages one "
                         "fabric-pool hit page is worth (fourth "
@@ -582,12 +622,14 @@ def main() -> dict:
                       args.compare_ladder, args.compare_spec,
                       args.compare_fleet, args.compare_pd,
                       args.compare_elastic, args.compare_fabric,
-                      args.compare_chaos_rpc))) > 1:
+                      args.compare_chaos_rpc,
+                      args.compare_kv_plane))) > 1:
         # Each comparison pins its own workload/sizing; combining them
         # would silently measure one lane on the other's shape.
         p.error("--compare-admission/--compare-hybrid/--compare-ladder/"
                 "--compare-spec/--compare-fleet/--compare-pd/"
-                "--compare-elastic/--compare-fabric/--compare-chaos-rpc "
+                "--compare-elastic/--compare-fabric/--compare-chaos-rpc/"
+                "--compare-kv-plane "
                 "are mutually exclusive; run them as separate "
                 "invocations")
 
@@ -719,6 +761,55 @@ def main() -> dict:
             args.fabric_wave2_users = 6
             args.prefill_buckets = (16, 64, 320)
             args.preempt_watermark_pages = 128
+        if args.compare_kv_plane:
+            # 1 prefill + 1 decode worker; EVERY request hands its KV
+            # off between them, so the wave is pure data-plane
+            # traffic. BIG payloads without long-context compute: the
+            # fatkv model carries 16 KiB of KV per token (the
+            # production KV:compute ratio the stock tiny models are
+            # two orders of magnitude under), so a 448-token prompt —
+            # 7 full 64-token pages, distinct per user so nothing
+            # prefix-caches away, one prefill bucket fitting it whole
+            # — hands off ~7.3 MiB of serialized KV after a sub-second
+            # CPU prefill. The fixed costs of a handoff (dispatch RPC,
+            # admission, device restore, first decode step) are
+            # identical in both arms; MiB-scale blobs are what make
+            # the per-byte contrast visible over that floor. The relay
+            # arm moves every payload twice through router sockets
+            # (plus a router-side digest pass); the shm arm's
+            # descriptors carry bytes that never left the arena.
+            # Fabric stays ON so fabric_put publishes are part of the
+            # relay-vs-shm blob contrast. No warmup (4 worker boots
+            # across the arms); each arm runs an unmeasured compile-
+            # warm wave first.
+            args.dp = 2
+            args.model = "tiny-llama-fatkv"
+            args.page_size, args.max_pages_per_seq = 64, 8
+            # Pool headroom and NO host tier: a reclaim during the
+            # measured series must be a free-list pop, not an eviction
+            # batch demoting victims through a device_get — that demote
+            # lands as a ~50 ms outlier inside whichever adopt it
+            # interrupts (both arms equally) and owns the p95.
+            args.num_pages = 144
+            args.host_cache_pages = 0
+            # One decode dispatch in flight at a time: the export's
+            # device_get orders after in-flight dispatch, so a deeper
+            # dispatch-ahead window pads BOTH arms' export wall with
+            # identical decode work and dilutes the transit contrast.
+            args.decode_steps_per_call = 1
+            args.no_warmup = True
+            args.prefill_buckets = (16, 512)
+            args.kvp_users = 12
+            args.kvp_prompt_pages = 7
+            args.kvp_pool_pages = 64
+            # Sized so the WHOLE run's slabs fit a region without one
+            # free ever landing: frees ride the periodic stats tick, so
+            # during back-to-back waves the prefill region must hold
+            # warm+measured+kill publishes at once (36 x ~7.45 MiB
+            # extents ~= 268 MiB < 384 MiB/region at dp=2). An
+            # undersized arena degrades gracefully (ArenaFull -> relay
+            # fallback) but that contaminates the shm arm's walls.
+            args.shm_arena_bytes = 768 * 1024 * 1024
         if args.compare_pd:
             # dp=2 subprocess topologies, room for the 448-token long
             # prompts (ctx 640 at page_size 16), host tier on. K=2
@@ -763,6 +854,8 @@ def main() -> dict:
                         if args.compare_fabric
                         else "benchmarks/results/replay_chaos_rpc.json"
                         if args.compare_chaos_rpc
+                        else "benchmarks/results/replay_kv_plane.json"
+                        if args.compare_kv_plane
                         else "benchmarks/results/replay_smoke.json")
         if args.compare_pd and args.trace_artifact is None:
             args.trace_artifact = os.path.join(
@@ -816,6 +909,8 @@ def main() -> dict:
         return _compare_fabric(args)
     if args.compare_chaos_rpc:
         return _compare_chaos_rpc(args)
+    if args.compare_kv_plane:
+        return _compare_kv_plane(args)
 
     summary = run_replay(args)
     out = {"config": vars(args), "summary": summary}
@@ -2121,6 +2216,279 @@ def _compare_fabric(args) -> dict:
             and on["cross_replica_turns"] >= 1
             and on["prefix_recomputed_tokens"] == 0
             and on["fabric_hits"] > 0 and on["fabric_puts"] > 0),
+    }
+    out = {"config": cfg_snapshot, **arms, "comparison": comparison}
+    print(json.dumps(comparison, indent=1))
+    _write_out(args.out, out)
+    result = dict(comparison)
+    result.update(arms)
+    return result
+
+
+def _kv_plane_arm(args, label: str, plane: str) -> dict:
+    """Boot a 1-prefill + 1-decode subprocess fleet on one KV data
+    plane, run the pinned handoff-heavy burst — an unmeasured compile
+    warm wave, the measured wave, then a kill -9 wave — and summarize
+    the per-request handoff walls and the router's relayed-blob books."""
+    import hashlib
+    import threading
+
+    print(f"[replay] kv-plane arm: {label}", file=sys.stderr)
+    args.fleet = "subprocess"
+    args.worker_roles = ("prefill", "decode")
+    args.kv_plane = plane
+    args.fabric_cache_pages = args.kvp_pool_pages
+    args.worker_restart_backoff_s = 0.1
+    args.worker_restart_max = 10
+    page = args.page_size
+    prompt_tokens = args.kvp_prompt_pages * page
+    srv, port, stop = start_server(args)
+    group = srv.group
+    records = []
+
+    def _wave(tag: str, n: int, start: int = 0) -> list:
+        # Distinct per-user bodies (the tag+index is IN the page-0
+        # content) so nothing prefix-caches away: every request
+        # prefills its own ~kvp-prompt-pages pages and hands the whole
+        # context off to the decode worker.
+        reqs = [(f"{tag}{i:02d}",
+                 (f"[{tag}{i:02d}] " + "kv plane payload " * 512)
+                 [:prompt_tokens])
+                for i in range(start, start + n)]
+        return asyncio.run(_fabric_burst(port, args.model, reqs,
+                                         args.kvp_tokens))
+
+    try:
+        # Compile warmth (the arms boot without warmup): the same wave
+        # shape as the measured one, so the big prefill bucket, the
+        # decode rungs at full width, and the handoff export/adopt
+        # graphs all compile HERE — the measured wave times the data
+        # plane, not XLA.
+        records += _wave("wm", args.kvp_users)
+        # Sequential warm singles: the concurrent wave above compiles
+        # the full-width decode rungs, but a lone request rides the
+        # batch-1 rung — its first trip through prefill+handoff+decode
+        # still pays one-time setup (rung compile, allocator paths)
+        # that would otherwise land as a ~40 ms outlier inside the
+        # measured series and own its p95.
+        for i in range(3):
+            records += _wave("ws", 1, start=i)
+        # Measured handoffs, SEQUENTIAL: one request in flight at a
+        # time, so each wall prices exactly one trip through the data
+        # plane with no cross-request compute queueing contaminating
+        # the spans (the concurrent regime's walls measure the router
+        # backlog and the decode worker's step queue, identically in
+        # both arms — not the plane).
+        t0 = time.perf_counter()
+        for i in range(args.kvp_users):
+            records += _wave("kw", 1, start=i)
+        wave_wall = time.perf_counter() - t0
+        # Per-request handoff+adopt wall, measured across processes on
+        # the assembled trace timeline (the /debug/trace stance: every
+        # span carries its emitter's unix-anchored timestamps): from
+        # the prefill worker's "handoff_export" span END — the moment
+        # the serialized payload exists and the data plane takes over —
+        # to the decode worker's "handoff_adopt" span END. The window
+        # covers everything the PLANES differ on: arena publish vs
+        # frame send, the router's event-socket ingest and dispatch
+        # (where the relay arm carries megabytes in and out), and the
+        # adoption read+restore. The export span itself (device KV
+        # gather + serialize) is identical prefill-side compute on
+        # either plane and is reported separately below.
+        walls, exports, adopts, legs = [], [], [], []
+        for i in range(args.kvp_users):
+            sp = {}
+            for s in group._recorder.get_trace(f"kw{i:02d}") or ():
+                if s.get("name") in ("handoff_export", "handoff",
+                                     "handoff_adopt"):
+                    sp[s["name"]] = (float(s.get("ts", 0.0)),
+                                     float(s.get("dur", 0.0)))
+            if "handoff_export" in sp:
+                exports.append(sp["handoff_export"][1])
+            if "handoff_adopt" in sp:
+                adopts.append(sp["handoff_adopt"][1])
+            if "handoff_export" in sp and "handoff_adopt" in sp:
+                t_exp = sum(sp["handoff_export"])
+                t_done = sum(sp["handoff_adopt"])
+                walls.append(max(0.0, t_done - t_exp))
+                if "handoff" in sp:
+                    # The wall's legs on the assembled timeline: the
+                    # export, the event-frame transit into the router
+                    # (where the relay arm carries the payload), the
+                    # router's routing+dispatch span (where it carries
+                    # it out again), and the decode worker's admission
+                    # wait + adoption.
+                    legs.append({
+                        "export_s": round(sp["handoff_export"][1], 6),
+                        "transit_in_s": round(
+                            sp["handoff"][0]
+                            - sum(sp["handoff_export"]), 6),
+                        "route_dispatch_s": round(sp["handoff"][1], 6),
+                        "sched_wait_s": round(
+                            sp["handoff_adopt"][0]
+                            - sum(sp["handoff"]), 6),
+                        "adopt_s": round(sp["handoff_adopt"][1], 6),
+                    })
+        blob_bytes_measured = dict(group.rpc_blob_bytes)
+        sup_measured = group.supervision_counters()
+        # Kill -9 mid-wave: fire the wave, then SIGKILL the prefill
+        # worker while its handoffs are in flight. The shm arm's
+        # supervisor must reclaim the dead incarnation's slabs via the
+        # region epoch bump; the caught-out requests recompute-resume
+        # (byte-identical under greedy) — the relay fallback books
+        # below record whatever blob traffic the salvage paths moved.
+        prefill_replica = next(
+            h.replica for h in group.workers
+            if group.roles[h.replica] == "prefill")
+        kill_records: list = []
+        kill_err: list = []
+
+        def _kill_wave() -> None:
+            try:
+                kill_records.extend(_wave("kk", args.kvp_users))
+            except Exception as e:          # surfaced after join
+                kill_err.append(e)
+
+        t = threading.Thread(target=_kill_wave)
+        t.start()
+        time.sleep(0.25)
+        group.apply_chaos({"replica": prefill_replica, "kill": "kill9"})
+        t.join(timeout=600)
+        assert not t.is_alive(), "kill wave never finished"
+        if kill_err:
+            raise kill_err[0]
+        records += kill_records
+        deadline = time.perf_counter() + 90
+        while (time.perf_counter() < deadline
+               and not all(h.state == "up" for h in group.workers)):
+            time.sleep(0.1)
+        sup = group.supervision_counters()
+        blob_bytes_final = dict(group.rpc_blob_bytes)
+        shm_reclaims = group.shm_reclaims
+        fabric_snap = group.fabric.snapshot()
+    finally:
+        group.stop(drain=False)
+        stop()
+
+    h = hashlib.sha256()
+    for r in sorted(records, key=lambda r: r["trace_id"]):
+        h.update(f"{r['trace_id']}:".encode())
+        h.update(r["reply"].encode())
+        h.update(b"\x00")
+    return {
+        "label": label, "kv_plane": plane,
+        "requests": len(records),
+        "outputs_sha256": h.hexdigest(),
+        "prompt_tokens": prompt_tokens,
+        "wave_wall_s": round(wave_wall, 3),
+        # Handoff+adopt wall of the measured wave (export settled ->
+        # adoption complete: transit + route + dispatch + adopt), per
+        # request.
+        "handoff_wall_s": _percentiles(walls, ps=(50, 95)),
+        # The wall's worker-side legs (identical work in both arms:
+        # KV gather+serialize on the prefill side, restore on the
+        # decode side) — everything between them is the data plane.
+        "handoff_export_s": _percentiles(exports, ps=(50, 95)),
+        "handoff_adopt_s": _percentiles(adopts, ps=(50, 95)),
+        "handoff_legs_p50_s": {
+            k: round(float(np.median([leg[k] for leg in legs])), 6)
+            for k in (legs[0] if legs else ())},
+        "handoff_walls_observed": len(walls),
+        # Router-relayed KV payload bytes by verb, before and after
+        # the kill wave: the measured-phase books grade the zero-copy
+        # claim; the final books show what the post-kill salvage /
+        # fallback paths moved (the relay fallback is a feature).
+        "rpc_blob_bytes_measured": blob_bytes_measured,
+        "rpc_blob_bytes": blob_bytes_final,
+        "pd_handoffs_measured": sup_measured.get("pd_handoffs", 0),
+        "pd_handoffs": sup.get("pd_handoffs", 0),
+        "pd_adoptions": sup.get("pd_adoptions", 0),
+        "pd_handoff_recomputes": sup.get("pd_handoff_recomputes", 0),
+        "recompute_resumes": sup.get("recompute_resumes", 0),
+        "resume_recomputed_tokens": sup.get(
+            "resume_recomputed_tokens", 0),
+        "worker_restarts": sup.get("worker_restarts", 0),
+        "kv_integrity_rejections": sup.get(
+            "kv_integrity_rejections", 0),
+        "shm_reclaims": shm_reclaims,
+        "fabric_puts": sup.get("fabric_puts", 0),
+        "fabric": fabric_snap,
+        "kill_wave_requests": len(kill_records),
+    }
+
+
+def _compare_kv_plane(args) -> dict:
+    """The zero-copy KV data plane artifact (README "KV data plane"):
+    the same handoff-heavy burst through a 1-prefill + 1-decode
+    subprocess fleet on both planes — KV blobs relayed through router
+    frames vs handed worker-to-worker through the shared-memory page
+    arena. The planes move the same bytes, so outputs must stay
+    byte-identical; the shm arm's router must relay ~0 KV payload
+    bytes on the handoff/fabric verbs; and a kill -9 mid-wave must
+    reclaim the dead worker's slabs and recompute-resume cleanly."""
+    cfg_snapshot = {k: v for k, v in vars(args).items()
+                    if not k.startswith("_")}
+    arms = {}
+    arms["relay"] = _kv_plane_arm(args, "relay", "relay")
+    arms["shm"] = _kv_plane_arm(args, "shm", "shm")
+    args.worker_roles, args.fleet, args.kv_plane = (), "in-process", \
+        "relay"
+
+    relay, shm = arms["relay"], arms["shm"]
+    shas = {a["outputs_sha256"] for a in arms.values()}
+    ratio = (relay["handoff_wall_s"]["p95"]
+             / max(shm["handoff_wall_s"]["p95"], 1e-9))
+    shm_m, relay_m = (shm["rpc_blob_bytes_measured"],
+                      relay["rpc_blob_bytes_measured"])
+    comparison = {
+        "users": args.kvp_users,
+        "prompt_tokens": relay["prompt_tokens"],
+        # Byte-identity: a descriptor adoption reads the same bit-exact
+        # serialized KV the relay frames carry (incl. through the kill
+        # wave's recompute-resumes).
+        "outputs_identical": len(shas) == 1,
+        # The zero-copy claim, graded on the measured phase (before
+        # the kill wave's INTENTIONAL relay fallbacks): with the shm
+        # plane on, no KV payload byte traversed a router frame on any
+        # verb, while the relay arm moved every handoff through the
+        # router twice (handoff event in, dispatch out) plus every
+        # fabric publish.
+        "rpc_blob_bytes_measured_relay": relay_m,
+        "rpc_blob_bytes_measured_shm": shm_m,
+        "shm_zero_copy": bool(
+            sum(shm_m.values()) == 0
+            and relay_m.get("handoff", 0) > 0
+            and relay_m.get("submit", 0) > 0
+            and relay_m.get("fabric_put", 0) > 0),
+        # Handoff+adopt wall p95, relay vs shm (>= 1.5x is the
+        # artifact's acceptance claim; CPU-noise makes it a committed-
+        # artifact grade, not a live tier-1 assert).
+        "handoff_p95_relay_s": relay["handoff_wall_s"]["p95"],
+        "handoff_p95_shm_s": shm["handoff_wall_s"]["p95"],
+        "handoff_p95_ratio": round(ratio, 4),
+        "shm_handoff_wins": bool(ratio >= 1.5),
+        # Kill -9 mid-wave: the dead prefill incarnation's slabs were
+        # reclaimed via the epoch bump (shm arm), the worker restarted,
+        # and every request in both arms' kill waves still finished
+        # byte-identically (recompute-resume fallback).
+        "shm_reclaims": shm["shm_reclaims"],
+        "worker_restarts": {k: a["worker_restarts"]
+                            for k, a in arms.items()},
+        "kill_recovered": bool(
+            shm["shm_reclaims"] >= 1
+            and all(a["worker_restarts"] >= 1 for a in arms.values())
+            and all(a["kill_wave_requests"] == args.kvp_users
+                    for a in arms.values())),
+        "kv_integrity_rejections": {
+            k: a["kv_integrity_rejections"] for k, a in arms.items()},
+        "kv_plane_wins": bool(
+            len(shas) == 1
+            and sum(shm_m.values()) == 0
+            and relay_m.get("handoff", 0) > 0
+            and shm["shm_reclaims"] >= 1
+            and shm["pd_handoffs_measured"] > 0
+            and all(a["kv_integrity_rejections"] == 0
+                    for a in arms.values())),
     }
     out = {"config": cfg_snapshot, **arms, "comparison": comparison}
     print(json.dumps(comparison, indent=1))

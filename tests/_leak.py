@@ -71,8 +71,9 @@ def assert_fabric_clean(pool) -> None:
     assert pool.bytes_used == sum(e.nbytes for e in entries.values()), \
         "fabric byte accounting drifted"
     assert pool.bytes_used == sum(
-        len(e.blob) for e in entries.values()), \
-        "fabric entry nbytes disagrees with its blob"
+        len(e.blob) if e.blob is not None else int(e.desc["len"])
+        for e in entries.values()), \
+        "fabric entry nbytes disagrees with its blob/descriptor"
     assert 0 <= pool.used <= max(pool.capacity, 0), (
         f"fabric pool over capacity: {pool.used}/{pool.capacity}")
     snap = pool.snapshot()
@@ -81,3 +82,27 @@ def assert_fabric_clean(pool) -> None:
     pool.clear()
     assert pool.used == 0, "fabric pages leaked after clear"
     assert pool.bytes_used == 0, "fabric bytes leaked after clear"
+
+
+def assert_arena_clean(group) -> None:
+    """Shared-memory arena invariant (server/shm_arena, zero-copy KV
+    plane): after the fabric pool and every in-flight handoff released
+    their slabs, the router's SlabDirectory must hold nothing live — a
+    tracked slab with no consumer is arena memory that ratchets until
+    the region is full and every publish relays. No-op on the relay
+    plane (no arena). Call AFTER assert_fabric_clean/clear: pool
+    entries legitimately hold live slabs."""
+    arena = getattr(group, "arena", None)
+    adir = getattr(group, "_arena_dir", None)
+    if arena is None or adir is None:
+        return
+    live = adir.slabs_live
+    assert live == 0, (
+        f"arena slab leak: {live} slabs still registered with no "
+        "releasing consumer")
+    # Pending frees are fine (they drain on the next stats tick) but
+    # the books must balance: released + reclaimed covers everything
+    # ever registered minus the live set (== 0 here).
+    assert adir.slabs_tracked >= 0
+    for rg in range(arena.regions):
+        assert arena.epoch(rg) >= 1, f"region {rg} epoch word clobbered"

@@ -35,6 +35,7 @@ import time
 import numpy as np
 import pytest
 
+from tests._leak import assert_arena_clean
 from tpu_inference.config import (EngineConfig, FrameworkConfig,
                                   ParallelConfig, ServerConfig,
                                   framework_config_from_dict,
@@ -618,6 +619,9 @@ def test_pd_fleet_handoff_byte_identity_and_surfaces(pd_fleet, oracle):
     assert 'tpu_inf_worker_role_info{replica="1",role="decode"}' in pt
     assert "tpu_inf_pd_handoffs_total" in pt
     assert "tpu_inf_pd_handoff_seconds_bucket" in pt
+    # Relay plane (no --kv-plane shm): the arena invariant checker is
+    # a documented no-op, and no handoff blob leaked a tracked slab.
+    assert_arena_clean(pd_fleet)
 
 
 @pytest.mark.slow   # ~77s of restart-backoff waits; the handoff fallback

@@ -29,7 +29,7 @@ import time
 import numpy as np
 import pytest
 
-from tests._leak import assert_fabric_clean
+from tests._leak import assert_arena_clean, assert_fabric_clean
 from tpu_inference.config import (EngineConfig, FrameworkConfig,
                                   ParallelConfig, ServerConfig, tiny_llama)
 from tpu_inference.engine import kv_cache as kvc
@@ -369,6 +369,8 @@ def test_fabric_warm_once_subprocess(fabric_fleet):
                  "tpu_inf_fabric_evictions_total",
                  "tpu_inf_route_fabric_hits_total"):
         assert any(k[0] == name for k in seen), f"missing {name}"
+    # Relay plane: no arena exists, and the invariant checker says so.
+    assert_arena_clean(group)
 
 
 def test_fabric_warm_once_in_process():

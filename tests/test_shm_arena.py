@@ -1,0 +1,404 @@
+"""Zero-copy KV data plane (README "KV data plane"): the shared-memory
+page arena and the descriptor frames that replace through-router blob
+relays.
+
+Covers the subsystem at three levels:
+
+- pure arena units: slab alloc/free/coalesce with refcount-style
+  directory accounting, ArenaFull relay fallback, free-then-read
+  failing closed, crc rejection typed apart from staleness, and the
+  dead-incarnation reclaim (epoch bump) invalidating every outstanding
+  descriptor without the owner's cooperation — the kill -9 story.
+- serialized-page round-trips: one descriptor per kv_quant host-page
+  layout (none/int8/int4) travels segment -> descriptor -> read ->
+  deserialize bit-exactly, from the writer, the router, and a second
+  attached reader.
+- the real fleet: a 1-prefill+1-decode subprocess fleet on
+  ``--kv-plane shm`` serves byte-identical outputs with ZERO handoff
+  bytes over the RPC sockets, and keeps serving byte-identically after
+  a supervisor region reclaim staled every pooled descriptor (the
+  relay/recompute fallback equivalence).
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests._leak import assert_arena_clean, assert_fabric_clean
+from tpu_inference.config import (EngineConfig, FrameworkConfig,
+                                  ParallelConfig, ServerConfig, tiny_llama)
+from tpu_inference.engine import kv_cache as kvc
+from tpu_inference.server import shm_arena
+from tpu_inference.server.shm_arena import (ArenaCorrupt, ArenaFull,
+                                            ArenaSegment, ArenaStale,
+                                            SlabDirectory, WorkerArena,
+                                            effective_kv_plane)
+
+pytestmark = pytest.mark.skipif(
+    not sys.platform.startswith("linux"),
+    reason="shm arena needs POSIX shared memory (Linux)")
+
+
+@pytest.fixture()
+def seg():
+    s = ArenaSegment(64 * 1024, regions=4)
+    yield s
+    s.close()
+
+
+def _worker(seg_, rg=0) -> WorkerArena:
+    return WorkerArena(seg_.region_spec(rg))
+
+
+# ------------------------------------------------------------ resolution
+
+
+def test_effective_kv_plane_decision_table():
+    """The knob is a request, not a promise: shm resolves only for the
+    subprocess fleet on Linux; every other combination rides relay."""
+    mk = lambda **kw: ServerConfig(model_name="t", tokenizer="byte", **kw)
+    assert effective_kv_plane(mk()) == "relay"
+    assert effective_kv_plane(mk(kv_plane="shm")) == "relay"
+    assert effective_kv_plane(
+        mk(kv_plane="shm", fleet="subprocess")) == "shm"
+    assert effective_kv_plane(
+        mk(kv_plane="relay", fleet="subprocess")) == "relay"
+
+
+# ----------------------------------------------------------- slab units
+
+
+def test_slab_alloc_read_free_roundtrip(seg):
+    w = _worker(seg)
+    payloads = [bytes([i]) * (17 + 13 * i) for i in range(5)]
+    descs = [w.publish(p) for p in payloads]
+    assert w.writer.slabs_used == 5
+    for d, p in zip(descs, payloads):
+        assert d["len"] == len(p) and d["gen"] > 0 and d["ep"] == 1
+        assert w.read(d) == p          # owner read
+        assert seg.read(d) == p        # router read
+    assert w.puts == 5 and w.gets == 5
+    assert w.put_bytes == sum(len(p) for p in payloads)
+    # Free everything; the free list coalesces back to one extent.
+    for d in descs:
+        assert w.free(d["off"]) is True
+        assert w.free(d["off"]) is False      # idempotent
+    assert w.writer.slabs_used == 0 and w.writer.bytes_used == 0
+    assert len(w.writer._free) == 1
+    w.close()
+
+
+def test_free_slab_read_fails_closed(seg):
+    """A freed slab's gen word is zeroed — a stale descriptor can
+    never return recycled bytes, even before reuse."""
+    w = _worker(seg)
+    d = w.publish(b"x" * 100)
+    w.free(d["off"])
+    with pytest.raises(ArenaStale):
+        seg.read(d)
+    # Reuse of the extent mints a NEW generation: the old descriptor
+    # still fails closed while the new one reads clean.
+    d2 = w.publish(b"y" * 100)
+    assert d2["off"] == d["off"] and d2["gen"] != d["gen"]
+    with pytest.raises(ArenaStale):
+        seg.read(d)
+    assert seg.read(d2) == b"y" * 100
+    w.close()
+
+
+def test_arena_full_signals_relay_fallback(seg):
+    w = _worker(seg)
+    big = b"z" * (seg.region_bytes // 2)
+    w.publish(big)
+    with pytest.raises(ArenaFull):
+        w.publish(big)                 # header overhead makes it not fit
+    assert w.writer.alloc_failures == 1
+    # Single-writer discipline: region 1's writer is unaffected.
+    w1 = _worker(seg, rg=1)
+    assert w1.publish(big)["rg"] == 1
+    w.close()
+    w1.close()
+
+
+def test_crc_rejection_typed_apart_from_stale(seg):
+    """Corruption (payload bytes, length word) is ArenaCorrupt —
+    counted like any corrupt KV blob; staleness (epoch, gen) is
+    ArenaStale — a fallback, not an integrity event."""
+    w = _worker(seg)
+    d = w.publish(b"payload" * 40)
+    # Flip one payload byte in shared memory behind the descriptor.
+    seg.shm.buf[d["off"] + 3] ^= 0xFF
+    with pytest.raises(ArenaCorrupt) as ei:
+        seg.read(d)
+    assert ei.value.reason == "crc"
+    seg.shm.buf[d["off"] + 3] ^= 0xFF
+    assert seg.read(d) == b"payload" * 40
+    # Length mismatch between descriptor and slab header: corrupt.
+    bad = dict(d, len=d["len"] - 1, crc=0)
+    with pytest.raises(ArenaCorrupt):
+        seg.read(bad)
+    # Out-of-region geometry: corrupt (bounds), never an OOB read.
+    with pytest.raises(ArenaCorrupt):
+        seg.read(dict(d, off=seg.region_bytes * seg.regions + 64))
+    # Wrong-epoch descriptor: stale.
+    with pytest.raises(ArenaStale):
+        seg.read(dict(d, ep=d["ep"] + 1))
+    w.close()
+
+
+def test_generation_reclaim_after_owner_death(seg):
+    """The kill -9 story: the owner dies holding live slabs; the
+    supervisor reclaims the region (ledger count + epoch bump) and
+    every outstanding descriptor fails closed, while the respawned
+    incarnation's fresh spec mints readable slabs again."""
+    w = _worker(seg)
+    adir = SlabDirectory()
+    descs = [w.publish(bytes([i]) * 64) for i in range(3)]
+    for d in descs:
+        adir.register(d)
+    adir.release(descs[2])             # one already pending-free
+    assert adir.slabs_live == 2 and adir.slabs_tracked == 3
+    w.close()                          # owner gone, frees never applied
+
+    assert adir.reclaim(0) == 3        # live + pending, all settled
+    assert adir.reclaims == 3 and adir.slabs_tracked == 0
+    new_ep = seg.bump_epoch(0)
+    assert new_ep == 2
+    for d in descs:
+        with pytest.raises(ArenaStale):
+            seg.read(d)
+    adir.release(descs[0])             # release-after-reclaim: no-op
+    assert adir.slabs_tracked == 0
+
+    w2 = WorkerArena(seg.region_spec(0))    # respawned incarnation
+    d2 = w2.publish(b"fresh" * 10)
+    assert d2["ep"] == new_ep and seg.read(d2) == b"fresh" * 10
+    w2.close()
+
+
+def test_slab_directory_free_batching(seg):
+    """Release -> drain -> stats-RPC -> owner free, with the requeue
+    path for a failed RPC: no free is ever lost or double-applied."""
+    w = _worker(seg)
+    adir = SlabDirectory()
+    d = w.publish(b"a" * 32)
+    adir.register(d)
+    adir.release(d)
+    offs = adir.drain_free(0)
+    assert offs == [d["off"]] and adir.drain_free(0) == []
+    adir.requeue_free(0, offs)         # the RPC failed; retry next tick
+    offs = adir.drain_free(0)
+    assert offs == [d["off"]]
+    assert [w.free(o) for o in offs] == [True]
+    assert w.writer.slabs_used == 0
+    w.close()
+
+
+def test_concurrent_reader_never_adopts_recycled_bytes(seg):
+    """Torn-read guard under a real race: readers hammer a descriptor
+    while the owner frees and recycles the extent with different
+    bytes. Every read either returns the original payload or raises —
+    recycled bytes must never surface under the old descriptor."""
+    w = _worker(seg)
+    payload = b"\xAA" * 4096
+    d = w.publish(payload)
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                got = seg.read(d)
+            except (ArenaStale, ArenaCorrupt):
+                continue
+            if got != payload:
+                bad.append(got[:8])
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.02)
+    w.free(d["off"])
+    for i in range(50):
+        dn = w.publish(bytes([i % 251]) * 4096)
+        w.free(dn["off"])
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    assert not bad, f"reader adopted recycled bytes: {bad[0]!r}"
+    w.close()
+
+
+# ------------------------------------------- serialized-page round-trip
+
+
+def _page(quant: str, tag: int) -> kvc.HostKVPage:
+    rng = np.random.default_rng(100 + tag)
+    if quant == "none":
+        mk = lambda: rng.standard_normal((2, 8, 2, 16)).astype(np.float32)
+        return kvc.HostKVPage(mk(), mk())
+    code_dt = np.uint8 if quant == "int4" else np.int8
+    d = 8 if quant == "int4" else 16
+    mk = lambda: rng.integers(0, 255, (2, 8, 2, d)).astype(code_dt)
+    sc = lambda: rng.standard_normal((2, 8, 2)).astype(np.float32)
+    return kvc.HostKVPage(mk(), mk(), sc(), sc())
+
+
+def _pages_equal(a: kvc.HostKVPage, b: kvc.HostKVPage) -> bool:
+    for f in ("k", "v", "k_scale", "v_scale"):
+        x, y = getattr(a, f, None), getattr(b, f, None)
+        if (x is None) != (y is None):
+            return False
+        if x is not None and not np.array_equal(x, y):
+            return False
+    return True
+
+
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_descriptor_roundtrip_per_kv_quant(quant, seg):
+    """serialize -> publish -> (descriptor crosses the wire) -> read ->
+    deserialize is bit-exact for every host-page layout, from the
+    owning worker, the router segment, and a second attached worker —
+    the three consumers the data plane actually has."""
+    src = _worker(seg, rg=0)
+    dst = _worker(seg, rg=1)
+    pages = [_page(quant, i) for i in range(3)]
+    blob = kvc.serialize_host_pages(pages)
+    desc = src.publish(blob)
+    for reader in (lambda: src.read(desc), lambda: seg.read(desc),
+                   lambda: dst.read(desc)):
+        got = kvc.deserialize_host_pages(reader())
+        assert len(got) == len(pages)
+        assert all(_pages_equal(g, p) for g, p in zip(got, pages))
+    assert dst.gets == 1 and dst.get_bytes == len(blob)
+    src.close()
+    dst.close()
+
+
+# ------------------------------------------------------- fleet end-to-end
+
+ENGINE_KW = dict(page_size=8, num_pages=64, max_pages_per_seq=8,
+                 max_batch_size=2, prefill_buckets=(16,),
+                 host_cache_pages=32)
+
+
+def _cfg(**server_kw) -> FrameworkConfig:
+    server_kw.setdefault("fleet", "subprocess")
+    server_kw.setdefault("worker_restart_max", 10)
+    server_kw.setdefault("worker_restart_backoff_s", 0.1)
+    return FrameworkConfig(
+        model=tiny_llama(vocab_size=512),
+        engine=EngineConfig(**ENGINE_KW),
+        parallel=ParallelConfig(dp=2),
+        server=ServerConfig(model_name="t", tokenizer="byte",
+                            warmup=False, **server_kw))
+
+
+def _submit(group, rid, prompt, max_new):
+    from tpu_inference.engine.engine import Sequence
+    toks, done, box = [], threading.Event(), {}
+    seq = Sequence(request_id=rid, prompt_tokens=list(prompt),
+                   max_new_tokens=max_new)
+    group.submit(seq, lambda s, t: toks.append(t),
+                 lambda s: (box.update(seq=s), done.set()))
+    return toks, done, box
+
+
+def _finish(done, box, timeout=180.0):
+    assert done.wait(timeout), "request did not finish"
+    return box["seq"]
+
+
+@pytest.fixture(scope="module")
+def shm_pd_fleet():
+    """1 prefill + 1 decode worker on the shm plane with the fabric
+    pool armed — every data-plane path (handoff, fabric publish) has a
+    descriptor variant to exercise."""
+    from tpu_inference.server.fleet import ProcessEngineGroup
+
+    group = ProcessEngineGroup(_cfg(
+        worker_roles=("prefill", "decode"), kv_plane="shm",
+        shm_arena_bytes=8 * 1024 * 1024, fabric_cache_pages=64,
+        fabric_publish_min_pages=1))
+    group.start()
+    yield group
+    group.stop(drain=False)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    from tpu_inference.engine.engine import InferenceEngine
+    return InferenceEngine(tiny_llama(vocab_size=512),
+                           EngineConfig(**ENGINE_KW), seed=0)
+
+
+def test_shm_plane_handoff_zero_blob_bytes(shm_pd_fleet, oracle):
+    """Tentpole proof: on the shm plane the P/D handoff and the fabric
+    publishes move ONLY descriptors over the RPC sockets — the per-verb
+    relayed-blob-byte counters stay at zero — while outputs remain
+    byte-identical to a single mixed engine."""
+    group = shm_pd_fleet
+    deadline = time.monotonic() + 60
+    while not all(h.state == "up" for h in group.workers):
+        assert time.monotonic() < deadline, "fleet never came up"
+        time.sleep(0.05)
+    assert group.arena is not None, "shm plane must be active on Linux"
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [4, 4, 4, 4]]
+    pend = [_submit(group, 8100 + i, p, 16)
+            for i, p in enumerate(prompts)]
+    for (toks, done, box), p in zip(pend, prompts):
+        fin = _finish(done, box)
+        assert fin.finish_reason == "length"
+        assert toks == oracle.generate([p], max_new_tokens=16)[0]
+    assert group.pd_handoffs >= len(prompts)
+    assert group.rpc_blob_bytes["handoff"] == 0, \
+        "handoff payloads must not traverse the router socket"
+    assert group.rpc_blob_bytes["fabric_put"] == 0, \
+        "fabric publishes must not traverse the router socket"
+    # The adopting side pulled real bytes out of shared memory.
+    hs = group.health_snapshot()
+    assert hs["replicas"][1]["pd_adoptions"] >= len(prompts)
+    pt = group.prometheus_text()
+    assert 'tpu_inf_rpc_blob_bytes_total{verb="handoff"} 0' in pt
+    assert "tpu_inf_shm_slabs_total" in pt
+    assert "tpu_inf_kv_plane_shm_puts_total" in pt
+
+
+def test_shm_reclaim_staleness_falls_back_byte_identical(
+        shm_pd_fleet, oracle):
+    """Relay-fallback equivalence: reclaim the prefill worker's region
+    (exactly what the supervisor does after a kill -9) so every pooled
+    descriptor it minted is stale, then serve the same prompt again —
+    stale reads fail closed, the recompute/relay machinery takes over,
+    and the output stays byte-identical."""
+    group = shm_pd_fleet
+    prompt = [7, 7, 1, 2]
+    toks1, done, box = _submit(group, 8200, prompt, 12)
+    _finish(done, box)
+    assert toks1 == oracle.generate([prompt], max_new_tokens=12)[0]
+
+    reclaims0 = group.shm_reclaims
+    group._reclaim_region(0)           # stale everything region 0 minted
+    assert group.shm_reclaims >= reclaims0
+
+    toks2, done, box = _submit(group, 8201, prompt, 12)
+    fin = _finish(done, box)
+    assert fin.finish_reason == "length"
+    assert toks2 == toks1, "post-reclaim serve must stay byte-identical"
+
+
+def test_shm_fleet_leak_invariants(shm_pd_fleet):
+    """After the request mixes above settled, the arena books balance:
+    the fabric pool's descriptors are the only live slabs, and clearing
+    the pool releases every one (assert_arena_clean contract)."""
+    group = shm_pd_fleet
+    deadline = time.monotonic() + 30
+    while group._tracked and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not group._tracked
+    assert_fabric_clean(group.fabric)
+    assert_arena_clean(group)

@@ -211,6 +211,23 @@ def tiny_llama(vocab_size: int = 512) -> ModelConfig:
     )
 
 
+def tiny_llama_fatkv(vocab_size: int = 512) -> ModelConfig:
+    """tiny_llama with a production-shaped KV:compute ratio. The stock
+    tiny models carry ~1 KiB of KV per token — two orders of magnitude
+    leaner than a real 8B (32 layers x 8 KV heads x 128 dims), which
+    makes any KV *data-plane* measurement on them fixed-cost bound.
+    Four MHA layers at head_dim 64 put 16 KiB of f32 KV behind every
+    token, so handoff/migration payloads reach realistic MiB scale at
+    prompt lengths a CPU lane can still prefill in well under a
+    second. Unit-scale weights otherwise (d_model 128, d_ff 256)."""
+    return ModelConfig(
+        name="tiny-llama-fatkv", family="llama", vocab_size=vocab_size,
+        d_model=128, n_layers=4, n_heads=8, n_kv_heads=8, d_ff=256,
+        max_seq_len=1024, rope_theta=10000.0, head_dim_override=64,
+        dtype=jnp.float32,
+    )
+
+
 def tiny_mixtral(vocab_size: int = 512) -> ModelConfig:
     return ModelConfig(
         name="tiny-mixtral", family="mixtral", vocab_size=vocab_size,
@@ -273,6 +290,7 @@ PRESETS = {
     "phi-3-mini": phi3_mini,
     "gpt2": gpt2_small,
     "tiny-llama": tiny_llama,
+    "tiny-llama-fatkv": tiny_llama_fatkv,
     "tiny-qwen2": tiny_qwen2,
     "tiny-gemma": tiny_gemma,
     "tiny-mixtral": tiny_mixtral,
@@ -757,6 +775,21 @@ class ServerConfig:
     # JSON RPC over a local unix socket (server/worker.py +
     # server/fleet.py ProcessEngineGroup). Same facade either way.
     fleet: str = "in-process"
+    # --- Zero-copy KV data plane (README "KV data plane") ---
+    # "relay" = KV blobs (handoff/migrate/fabric/warmboot) traverse the
+    # RPC sockets through the router — the universal path. "shm" =
+    # subprocess-fleet workers write each blob ONCE into a shared-
+    # memory page arena and frames carry {seg, off, len, crc32c}
+    # descriptors instead; adopting workers read straight from the
+    # arena. Silently degrades to relay for --fleet in-process, on
+    # non-Linux hosts, or when the arena cannot be created; every
+    # arena read re-verifies crc32c and falls back to relay/recompute
+    # on any stale or corrupt slab. CLI: --kv-plane.
+    kv_plane: str = "relay"
+    # Total bytes of the shared-memory arena (split into equal
+    # per-worker regions). A blob that does not fit a region's free
+    # space relays through the router instead. CLI: --shm-arena-bytes.
+    shm_arena_bytes: int = 256 * 1024 * 1024
     # Subprocess fleet: restarts allowed per worker (with doubling
     # backoff from worker_restart_backoff_s) before it is left down and
     # the fleet serves degraded on the survivors.

@@ -535,16 +535,19 @@ def _np_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def serialize_host_pages(pages: List[HostKVPage]) -> bytes:
-    """Pack host page copies into one binary blob:
-    ``[u32 header_len][json header][raw k|v|k_scale|v_scale per page]``.
-    All pages in a batch come from one pool, so shapes/dtypes are
-    batch-constant and live once in the header."""
+def serialize_host_pages_parts(pages: List[HostKVPage]) -> List[bytes]:
+    """The blob of :func:`serialize_host_pages` as its constituent
+    buffers — ``[u32 header_len + json header, page buffers...]`` in
+    stream order. The zero-copy plane writes these straight into an
+    arena slab (RegionWriter.alloc_parts) so the payload is copied
+    exactly once, into shared memory; the relay plane joins them into
+    one frame blob. The embedded digest is chained across the parts —
+    no intermediate body concatenation on either plane."""
     import json
     import struct
 
     if not pages:
-        return struct.pack(">I", 2) + b"{}"
+        return [struct.pack(">I", 2) + b"{}"]
     first = pages[0]
     meta = {
         "n": len(pages),
@@ -562,20 +565,37 @@ def serialize_host_pages(pages: List[HostKVPage]) -> bytes:
         if meta["scaled"]:
             parts.append(np.ascontiguousarray(hp.k_scale).tobytes())
             parts.append(np.ascontiguousarray(hp.v_scale).tobytes())
-    body = b"".join(parts)
     # Per-blob digest (README "Failure model"): CRC-32C over the raw
     # page bytes, carried inside the header so every adopt/import path
     # can verify end-to-end — across processes, sockets, and any future
     # storage hop — independent of the frame-level checksum.
-    meta["crc32c"] = integrity.crc32c(body)
+    crc = 0
+    for p in parts:
+        crc = integrity.crc32c(p, crc)
+    meta["crc32c"] = crc
     header = json.dumps(meta).encode()
-    return struct.pack(">I", len(header)) + header + body
+    return [struct.pack(">I", len(header)) + header] + parts
 
 
-def deserialize_host_pages(blob: bytes) -> List[HostKVPage]:
+def serialize_host_pages(pages: List[HostKVPage]) -> bytes:
+    """Pack host page copies into one binary blob:
+    ``[u32 header_len][json header][raw k|v|k_scale|v_scale per page]``.
+    All pages in a batch come from one pool, so shapes/dtypes are
+    batch-constant and live once in the header."""
+    return b"".join(serialize_host_pages_parts(pages))
+
+
+def deserialize_host_pages(blob: bytes,
+                           copy: bool = True) -> List[HostKVPage]:
     """Inverse of :func:`serialize_host_pages`. Each returned page owns
     its bytes (copies out of the blob), so the caller may drop the blob
-    and the pages live independently in the host tier."""
+    and the pages live independently in the host tier.
+
+    ``copy=False`` returns read-only page views over the blob instead
+    (each array's ``.base`` keeps the blob alive) — the one-shot adopt
+    path hands them straight to the device restore and never needs an
+    owning copy, which at multi-MiB handoff blobs is the difference
+    between one memcpy of the payload and two."""
     import json
     import struct
 
@@ -614,7 +634,9 @@ def deserialize_host_pages(blob: bytes) -> List[HostKVPage]:
     def take(n, dtype, shape):
         nonlocal at
         arr = np.frombuffer(blob, dtype=dtype, count=int(np.prod(shape)),
-                            offset=at).reshape(shape).copy()
+                            offset=at).reshape(shape)
+        if copy:
+            arr = arr.copy()
         at += n
         return arr
 

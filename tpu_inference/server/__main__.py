@@ -305,6 +305,22 @@ def main() -> None:
                         "pages into a newly spawned worker BEFORE it "
                         "becomes routable (warm boot for autoscale "
                         "scale-ups, restarts, and rollouts); 0 = off")
+    p.add_argument("--kv-plane", default="relay",
+                   choices=("relay", "shm"),
+                   help="KV data plane (README 'KV data plane'): how KV "
+                        "payloads (fabric publishes, P/D handoffs, drain "
+                        "migrations) move between processes. 'relay' = "
+                        "blobs ride the RPC sockets through the router "
+                        "(default, works everywhere); 'shm' = payloads "
+                        "go into a shared-memory page arena and only "
+                        "descriptors cross the sockets (zero-copy; "
+                        "needs --fleet subprocess on Linux, silently "
+                        "falls back to relay otherwise)")
+    p.add_argument("--shm-arena-bytes", type=int,
+                   default=256 * 1024 * 1024,
+                   help="--kv-plane shm: total shared-memory arena size "
+                        "in bytes, split into one single-writer region "
+                        "per worker (default 256 MiB)")
     p.add_argument("--route-fabric-hit-weight", type=float, default=0.25,
                    help="prefix-affinity: pages of prefill work one "
                         "fabric-pool hit page is worth (fourth "
@@ -577,6 +593,8 @@ def main() -> None:
                               route_fabric_hit_weight=(
                                   args.route_fabric_hit_weight),
                               fleet=args.fleet,
+                              kv_plane=args.kv_plane,
+                              shm_arena_bytes=args.shm_arena_bytes,
                               worker_roles=worker_roles,
                               pd_prefill_nice=args.pd_prefill_nice,
                               worker_restart_max=args.worker_restart_max,

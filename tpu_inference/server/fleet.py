@@ -56,7 +56,7 @@ from tpu_inference.config import (FrameworkConfig, class_rank,
 from tpu_inference.engine import kv_cache as kvc
 from tpu_inference.engine.engine import Sequence
 from tpu_inference.engine.prefix_cache import _chain_hashes
-from tpu_inference.server import kv_fabric
+from tpu_inference.server import kv_fabric, shm_arena
 from tpu_inference.server.replicas import (FleetSaturated, FleetUnavailable,
                                            _RETRYABLE, _clone_request,
                                            aggregate_replica_stats)
@@ -317,7 +317,7 @@ class _Tracked:
     __slots__ = ("template", "on_token", "on_finish", "worker", "client",
                  "generation", "attempts", "tokens", "seq_local",
                  "resume_stream_len", "t_submit", "handoff_blob",
-                 "handoff_meta", "failed_workers")
+                 "handoff_desc", "handoff_meta", "failed_workers")
 
     def __init__(self, template: Sequence, on_token, on_finish):
         self.template = template
@@ -343,6 +343,11 @@ class _Tracked:
         # after a handoff can re-adopt elsewhere; once decode advanced
         # past the export, resubmission falls back to recompute-resume.
         self.handoff_blob: Optional[bytes] = None
+        # Zero-copy variant (README "KV data plane"): the export's
+        # shared-memory arena descriptor — the payload never entered
+        # this process; the decode worker adopts straight from the
+        # arena, crc-verified there, with the blob path as fallback.
+        self.handoff_desc: Optional[dict] = None
         self.handoff_meta: Optional[dict] = None
         # Poison-quarantine evidence: replica indices whose worker this
         # request's attempts CRASHED or WEDGED (not mere step errors —
@@ -509,6 +514,32 @@ class ProcessEngineGroup:
         # frames; pulls ship to the routed worker's host tier over the
         # import-kv RPC before its submit.
         self.fabric = kv_fabric.FabricPool(cfg.server.fabric_cache_pages)
+        # Zero-copy KV data plane (README "KV data plane"): one shared-
+        # memory arena for the whole fleet, one region per boot-time
+        # replica. Creation failure (or --kv-plane relay, in-process
+        # fleet, non-Linux) leaves arena=None and every path below
+        # rides the through-router relay exactly as before.
+        self.arena: Optional[shm_arena.ArenaSegment] = None
+        self._arena_dir: Optional[shm_arena.SlabDirectory] = None
+        self.shm_reclaims = 0
+        # Router-relayed KV payload bytes per RPC/event verb — the shm
+        # arm's ≈0 on handoff/fabric verbs is the lane's headline grade.
+        self.rpc_blob_bytes: Dict[str, int] = {
+            "submit": 0, "import-kv": 0, "handoff": 0, "migrate": 0,
+            "fabric_put": 0}
+        if shm_arena.effective_kv_plane(cfg.server) == "shm":
+            try:
+                self.arena = shm_arena.ArenaSegment(
+                    cfg.server.shm_arena_bytes,
+                    regions=max(4, self.dp * 2))
+                self._arena_dir = shm_arena.SlabDirectory()
+                self.fabric.on_release = self._arena_dir.release
+            except Exception as e:  # noqa: BLE001 — degrade to relay
+                telemetry.log_event(
+                    "shm_arena_unavailable", level="warning",
+                    error=str(e),
+                    note="kv_plane=shm degraded to relay")
+                self.arena = None
         self._fleet_registry = telemetry.Registry()
         self._build_registry()
 
@@ -557,6 +588,35 @@ class ProcessEngineGroup:
             "Fabric pages pulled per fabric-warm dispatch",
             buckets=telemetry.COUNT_BUCKETS)
         telemetry.register_fabric(r, self.fabric)
+        # Zero-copy KV data plane: how many KV payload bytes still
+        # traverse the router per verb (the shm plane's reason to
+        # exist is driving the handoff/fabric rows of this family to
+        # ~0), plus the arena supervisor's slab books.
+        for verb in self.rpc_blob_bytes:
+            r.counter("tpu_inf_rpc_blob_bytes_total",
+                      "KV payload bytes relayed through the router's "
+                      "RPC/event frames, by verb (descriptor frames on "
+                      "the shm plane count 0 here — the bytes stay in "
+                      "the arena)",
+                      fn=lambda v=verb: self.rpc_blob_bytes[v],
+                      verb=verb)
+        r.gauge("tpu_inf_shm_slabs_total",
+                "Arena slabs the router still tracks: live plus "
+                "released-but-not-yet-freed (frees batch to the owning "
+                "worker on its next stats tick). 0 on the relay plane.",
+                fn=lambda: float(self._arena_dir.slabs_tracked
+                                 if self._arena_dir is not None else 0))
+        r.gauge("tpu_inf_shm_slabs_used",
+                "Arena slabs still referenced by a live consumer "
+                "(fabric pool entry or pending handoff/migrate)",
+                fn=lambda: float(self._arena_dir.slabs_live
+                                 if self._arena_dir is not None else 0))
+        r.counter("tpu_inf_shm_reclaims_total",
+                  "Arena slabs reclaimed by the supervisor via the "
+                  "region epoch bump after their owning worker "
+                  "incarnation died (kill -9 mid-handoff lands here; "
+                  "the in-flight request recompute-resumes)",
+                  fn=lambda: self.shm_reclaims)
         r.counter("tpu_inf_fleet_migrations_total",
                   "In-flight requests migrated off a draining worker",
                   fn=lambda: self.migrations)
@@ -752,7 +812,7 @@ class ProcessEngineGroup:
         import jax
 
         pcfg = self.cfg.parallel
-        return {
+        env = {
             "config": framework_config_to_dict(self.cfg),
             "platform": jax.default_backend(),
             "cpu_devices": max(1, pcfg.tp * pcfg.sp),
@@ -765,12 +825,32 @@ class ProcessEngineGroup:
             # pd_prefill_nice; no-op at 0 or on per-chip deployments).
             "nice": (self.cfg.server.pd_prefill_nice
                      if self.roles[replica] == "prefill" else 0),
+            # Pool watermark at boot (satellite: publish back-pressure);
+            # the periodic stats RPC keeps it fresh afterwards.
+            "fabric_free": self.fabric.free_pages,
         }
+        if self.arena is not None:
+            # Zero-copy plane: this worker's region assignment (segment
+            # name + geometry + current epoch). None past the region
+            # count — a late autoscaled worker rides the relay plane.
+            shm = self.arena.region_spec(replica)
+            if shm is not None:
+                env["shm"] = shm
+        return env
 
     def _spawn(self, h: WorkerHandle) -> None:
         """Launch one worker incarnation and wait for its hello (which
         blocks until the worker's engine is built and warmed)."""
         h.incarnation += 1
+        if self.arena is not None and h.incarnation > 1:
+            # Supervisor reclaim (README "KV data plane"): the dead
+            # incarnation's in-flight slabs — published fabric pages, a
+            # handoff export that never got adopted — are taken back by
+            # bumping the region epoch: every outstanding descriptor
+            # fails closed (ArenaStale) and its consumer falls back to
+            # recompute/miss, never a stale adoption. The fresh
+            # incarnation mints under the new epoch from a blank region.
+            self._reclaim_region(h.replica)
         h.socket_path = os.path.join(
             self._sock_dir, f"w{h.replica}.{h.incarnation}.sock")
         env = dict(os.environ)
@@ -831,7 +911,31 @@ class ProcessEngineGroup:
         over import-kv. Each pooled blob re-verifies before shipping —
         a corrupt entry is dropped and counted, never shipped. Best
         effort: any failure leaves the worker cold but serviceable."""
-        hot = self.fabric.hot_set(self.server_cfg.fabric_warmboot_pages)
+        budget = self.server_cfg.fabric_warmboot_pages
+        adopted = 0
+        offered_d = 0
+        if self.arena is not None:
+            # Zero-copy push first: descriptors only — the fresh worker
+            # reads each slab straight from the arena and verifies it
+            # there; rejected digests come back so the pool drops them.
+            hot_d = self.fabric.hot_set_descs(budget)
+            if hot_d:
+                offered_d = len(hot_d)
+                try:
+                    r = client.rpc(
+                        "import-kv",
+                        digests=[d.hex() for d, _ in hot_d],
+                        descs=[desc for _, desc in hot_d],
+                        idem=f"wbd{h.replica}.{h.incarnation}")
+                    adopted += int(r.get("adopted", 0))
+                    for hexd in r.get("rejected_digests") or ():
+                        self.fabric.reject(bytes.fromhex(hexd))
+                except (WorkerGone, TimeoutError, RuntimeError) as e:
+                    telemetry.log_event("fabric_warmboot_failed",
+                                        level="warning",
+                                        replica=h.replica, error=str(e))
+                budget = max(0, budget - len(hot_d))
+        hot = self.fabric.hot_set(budget)
         pairs = []
         for d, b in hot:
             try:
@@ -839,23 +943,56 @@ class ProcessEngineGroup:
             except kvc.integrity.KVIntegrityError:
                 self.fabric.reject(d)
         if not pairs:
-            return 0
+            if adopted:
+                telemetry.log_event(
+                    "fabric_warmboot", level="info", replica=h.replica,
+                    offered=offered_d, adopted=adopted)
+            return adopted
         try:
+            blob = kvc.serialize_host_pages([p for _, p in pairs])
+            with self._lock:
+                self.rpc_blob_bytes["import-kv"] += len(blob)
             r = client.rpc(
-                "import-kv",
-                blob=kvc.serialize_host_pages([p for _, p in pairs]),
+                "import-kv", blob=blob,
                 digests=[d.hex() for d, _ in pairs],
                 idem=f"wb{h.replica}.{h.incarnation}")
         except (WorkerGone, TimeoutError, RuntimeError) as e:
             telemetry.log_event("fabric_warmboot_failed",
                                 level="warning", replica=h.replica,
                                 error=str(e))
-            return 0
-        adopted = int(r.get("adopted", 0))
+            return adopted
+        adopted += int(r.get("adopted", 0))
         telemetry.log_event(
             "fabric_warmboot", level="info", replica=h.replica,
-            offered=len(pairs), adopted=adopted)
+            offered=offered_d + len(pairs), adopted=adopted)
         return adopted
+
+    def _reclaim_region(self, rg: int) -> int:
+        """Dead-incarnation slab reclaim: drop the region's fabric
+        entries, settle the directory books, bump the epoch word so
+        every outstanding descriptor fails closed."""
+        if self.arena is None or self._arena_dir is None \
+                or not (0 <= rg < self.arena.regions):
+            return 0
+        dropped = self.fabric.drop_region(rg)
+        n = self._arena_dir.reclaim(rg)
+        self.arena.bump_epoch(rg)
+        with self._lock:
+            self.shm_reclaims += n
+        if n or dropped:
+            telemetry.log_event(
+                "shm_region_reclaimed", level="info", region=rg,
+                slabs=n, fabric_entries=dropped)
+        return n
+
+    def _release_handoff_desc(self, entry: "_Tracked") -> None:
+        """Drop a tracked handoff's arena slab reference (idempotent).
+        Called wherever the blob variant would be dropped — the slab
+        frees back to its owner on the next stats tick."""
+        desc = entry.handoff_desc
+        entry.handoff_desc = None
+        if desc is not None and self._arena_dir is not None:
+            self._arena_dir.release(desc)
 
     def _ensure_started(self) -> None:
         with self._start_lock:
@@ -928,11 +1065,18 @@ class ProcessEngineGroup:
             for q in self._deferred.values():
                 q.clear()
         for entry in leftovers:
+            self._release_handoff_desc(entry)
             self._finish_trace(entry, "shutdown")
             ghost = entry.seq_local
             ghost.done, ghost.finish_reason = True, "shutdown"
             ghost.finish_time = time.perf_counter()
             entry.on_finish(ghost)
+        if self.arena is not None:
+            # Every worker is dead: unlink the segment (the kernel
+            # reclaims the pages; attached mappings, if any, die with
+            # their processes).
+            self.arena.close(unlink=True)
+            self.arena = None
 
     # ------------------------------------------------------ supervision
 
@@ -970,13 +1114,27 @@ class ProcessEngineGroup:
         for h in self.workers:
             if h.state != UP or h.client is None:
                 continue
+            # The stats tick doubles as the data-plane's control
+            # channel: the pool watermark rides out (publish
+            # back-pressure) and the batched slab frees ride out
+            # (arena lifecycle) — no extra RPCs on the hot path.
+            frees = (self._arena_dir.drain_free(h.replica)
+                     if self._arena_dir is not None else [])
             try:
                 h.last_metrics = h.client.rpc("metrics")["samples"]
-                h.last_stats = h.client.rpc("stats")["stats"]
+                h.last_stats = h.client.rpc(
+                    "stats", fabric_free=self.fabric.free_pages,
+                    arena_free=frees)["stats"]
+                frees = []
                 h.last_health = h.client.rpc("healthz")
                 h.last_steps = h.client.rpc("steps")["steps"]
             except (WorkerGone, TimeoutError, RuntimeError):
                 pass
+            finally:
+                if frees and self._arena_dir is not None:
+                    # The tick that would have carried them failed —
+                    # retry next second (a free lost forever is a leak).
+                    self._arena_dir.requeue_free(h.replica, frees)
 
     def _schedule_restart(self, h: WorkerHandle) -> None:
         scfg = self.server_cfg
@@ -998,6 +1156,9 @@ class ProcessEngineGroup:
             telemetry.log_event("worker_quarantined", level="error",
                                 replica=h.replica, restarts=h.restarts,
                                 consecutive_failures=h.consecutive_failures)
+            # No respawn will ever bump this region's epoch — reclaim
+            # its slabs now or they pin arena memory forever.
+            self._reclaim_region(h.replica)
             return
         backoff = min(30.0, scfg.worker_restart_backoff_s
                       * (2 ** max(0, h.consecutive_failures)))
@@ -1477,12 +1638,39 @@ class ProcessEngineGroup:
         if h.client is None:
             return 0
         digests = self._digests_for(t)[0]
-        entries = self.fabric.get_pages(
-            digests[warm:warm + fabric_extra])
+        want = digests[warm:warm + fabric_extra]
+        if self.arena is not None:
+            # Zero-copy pull: ship descriptors; the worker reads each
+            # slab from the arena, crc-verifies it there, and reports
+            # rejects back so the pool drops them. No KV byte touches
+            # a socket or this process.
+            descs = self.fabric.get_descs(want)
+            if descs:
+                try:
+                    r = h.client.rpc(
+                        "import-kv",
+                        digests=[d.hex() for d, _ in descs],
+                        descs=[dd for _, dd in descs],
+                        idem=f"fd{t.request_id}.{entry.attempts}."
+                             f"{entry.generation}")
+                    rejected = r.get("rejected_digests") or ()
+                    for hexd in rejected:
+                        self.fabric.reject(bytes.fromhex(hexd))
+                    if not r.get("applied"):
+                        return 0
+                    return max(0, len(descs) - len(rejected))
+                except (WorkerGone, TimeoutError, RuntimeError) as e:
+                    telemetry.log_event("fabric_pull_failed",
+                                        level="warning",
+                                        replica=h.replica, error=str(e))
+                    return 0
+        entries = self.fabric.get_pages(want)
         if not entries:
             return 0
         try:
             blob = kvc.serialize_host_pages([p for _, p in entries])
+            with self._lock:
+                self.rpc_blob_bytes["import-kv"] += len(blob)
             r = h.client.rpc(
                 "import-kv", blob=blob,
                 digests=[d.hex() for d, _ in entries],
@@ -1615,13 +1803,20 @@ class ProcessEngineGroup:
         with self._lock:
             entry.worker, entry.client = h, h.client
         hbm, host, fabric_extra = hit
+        meta = entry.handoff_meta
+        live_handoff = (meta is not None
+                        and bool(entry.handoff_blob or entry.handoff_desc)
+                        and len(gen_tokens) == meta["n_generated"])
         # Fabric pull (README "KV fabric"): pages the router's pool
         # covers beyond this worker's own warm depth ship to its host
         # tier over the import-kv RPC BEFORE the submit — the verb
         # replies only after the engine loop applied the import, so
-        # this request's prefill is guaranteed to see them.
+        # this request's prefill is guaranteed to see them. A live
+        # handoff dispatch skips it: the attempt already carries the
+        # full KV, and pre-warming the same pages is a redundant
+        # import-kv round trip on the handoff critical path.
         fabric_pulled = 0
-        if fabric_extra > 0:
+        if fabric_extra > 0 and not live_handoff:
             fabric_pulled = self._fabric_pull(
                 h, t, hbm + host, fabric_extra, entry)
         total_hit = hbm + host + fabric_pulled
@@ -1668,15 +1863,19 @@ class ProcessEngineGroup:
             "generated": gen_tokens,
         }
         blob = b""
-        meta = entry.handoff_meta
         if meta is not None:
-            if (entry.handoff_blob
-                    and len(gen_tokens) == meta["n_generated"]):
+            if live_handoff:
                 # Live handoff resume: the worker adopts the exported KV
                 # (incl. the partial final page) and continues decode
-                # with zero recomputed tokens.
+                # with zero recomputed tokens. On the shm plane the
+                # frame carries only the arena descriptor — the decode
+                # worker reads+verifies the slab itself and falls back
+                # to recompute-resume on any stale/corrupt read.
                 payload["handoff"] = {"ctx_len": meta["ctx_len"]}
-                blob = entry.handoff_blob
+                if entry.handoff_desc is not None:
+                    payload["handoff"]["kv_desc"] = entry.handoff_desc
+                else:
+                    blob = entry.handoff_blob
             else:
                 # Decode advanced past the export (the blob was dropped
                 # at the first post-handoff token, or the length no
@@ -1684,6 +1883,7 @@ class ProcessEngineGroup:
                 # fall back to recompute-resume from the router's token
                 # record, byte-identical under greedy.
                 entry.handoff_blob = entry.handoff_meta = None
+                self._release_handoff_desc(entry)
                 with self._lock:
                     self.pd_handoff_recomputes += 1
         # Idempotency token, unique per dispatch attempt: a duplicate
@@ -1692,6 +1892,9 @@ class ProcessEngineGroup:
         # attempt.
         idem = f"s{t.request_id}.{entry.attempts}.{entry.generation}"
         try:
+            if blob:
+                with self._lock:
+                    self.rpc_blob_bytes["submit"] += len(blob)
             h.client.rpc("submit", seq=payload, blob=blob, idem=idem)
             return True
         except (WorkerGone, RuntimeError) as e:
@@ -1760,6 +1963,7 @@ class ProcessEngineGroup:
                             request_id=rid, attempts=entry.attempts)
         with self._lock:
             self._tracked.pop(rid, None)
+            self._release_handoff_desc(entry)
         self._finish_trace(entry, "unavailable")
         ghost = entry.seq_local
         ghost.done, ghost.finish_reason = True, "unavailable"
@@ -1772,6 +1976,7 @@ class ProcessEngineGroup:
             if entry is not None:
                 entry.generation += 1
                 h = entry.worker
+                self._release_handoff_desc(entry)
         if entry is None or h is None or h.client is None:
             return
 
@@ -1824,6 +2029,25 @@ class ProcessEngineGroup:
         never be adopted. A frame whose lengths disagree with the blob
         is dropped whole — never partially ingested."""
         digests = obj.get("digests") or ()
+        descs = obj.get("descs")
+        if descs is not None:
+            # Zero-copy publish: descriptors only — register each slab
+            # with the supervisor's ledger, pool the descriptor. The
+            # payload bytes never traversed this socket (the verb's
+            # rpc_blob_bytes row stays at 0, the lane's grade).
+            if len(digests) != len(descs) or blob:
+                with self._lock:
+                    self.frame_errors += 1
+                telemetry.log_event(
+                    "fabric_put_malformed", level="warning",
+                    replica=h.replica, digests=len(digests),
+                    descs=len(descs), blob_bytes=len(blob))
+                return
+            for d, desc in zip(digests, descs):
+                if self._arena_dir is not None:
+                    self._arena_dir.register(desc)
+                self.fabric.put_desc(bytes.fromhex(d), desc)
+            return
         lens = obj.get("lens") or ()
         if len(digests) != len(lens) or sum(lens) != len(blob):
             with self._lock:
@@ -1833,6 +2057,8 @@ class ProcessEngineGroup:
                 replica=h.replica, digests=len(digests),
                 lens=len(lens), blob_bytes=len(blob))
             return
+        with self._lock:
+            self.rpc_blob_bytes["fabric_put"] += len(blob)
         off = 0
         for d, n in zip(digests, lens):
             self.fabric.put_blob(bytes.fromhex(d), blob[off:off + n])
@@ -1873,7 +2099,8 @@ class ProcessEngineGroup:
             return
         with self._lock:
             meta = entry.handoff_meta
-            if (entry.handoff_blob is not None and meta is not None
+            if ((entry.handoff_blob is not None
+                 or entry.handoff_desc is not None) and meta is not None
                     and len(entry.tokens) > meta["n_generated"]):
                 # The adopter streamed past the export: the blob can
                 # never be dispatched again (a re-adoption would fork
@@ -1882,6 +2109,7 @@ class ProcessEngineGroup:
                 # small meta stays so a later failover still counts as
                 # a handoff recompute in _dispatch.
                 entry.handoff_blob = None
+                self._release_handoff_desc(entry)
             sl = entry.seq_local
             sl.generated.append(tok)
             if sl.first_token_time == 0.0:
@@ -1934,6 +2162,7 @@ class ProcessEngineGroup:
                 self.retries_attempted += 1
             else:
                 self._tracked.pop(rid, None)
+                self._release_handoff_desc(entry)
                 if entry.attempts and reason in ("stop", "length"):
                     self.retries_succeeded += 1
             # Migration accounting: the resume stream this attempt
@@ -2033,7 +2262,21 @@ class ProcessEngineGroup:
         n_gen = int(obj.get("n_generated", 0))
         entry.handoff_meta = {"ctx_len": int(obj.get("ctx_len", 0)),
                               "n_generated": n_gen}
-        blob = self._checked_blob(blob, "handoff", rid)
+        kv_desc = obj.get("kv_desc")
+        if kv_desc is not None:
+            # Zero-copy handoff: the export rode the arena, only this
+            # descriptor crossed the socket. Register the slab so the
+            # leak ledger tracks it until the decode worker adopted (or
+            # every fallback released it).
+            if self._arena_dir is not None:
+                self._arena_dir.register(kv_desc)
+            entry.handoff_desc = dict(kv_desc)
+            blob = b""
+        else:
+            if blob:
+                with self._lock:
+                    self.rpc_blob_bytes["handoff"] += len(blob)
+            blob = self._checked_blob(blob, "handoff", rid)
         entry.handoff_blob = blob or None
         if n_gen != len(entry.tokens):
             # Out of sync with the export (events are FIFO per
@@ -2044,6 +2287,7 @@ class ProcessEngineGroup:
                 worker_generated=n_gen,
                 router_streamed=len(entry.tokens))
             entry.handoff_blob = entry.handoff_meta = None
+            self._release_handoff_desc(entry)
             with self._lock:
                 self.pd_handoff_recomputes += 1
         pool = [w for w in self._phase_pool("decode") if w is not h]
@@ -2054,13 +2298,33 @@ class ProcessEngineGroup:
             # Point-to-point handoff lost its destination: park the
             # settled prefix in the fabric pool so whichever worker the
             # grace-window retry eventually finds pulls it from the
-            # pool instead of re-prefilling the whole stream.
+            # pool instead of re-prefilling the whole stream. A
+            # descriptor export is materialized from the arena first
+            # (the salvage outlives the slab's region).
+            if not blob and entry.handoff_desc is not None \
+                    and self.arena is not None:
+                try:
+                    blob = self.arena.read(entry.handoff_desc)
+                except shm_arena.ArenaError:
+                    blob = b""
+                self._release_handoff_desc(entry)
+                entry.handoff_blob = blob or None
             self._fabric_salvage(
                 self._digests_for(entry.template)[0], blob, rid,
                 "handoff")
             self._retry_or_fail(entry)     # already claimed above
             return
-        dest, hit, _ = self._pick(pool, entry.template, phase="decode")
+        if len(pool) == 1 and (entry.handoff_blob
+                               or entry.handoff_desc is not None):
+            # Forced choice: one decode candidate and a live export in
+            # hand. The peek RPC would only rank a single option, and
+            # the dispatch carries the full KV so warmth cannot change
+            # the answer — skip the round trip on the handoff critical
+            # path.
+            dest, hit = pool[0], (0, 0, 0)
+        else:
+            dest, hit, _ = self._pick(pool, entry.template,
+                                      phase="decode")
         telemetry.log_event(
             "request_handoff", level="info",
             request_id=entry.template.trace_id or str(rid),
@@ -2117,6 +2381,12 @@ class ProcessEngineGroup:
                 request_id=entry.template.trace_id or str(rid),
                 worker_generated=n_gen, router_streamed=len(entry.tokens))
         digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
+        kv_desc = obj.get("kv_desc")
+        if kv_desc is not None and self._arena_dir is not None:
+            self._arena_dir.register(kv_desc)
+        if blob:
+            with self._lock:
+                self.rpc_blob_bytes["migrate"] += len(blob)
         blob = self._checked_blob(blob, "migrate", rid)
         phase = self._entry_phase(entry)
         others = ([w for w in self._phase_pool(phase) if w is not h]
@@ -2125,7 +2395,15 @@ class ProcessEngineGroup:
             # Migration lost its destination: park the exported pages
             # in the fabric pool (keyed by the digests the export
             # carried) so the grace-window retry's dispatch pulls them
-            # back instead of recompute-prefilling the stream.
+            # back instead of recompute-prefilling the stream. A
+            # descriptor export is materialized from the arena first.
+            if not blob and kv_desc is not None and self.arena is not None:
+                try:
+                    blob = self.arena.read(kv_desc)
+                except shm_arena.ArenaError:
+                    blob = b""
+            if kv_desc is not None and self._arena_dir is not None:
+                self._arena_dir.release(kv_desc)
             self._fabric_salvage(digests, blob, rid, "migrate")
             # No exclude: this entry is already claimed (detached) by
             # the block above and no dispatch was attempted — the guard
@@ -2133,9 +2411,32 @@ class ProcessEngineGroup:
             self._retry_or_fail(entry)
             return
         dest, hit, _ = self._pick(others, entry.template, phase=phase)
-        if (blob and digests and self.server_cfg.fleet_migrate
+        if (kv_desc is not None and digests
+                and self.server_cfg.fleet_migrate
+                and dest.client is not None):
+            # Zero-copy migrate: forward the descriptor; the destination
+            # adopts straight from the arena. The router never touches
+            # the payload bytes.
+            try:
+                r = dest.client.rpc(
+                    "import-kv", kv_desc=kv_desc,
+                    digests=[d.hex() for d in digests],
+                    idem=f"i{rid}.{entry.generation}")
+                with self._lock:
+                    self.migrated_pages += int(r.get("adopted", 0))
+                    self.migrated_bytes += int(kv_desc.get("len", 0))
+                hit = self._peek_hit(dest, entry.template)
+            except (WorkerGone, TimeoutError, RuntimeError) as e:
+                telemetry.log_event("migrate_import_failed",
+                                    level="warning", error=str(e))
+            finally:
+                if self._arena_dir is not None:
+                    self._arena_dir.release(kv_desc)
+        elif (blob and digests and self.server_cfg.fleet_migrate
                 and dest.client is not None):
             try:
+                with self._lock:
+                    self.rpc_blob_bytes["import-kv"] += len(blob)
                 r = dest.client.rpc(
                     "import-kv", blob=blob,
                     digests=[d.hex() for d in digests],
@@ -2149,6 +2450,10 @@ class ProcessEngineGroup:
             except (WorkerGone, TimeoutError, RuntimeError) as e:
                 telemetry.log_event("migrate_import_failed",
                                     level="warning", error=str(e))
+        elif kv_desc is not None and self._arena_dir is not None:
+            # Import preconditions failed (migration disabled, no
+            # digests): the descriptor has no consumer — release it.
+            self._arena_dir.release(kv_desc)
         telemetry.log_event(
             "request_migrated", level="warning",
             request_id=entry.template.trace_id or str(rid),

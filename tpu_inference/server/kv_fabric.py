@@ -42,11 +42,18 @@ from tpu_inference.engine import kv_cache as kvc
 
 
 class _Entry:
-    __slots__ = ("blob", "nbytes")
+    """One pooled page: either the serialized blob itself (relay
+    plane) or a shared-memory arena descriptor (shm plane — the bytes
+    never entered this process; ``nbytes`` is the slab length)."""
 
-    def __init__(self, blob: bytes):
+    __slots__ = ("blob", "desc", "nbytes")
+
+    def __init__(self, blob: Optional[bytes] = None,
+                 desc: Optional[dict] = None):
         self.blob = blob
-        self.nbytes = len(blob)
+        self.desc = desc
+        self.nbytes = len(blob) if blob is not None \
+            else int(desc["len"])
 
 
 class FabricPool:
@@ -72,29 +79,62 @@ class FabricPool:
         self.superseded = 0            # puts that replaced a live entry
         self.evictions = 0             # LRU capacity drops
         self.kv_rejections = 0         # corrupt entries dropped on get
+        # Zero-copy plane hook (server/shm_arena): called with the
+        # arena descriptor of every desc-entry this pool stops
+        # referencing (evict, supersede, reject, clear, region drop) —
+        # the fleet releases the slab back to its owning worker.
+        self.on_release = None
 
     # ------------------------------------------------------------- put
+
+    def _release_entry(self, e: "_Entry") -> None:
+        """Hand a desc-entry's slab back to the release hook (the
+        supervisor's slab ledger) — without it, a dropped descriptor
+        pins arena memory until the region's next epoch reclaim."""
+        if e.desc is not None and self.on_release is not None:
+            try:
+                self.on_release(e.desc)
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
+
+    def _drop_entry(self, e: "_Entry") -> None:
+        """Lock held by caller; entry WAS resident: settle the byte
+        books and release its slab."""
+        self._bytes -= e.nbytes
+        self._release_entry(e)
+
+    def _put_entry(self, digest: bytes, e: "_Entry") -> None:
+        if self.capacity <= 0:
+            # Never resident — no byte books to settle, but a
+            # descriptor's slab still needs its release.
+            self._release_entry(e)
+            return
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._drop_entry(old)
+                self.superseded += 1
+            while len(self._entries) >= self.capacity:
+                _, ev = self._entries.popitem(last=False)
+                self._drop_entry(ev)
+                self.evictions += 1
+            self._entries[digest] = e
+            self._bytes += e.nbytes
+            self.puts += 1
 
     def put_blob(self, digest: bytes, blob: bytes) -> None:
         """Insert/supersede ONE page's serialized blob under its chain
         digest. Re-publishing the same prefix from a second replica
         stores once (byte-identical pages; the fresh blob supersedes),
         and the entry moves to MRU either way."""
-        if self.capacity <= 0:
-            return
-        with self._lock:
-            old = self._entries.pop(digest, None)
-            if old is not None:
-                self._bytes -= old.nbytes
-                self.superseded += 1
-            while len(self._entries) >= self.capacity:
-                _, ev = self._entries.popitem(last=False)
-                self._bytes -= ev.nbytes
-                self.evictions += 1
-            e = _Entry(blob)
-            self._entries[digest] = e
-            self._bytes += e.nbytes
-            self.puts += 1
+        self._put_entry(digest, _Entry(blob=blob))
+
+    def put_desc(self, digest: bytes, desc: dict) -> None:
+        """Zero-copy publish: pool the arena DESCRIPTOR of one page's
+        blob — the payload stays in the worker-written slab, never
+        traverses the router. Integrity moves to adoption time: the
+        reading worker verifies crc32c and reports rejects back."""
+        self._put_entry(digest, _Entry(desc=dict(desc)))
 
     def put_pages(self, pairs: Sequence[Tuple[bytes, "kvc.HostKVPage"]]
                   ) -> int:
@@ -135,7 +175,10 @@ class FabricPool:
                 e = self._entries.get(d)
                 if e is not None:
                     self._entries.move_to_end(d)
-            if e is None:
+            if e is None or e.blob is None:
+                # Absent — or a desc-entry: the blob lives in the
+                # arena, not this process; the shm plane pulls it via
+                # get_descs and the adopting worker's direct read.
                 self.misses += 1
                 break
             try:
@@ -144,7 +187,7 @@ class FabricPool:
                 with self._lock:
                     live = self._entries.pop(d, None)
                     if live is not None:
-                        self._bytes -= live.nbytes
+                        self._drop_entry(live)
                 self.kv_rejections += 1
                 self.misses += 1
                 break
@@ -152,15 +195,50 @@ class FabricPool:
             out.append((d, page))
         return out
 
+    def get_descs(self, digests: Sequence[bytes]
+                  ) -> List[Tuple[bytes, dict]]:
+        """Zero-copy pull: the contiguous run of DESC-entries for
+        ``digests`` — counted like get_pages, but no bytes move here;
+        the adopting worker reads + crc-verifies each slab itself and
+        reports rejects back (``reject``). A blob entry ends the run
+        (the relay path serves it on the next pull)."""
+        out: List[Tuple[bytes, dict]] = []
+        for d in digests:
+            with self._lock:
+                e = self._entries.get(d)
+                if e is not None and e.desc is not None:
+                    self._entries.move_to_end(d)
+            if e is None or e.desc is None:
+                self.misses += 1
+                break
+            self.hits += 1
+            out.append((d, dict(e.desc)))
+        return out
+
     def reject(self, digest: bytes) -> None:
-        """Drop a corrupt entry discovered OUTSIDE get_pages (e.g. the
-        warmboot re-verify) — counted exactly like a get-time
-        integrity rejection, never adopted silently."""
+        """Drop a corrupt entry discovered OUTSIDE get_pages (the
+        warmboot re-verify, or a worker-side arena read that failed
+        crc) — counted exactly like a get-time integrity rejection,
+        never adopted silently."""
         with self._lock:
             live = self._entries.pop(digest, None)
             if live is not None:
-                self._bytes -= live.nbytes
+                self._drop_entry(live)
         self.kv_rejections += 1
+
+    def drop_region(self, rg: int) -> int:
+        """Reclaim support: drop every desc-entry whose slab lives in
+        arena region ``rg`` (its owning worker incarnation died; the
+        epoch bump already made the descriptors fail closed). Returns
+        entries dropped. Not an eviction and not a rejection — the
+        pages were fine, their backing store went away."""
+        with self._lock:
+            dead = [d for d, e in self._entries.items()
+                    if e.desc is not None
+                    and int(e.desc.get("rg", -1)) == int(rg)]
+            for d in dead:
+                self._drop_entry(self._entries.pop(d))
+            return len(dead)
 
     def hot_set(self, max_pages: int) -> List[Tuple[bytes, bytes]]:
         """The MRU-first (digest, blob) list for warm worker boot —
@@ -170,9 +248,22 @@ class FabricPool:
         if max_pages <= 0:
             return []
         with self._lock:
-            ds = list(self._entries)[-max_pages:]
+            ds = [d for d in self._entries
+                  if self._entries[d].blob is not None][-max_pages:]
             ds.reverse()
             return [(d, self._entries[d].blob) for d in ds]
+
+    def hot_set_descs(self, max_pages: int) -> List[Tuple[bytes, dict]]:
+        """MRU-first (digest, descriptor) list — the shm plane's warm
+        worker boot: the fresh worker adopts straight from the arena,
+        verifying each slab itself."""
+        if max_pages <= 0:
+            return []
+        with self._lock:
+            ds = [d for d in self._entries
+                  if self._entries[d].desc is not None][-max_pages:]
+            ds.reverse()
+            return [(d, dict(self._entries[d].desc)) for d in ds]
 
     # ------------------------------------------------------ accounting
 
@@ -183,6 +274,13 @@ class FabricPool:
     @property
     def bytes_used(self) -> int:
         return self._bytes
+
+    @property
+    def free_pages(self) -> int:
+        """Pool watermark the router advertises to workers (satellite:
+        publish back-pressure) — publishes larger than this are
+        instant-evict churn and get skipped at the source."""
+        return max(0, self.capacity - self.used)
 
     def snapshot(self) -> Dict[str, int]:
         """Operator view for /healthz (both fleet backends emit the
@@ -201,6 +299,8 @@ class FabricPool:
 
     def clear(self) -> None:
         with self._lock:
+            for e in self._entries.values():
+                self._drop_entry(e)
             self._entries.clear()
             self._bytes = 0
 

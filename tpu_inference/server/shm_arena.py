@@ -1,0 +1,431 @@
+"""Zero-copy KV data plane: the shared-memory page arena.
+
+Every KV movement the fleet performs — P/D handoff, drain migration,
+fabric publish/pull, warm boot — used to serialize pages into a blob
+and relay it THROUGH the router over the JSON-framed RPC, paying 4+
+full copies per transfer. The arena cuts that to one copy: the owning
+worker writes the serialized blob into a shared-memory slab once, and
+frames carry a compact descriptor ``{seg, rg, off, len, crc, gen, ep}``
+instead of the payload. The adopting worker reads the slab directly.
+
+Layout
+------
+One ``multiprocessing.shared_memory`` segment, created and owned by the
+ROUTER (workers attach read/write but never create or unlink), split
+into fixed equal regions — one per worker replica. Single-writer
+discipline makes the allocator trivial and portable: only region
+``rg``'s worker allocates or frees slabs inside region ``rg``; the
+router writes nothing but the per-region epoch word.
+
+* Region header: one big-endian u32 EPOCH word at the region base.
+  The router bumps it when the region's worker is respawned or
+  quarantined — every descriptor minted by the dead incarnation then
+  fails closed (``ArenaStale``), which is how in-flight slabs of a
+  kill -9'd worker are reclaimed without any cooperation from it.
+* Slab: 8-byte header ``[u32 gen][u32 len]`` followed by the payload,
+  16-byte aligned extents. ``gen`` is a per-incarnation monotonic
+  nonzero counter; ``free()`` zeroes the gen word so a stale
+  descriptor read fails closed instead of returning recycled bytes.
+
+Integrity
+---------
+A read validates epoch word -> slab gen/len -> payload crc32c (the
+PR-15 checksum, carried in the descriptor), copies the payload out,
+then RE-validates epoch+gen — a slab freed and recycled mid-copy is
+detected, never silently adopted. Failures are typed: ``ArenaStale``
+(epoch/gen moved — a reclaim or free raced the read; fall back to
+recompute/miss) vs ``ArenaCorrupt`` (length/crc mismatch — count it as
+an integrity rejection like any corrupt KV blob).
+
+Lifecycle
+---------
+The ROUTER is the consumer-side authority: it tracks outstanding slabs
+in a ``SlabDirectory``, releases them when the pooled/handoff entry is
+dropped, and batches the frees back to the owning worker on the
+periodic stats RPC (the worker applies them to its allocator). When a
+worker dies, the router reclaims the region at respawn/quarantine time
+— count the still-registered slabs, drop them, bump the epoch.
+"""
+
+from __future__ import annotations
+
+import struct
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tpu_inference.server.transport import crc32c
+
+
+def effective_kv_plane(server_cfg) -> str:
+    """Resolve --kv-plane against reality (the README "KV data plane"
+    decision table): shm only helps — and only works — when workers are
+    separate OS processes on a host with POSIX shared memory. Anything
+    else silently rides the relay plane; the knob is a request, not a
+    promise."""
+    if getattr(server_cfg, "kv_plane", "relay") != "shm":
+        return "relay"
+    if getattr(server_cfg, "fleet", "in-process") != "subprocess":
+        return "relay"
+    if not sys.platform.startswith("linux"):
+        return "relay"
+    return "shm"
+
+_EPOCH = struct.Struct(">I")
+_SLAB = struct.Struct(">II")          # gen, payload length
+_ALIGN = 16
+# First allocatable byte of a region: the epoch word, padded to one
+# alignment unit so slab extents never straddle it.
+_REGION_HDR = _ALIGN
+
+
+class ArenaError(Exception):
+    """Base for arena read/alloc failures; carries a short reason."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+class ArenaStale(ArenaError):
+    """Epoch or generation moved under the descriptor (free, recycle,
+    or a supervisor reclaim) — not corruption; fall back to the relay
+    or recompute path."""
+
+
+class ArenaCorrupt(ArenaError):
+    """Length or crc32c mismatch — treat exactly like a corrupt KV
+    blob: reject, count, never adopt."""
+
+
+class ArenaFull(ArenaError):
+    """No free extent fits the payload; caller falls back to the
+    through-router relay path."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__("full", detail)
+
+
+# Segments THIS process created (ArenaSegment) or already detached:
+# attach() must unregister a cross-process mapping from the resource
+# tracker exactly once — and never strip the owner's own registration
+# (same-process attach happens in tests and the in-process fallback).
+_OWNED: set = set()
+_DETACHED: set = set()
+
+
+def attach(name: str):
+    """Attach an existing segment WITHOUT adopting ownership: Python
+    3.10's SharedMemory registers every mapping with the
+    resource_tracker, whose cleanup would unlink the router-owned
+    segment when this (worker) process exits — unregister right away."""
+    from multiprocessing import shared_memory
+    shm = shared_memory.SharedMemory(name=name)
+    if name not in _OWNED and name not in _DETACHED:
+        _DETACHED.add(name)
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:  # noqa: BLE001 — tracker internals; best effort
+            pass
+    return shm
+
+
+def _validate_header(buf, desc, region_bytes: int) -> None:
+    off, length = int(desc["off"]), int(desc["len"])
+    rg = int(desc["rg"])
+    base = rg * region_bytes
+    if not (base + _REGION_HDR <= off - _SLAB.size
+            and off + length <= base + region_bytes
+            and off + length <= len(buf)):
+        raise ArenaCorrupt("bounds", f"off={off} len={length} rg={rg}")
+    (epoch,) = _EPOCH.unpack_from(buf, base)
+    if epoch != int(desc["ep"]):
+        raise ArenaStale("epoch", f"region {rg}: {epoch} != {desc['ep']}")
+    gen, slab_len = _SLAB.unpack_from(buf, off - _SLAB.size)
+    if gen != int(desc["gen"]):
+        raise ArenaStale("gen", f"slab@{off}: {gen} != {desc['gen']}")
+    if slab_len != length:
+        raise ArenaCorrupt("len", f"slab@{off}: {slab_len} != {length}")
+
+
+def read_slab(buf, desc: dict, region_bytes: int) -> bytes:
+    """Validate + copy a slab payload out of the segment. The
+    post-copy re-validation closes the torn-read window: the owner may
+    free (gen -> 0) or the supervisor reclaim (epoch bump) the slab
+    while the copy is in flight — the recycled bytes must never be
+    returned as if they were the descriptor's payload."""
+    _validate_header(buf, desc, region_bytes)
+    off, length = int(desc["off"]), int(desc["len"])
+    payload = bytes(buf[off:off + length])
+    if crc32c(payload) != int(desc["crc"]):
+        raise ArenaCorrupt("crc", f"slab@{off}")
+    _validate_header(buf, desc, region_bytes)
+    return payload
+
+
+class RegionWriter:
+    """Owner-side slab allocator for ONE region (single writer: the
+    worker process assigned to it). First-fit free list with adjacent-
+    extent coalescing; per-slab accounting so a leak is visible as
+    ``slabs_used`` that never returns to zero."""
+
+    def __init__(self, buf, region: int, region_bytes: int, epoch: int,
+                 seg: str):
+        self._buf = buf
+        self.region = int(region)
+        self.region_bytes = int(region_bytes)
+        self.epoch = int(epoch)
+        self.seg = seg
+        base = self.region * self.region_bytes
+        self._free: List[Tuple[int, int]] = [
+            (base + _REGION_HDR, self.region_bytes - _REGION_HDR)]
+        # payload offset -> (gen, extent offset, extent length)
+        self._slabs: Dict[int, Tuple[int, int, int]] = {}
+        self._gen = 0
+        self._lock = threading.Lock()
+        self.alloc_failures = 0
+
+    @property
+    def slabs_used(self) -> int:
+        return len(self._slabs)
+
+    @property
+    def bytes_used(self) -> int:
+        return sum(ext_len for _, _, ext_len in self._slabs.values())
+
+    def alloc(self, payload: bytes) -> dict:
+        """Write one slab; returns the wire descriptor. Raises
+        ArenaFull when no extent fits (caller relays instead)."""
+        return self.alloc_parts((payload,))
+
+    def alloc_parts(self, parts) -> dict:
+        """Write one slab from a sequence of buffers (the serialized
+        blob's constituent parts, kv_cache.serialize_host_pages_parts).
+        Gather-writing straight into the slab skips the ``b"".join``
+        the relay frame needs — the payload is copied exactly once, and
+        the descriptor crc is chained across the parts on the way in."""
+        length = sum(len(p) for p in parts)
+        need = _SLAB.size + length
+        need += (-need) % _ALIGN
+        with self._lock:
+            for i, (off, size) in enumerate(self._free):
+                if size >= need:
+                    if size == need:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = (off + need, size - need)
+                    self._gen = (self._gen % 0xFFFFFFFE) + 1
+                    gen = self._gen
+                    _SLAB.pack_into(self._buf, off, gen, length)
+                    pay_off = off + _SLAB.size
+                    at, crc = pay_off, 0
+                    for p in parts:
+                        self._buf[at:at + len(p)] = p
+                        crc = crc32c(p, crc)
+                        at += len(p)
+                    self._slabs[pay_off] = (gen, off, need)
+                    return {"seg": self.seg, "rg": self.region,
+                            "off": pay_off, "len": length,
+                            "crc": crc, "gen": gen,
+                            "ep": self.epoch}
+            self.alloc_failures += 1
+            raise ArenaFull(f"{length}B, region {self.region}")
+
+    def free(self, pay_off: int) -> bool:
+        """Release a slab by payload offset (idempotent — the router
+        may double-free across a reconnect resync). Zeroes the gen
+        word first so concurrent readers fail closed."""
+        with self._lock:
+            slab = self._slabs.pop(int(pay_off), None)
+            if slab is None:
+                return False
+            _, ext_off, ext_len = slab
+            _SLAB.pack_into(self._buf, ext_off, 0, 0)
+            self._free.append((ext_off, ext_len))
+            self._free.sort()
+            merged: List[Tuple[int, int]] = []
+            for off, size in self._free:
+                if merged and merged[-1][0] + merged[-1][1] == off:
+                    merged[-1] = (merged[-1][0], merged[-1][1] + size)
+                else:
+                    merged.append((off, size))
+            self._free = merged
+            return True
+
+
+class WorkerArena:
+    """Worker-side facade: attach the router's segment once, write
+    into THIS worker's region, read any region's slabs. Counts the
+    zero-copy plane's traffic for the kv_plane_shm metric family."""
+
+    def __init__(self, spec: dict):
+        self.seg = spec["seg"]
+        self.region = int(spec["region"])
+        self.region_bytes = int(spec["region_bytes"])
+        self.shm = attach(self.seg)
+        self.writer = RegionWriter(self.shm.buf, self.region,
+                                   self.region_bytes, int(spec["epoch"]),
+                                   self.seg)
+        self.puts = 0
+        self.gets = 0
+        self.put_bytes = 0
+        self.get_bytes = 0
+
+    def publish(self, payload: bytes) -> dict:
+        return self.publish_parts((payload,))
+
+    def publish_parts(self, parts) -> dict:
+        desc = self.writer.alloc_parts(parts)
+        self.puts += 1
+        self.put_bytes += desc["len"]
+        return desc
+
+    def read(self, desc: dict) -> bytes:
+        if desc.get("seg") != self.seg:
+            raise ArenaStale("seg", f"{desc.get('seg')} != {self.seg}")
+        payload = read_slab(self.shm.buf, desc, self.region_bytes)
+        self.gets += 1
+        self.get_bytes += len(payload)
+        return payload
+
+    def free(self, pay_off: int) -> bool:
+        return self.writer.free(pay_off)
+
+    def close(self) -> None:
+        try:
+            self.shm.close()
+        except (OSError, BufferError):
+            pass
+
+
+class ArenaSegment:
+    """Router-side owner: creates the segment, assigns regions, bumps
+    epochs at reclaim, and unlinks at teardown. The router never
+    allocates slabs — it only reads descriptors' geometry and writes
+    epoch words."""
+
+    def __init__(self, total_bytes: int, regions: int):
+        from multiprocessing import shared_memory
+        regions = max(1, int(regions))
+        region_bytes = max(_REGION_HDR + _ALIGN,
+                           (int(total_bytes) // regions) & ~(_ALIGN - 1))
+        self.region_bytes = region_bytes
+        self.regions = regions
+        self.shm = shared_memory.SharedMemory(
+            create=True, size=region_bytes * regions)
+        self.name = self.shm.name
+        _OWNED.add(self.name)
+        for rg in range(regions):
+            _EPOCH.pack_into(self.shm.buf, rg * region_bytes, 1)
+        self._closed = False
+
+    def region_spec(self, rg: int) -> Optional[dict]:
+        """Boot-envelope entry for one worker, or None when the
+        replica index is past the region count (autoscaled workers
+        beyond the boot-time fleet fall back to the relay plane)."""
+        if not (0 <= rg < self.regions) or self._closed:
+            return None
+        return {"seg": self.name, "region": rg,
+                "region_bytes": self.region_bytes,
+                "epoch": self.epoch(rg)}
+
+    def epoch(self, rg: int) -> int:
+        (ep,) = _EPOCH.unpack_from(self.shm.buf, rg * self.region_bytes)
+        return ep
+
+    def bump_epoch(self, rg: int) -> int:
+        """Invalidate every outstanding descriptor of region ``rg``
+        (dead-incarnation reclaim). Returns the new epoch the fresh
+        incarnation will mint descriptors under."""
+        ep = (self.epoch(rg) % 0xFFFFFFFE) + 1
+        _EPOCH.pack_into(self.shm.buf, rg * self.region_bytes, ep)
+        return ep
+
+    def read(self, desc: dict) -> bytes:
+        return read_slab(self.shm.buf, desc, self.region_bytes)
+
+    def close(self, unlink: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.shm.close()
+        except (OSError, BufferError):
+            pass
+        if unlink:
+            try:
+                self.shm.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+        _OWNED.discard(self.name)
+        _DETACHED.discard(self.name)
+
+
+class SlabDirectory:
+    """Router-side ledger of outstanding slabs: registered when a
+    descriptor arrives (fabric put, handoff, migrate), released when
+    its last consumer drops it, drained as per-region free batches for
+    the periodic stats RPC, and reclaimed wholesale — with a count —
+    when the owning incarnation dies."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._live: Dict[Tuple[int, int], dict] = {}
+        self._pending: Dict[int, List[int]] = {}
+        self.reclaims = 0
+        self.released = 0
+
+    @property
+    def slabs_live(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    @property
+    def slabs_tracked(self) -> int:
+        """Live + released-but-not-yet-freed (the owner applies frees
+        on its next stats tick)."""
+        with self._lock:
+            return len(self._live) + sum(
+                len(v) for v in self._pending.values())
+
+    def register(self, desc: dict) -> None:
+        with self._lock:
+            self._live[(int(desc["rg"]), int(desc["off"]))] = desc
+
+    def release(self, desc: dict) -> None:
+        """Idempotent: only a tracked slab moves to the pending-free
+        batch (a double release or a release after reclaim is a
+        no-op)."""
+        key = (int(desc["rg"]), int(desc["off"]))
+        with self._lock:
+            if self._live.pop(key, None) is None:
+                return
+            self._pending.setdefault(key[0], []).append(key[1])
+            self.released += 1
+
+    def drain_free(self, rg: int) -> List[int]:
+        with self._lock:
+            return self._pending.pop(int(rg), [])
+
+    def requeue_free(self, rg: int, offs: List[int]) -> None:
+        """Put a drained batch back (the stats RPC that would have
+        carried it failed; retry next tick)."""
+        if not offs:
+            return
+        with self._lock:
+            self._pending.setdefault(int(rg), []).extend(offs)
+
+    def reclaim(self, rg: int) -> int:
+        """Drop everything the dead incarnation owned. The epoch bump
+        (ArenaSegment.bump_epoch) makes the dropped descriptors fail
+        closed; this just settles the books and reports how many
+        slabs the supervisor took back."""
+        rg = int(rg)
+        with self._lock:
+            dead = [k for k in self._live if k[0] == rg]
+            for k in dead:
+                del self._live[k]
+            n = len(dead) + len(self._pending.pop(rg, []))
+            self.reclaims += n
+            return n

@@ -61,13 +61,29 @@ class FrameError(ConnectionError):
 
 
 def _read_exact(rfile, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = rfile.read(n - len(buf))
-        if not chunk:
-            raise FrameError("eof", "peer closed mid-frame")
-        buf += chunk
-    return buf
+    """Exact-size read without quadratic concat: one allocation,
+    ``readinto`` a sliding memoryview. A 1 MB KV blob arriving in 64 KB
+    socket chunks used to pay ~16 progressively larger copies; now it
+    pays one. Returns immutable bytes — deserialize_host_pages builds
+    numpy views over the result, so handing out a reusable buffer
+    would alias pages across frames."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    reader = getattr(rfile, "readinto", None)
+    while got < n:
+        if reader is not None:
+            k = reader(view[got:])
+            if not k:
+                raise FrameError("eof", "peer closed mid-frame")
+            got += k
+        else:
+            chunk = rfile.read(n - got)
+            if not chunk:
+                raise FrameError("eof", "peer closed mid-frame")
+            view[got:got + len(chunk)] = chunk
+            got += len(chunk)
+    return bytes(buf)
 
 
 def recv_frame(rfile) -> Tuple[dict, bytes]:
@@ -100,12 +116,31 @@ def recv_frame(rfile) -> Tuple[dict, bytes]:
     return obj, blob
 
 
-def encode_frame(obj: dict, blob: bytes = b"") -> bytes:
+def _frame_head(obj: dict, blob: bytes) -> bytes:
     payload = json.dumps(obj, separators=(",", ":")).encode()
     lens = struct.pack(">II", len(payload), len(blob))
     crc = crc32c(blob, crc32c(payload, crc32c(lens)))
-    return _HEADER.pack(_MAGIC, len(payload), len(blob), crc) \
-        + payload + blob
+    return _HEADER.pack(_MAGIC, len(payload), len(blob), crc) + payload
+
+
+def encode_frame(obj: dict, blob: bytes = b"") -> bytes:
+    return _frame_head(obj, blob) + blob
+
+
+def _sendmsg_all(sock: socket.socket, head: bytes, blob: bytes) -> None:
+    """Vectored send: header+json and the blob go out as one gather
+    write, so the blob is never copied into a header+blob bytes object
+    first (encode_frame's concat doubled the transient footprint of
+    every KV transfer). Loops on partial sends — sendmsg may land any
+    prefix of the iovec."""
+    bufs = [memoryview(head), memoryview(blob)]
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if bufs and sent:
+            bufs[0] = bufs[0][sent:]
 
 
 def send_frame(sock: socket.socket, obj: dict, blob: bytes = b"", *,
@@ -115,11 +150,17 @@ def send_frame(sock: socket.socket, obj: dict, blob: bytes = b"", *,
     one is armed. Chaos faults surface as ConnectionError (drop/tear)
     or silently swallowed writes (wedge) — exactly the failure shapes a
     real broken transport produces."""
-    data = encode_frame(obj, blob)
-    if chaos is None:
-        sock.sendall(data)
+    if chaos is not None:
+        # Chaos needs the full contiguous frame (corrupt/truncate act
+        # on absolute byte offsets); it is a test-only shim, so the
+        # concat copy is acceptable there.
+        chaos.send(sock, encode_frame(obj, blob), verb, direction)
         return
-    chaos.send(sock, data, verb, direction)
+    head = _frame_head(obj, blob)
+    if blob and hasattr(sock, "sendmsg"):
+        _sendmsg_all(sock, head, blob)
+    else:
+        sock.sendall(head + blob)
 
 
 class ChaosPolicy:

@@ -185,6 +185,55 @@ class EngineWorker:
         self.chaos_rpc = self._build_chaos_rpc()
         self._idem: "OrderedDict[str, dict]" = OrderedDict()
         self._idem_lock = threading.Lock()
+        # Zero-copy KV plane (README "KV data plane"): the router's
+        # boot envelope may carry a shared-memory region spec; when
+        # attached, KV exports (fabric publish, P/D handoff, drain
+        # migrate) write payloads into the arena and ship descriptors
+        # instead of blobs. None = relay plane (blobs over the socket).
+        self._arena = None
+        # Router pool watermark (fabric back-pressure): free pages the
+        # fabric pool advertised at boot, refreshed on every stats
+        # tick. None = no watermark yet, publish freely.
+        self._fabric_free = None
+        self.fabric_publish_skipped = 0
+
+    def attach_arena(self, spec) -> None:
+        """Map the router's shm segment from a boot-envelope region
+        spec. Failure is not fatal — the worker simply stays on the
+        relay plane (every payload rides the socket)."""
+        if not spec:
+            return
+        from tpu_inference import telemetry
+        from tpu_inference.server import shm_arena
+        try:
+            self._arena = shm_arena.WorkerArena(spec)
+        except Exception as e:  # noqa: BLE001 — relay fallback, not fatal
+            self._arena = None
+            telemetry.log_event(
+                "shm_arena_attach_failed", level="warning",
+                replica=self.replica, error=str(e))
+
+    def _arena_blob(self, desc, path: str):
+        """Materialize a descriptor's payload from the arena, typed by
+        failure: returns (blob, rejected) where rejected=True means the
+        slab FAILED ITS INTEGRITY CHECK (counted, the router must drop
+        the descriptor) and blob=b'' with rejected=False means the slab
+        is stale/unreachable (epoch bumped after a reclaim, arena not
+        attached) — the caller falls back to recompute/relay."""
+        from tpu_inference import telemetry
+        from tpu_inference.server import shm_arena
+        if self._arena is None or desc is None:
+            return b"", False
+        try:
+            return self._arena.read(desc), False
+        except shm_arena.ArenaCorrupt as e:
+            self.engine.kv_integrity_rejections += 1
+            telemetry.log_event(
+                "arena_slab_rejected", level="error", path=path,
+                replica=self.replica, reason=e.reason, detail=e.detail)
+            return b"", True
+        except shm_arena.ArenaError:
+            return b"", False
 
     def _build_chaos_rpc(self, over: Dict[str, Any] = None):
         """Worker-side chaos transport from config knobs (+ runtime
@@ -252,6 +301,33 @@ class EngineWorker:
             spec_mode=(self.engine.spec_mode if self.engine.spec_enabled
                        else "off"),
             routing=cfg.server.routing)
+        # Zero-copy KV plane counters (README "KV data plane"): arena
+        # traffic this worker moved without a socket copy, plus the
+        # publishes the fabric watermark gated off. Registered on the
+        # relay plane too (flat zeros) so dashboards join across arms.
+        reg = self.engine.telemetry.registry
+        reg.counter(
+            "tpu_inf_kv_plane_shm_puts_total",
+            "KV payloads published into the shm arena",
+            fn=lambda: self._arena.puts if self._arena else 0)
+        reg.counter(
+            "tpu_inf_kv_plane_shm_gets_total",
+            "KV payloads adopted out of the shm arena",
+            fn=lambda: self._arena.gets if self._arena else 0)
+        reg.counter(
+            "tpu_inf_kv_plane_shm_bytes_total",
+            "bytes moved through the shm arena by direction",
+            fn=lambda: self._arena.put_bytes if self._arena else 0,
+            op="put")
+        reg.counter(
+            "tpu_inf_kv_plane_shm_bytes_total",
+            "bytes moved through the shm arena by direction",
+            fn=lambda: self._arena.get_bytes if self._arena else 0,
+            op="get")
+        reg.counter(
+            "tpu_inf_fabric_publish_skipped_total",
+            "fabric publishes skipped by the pool-watermark gate",
+            fn=lambda: self.fabric_publish_skipped)
         if self.role == "prefill":
             self.sched.on_prefill_handoff = self._emit_handoff
         # Fleet KV fabric (README "KV fabric"): arm the engine's
@@ -327,11 +403,51 @@ class EngineWorker:
     def _publish_fabric(self, pairs) -> None:
         """Ship settled prefix pages to the router's fabric pool
         (engine thread, via _publish_to_fabric). Each page is
-        serialized individually — the pool stores per-page blobs so
-        entries evict independently and every get re-verifies its own
-        crc32c — and the frame carries the per-blob lengths so the
-        router slices without a deserialize on its event thread."""
+        serialized individually — the pool stores per-page entries so
+        they evict independently and every adoption re-verifies its own
+        crc32c.
+
+        Back-pressure gate first (README "KV fabric"): the router
+        advertises its pool's free-page watermark (boot envelope +
+        every stats tick); a publish that cannot fit would only be
+        serialized, shipped, and evicted on arrival — skip it here and
+        count the skip instead.
+
+        On the shm plane the payloads go into this worker's arena
+        region and only descriptors cross the socket; a full region
+        falls back to the relay frame for the overflow pages."""
         from tpu_inference.engine import kv_cache as kvc
+        from tpu_inference.server import shm_arena
+        free = self._fabric_free
+        if free is not None:
+            if len(pairs) > free:
+                self.fabric_publish_skipped += len(pairs)
+                return
+            self._fabric_free = free - len(pairs)
+        if self._arena is not None:
+            hex_descs, descs, relay = [], [], []
+            for d, p in pairs:
+                blob = kvc.serialize_host_pages([p])
+                try:
+                    descs.append(self._arena.publish(blob))
+                    hex_descs.append(d.hex())
+                except shm_arena.ArenaFull:
+                    relay.append((d, blob))
+            if descs:
+                self._broadcast({"ev": "fabric_put",
+                                 "digests": hex_descs,
+                                 "descs": descs,
+                                 "replica": self.replica},
+                                verb="fabric_put")
+            if not relay:
+                return
+            self._broadcast({"ev": "fabric_put",
+                             "digests": [d.hex() for d, _ in relay],
+                             "lens": [len(b) for _, b in relay],
+                             "replica": self.replica},
+                            b"".join(b for _, b in relay),
+                            verb="fabric_put")
+            return
         blobs = [kvc.serialize_host_pages([p]) for _, p in pairs]
         self._broadcast({"ev": "fabric_put",
                          "digests": [d.hex() for d, _ in pairs],
@@ -427,21 +543,43 @@ class EngineWorker:
             return False
         if not pages:
             return False
-        blob = kvc.serialize_host_pages(pages)
+        parts = kvc.serialize_host_pages_parts(pages)
+        total = sum(len(p) for p in parts)
         # Trace span: the live KV export — adjacent to (never
         # overlapping) this worker's prefill span and the decode
-        # worker's handoff_adopt on the assembled timeline.
+        # worker's handoff_adopt on the assembled timeline. It ends
+        # HERE, before the payload leaves for the data plane: the
+        # gather+serialize is identical work on every plane, while the
+        # arena publish (shm) and the frame send (relay) are the data
+        # plane itself and belong to the handoff window that follows.
+        t_ser = time.perf_counter()
+        # Zero-copy plane: the serialized parts gather-write into one
+        # arena slab — the payload's single copy — and only the
+        # descriptor rides the handoff frame; the decode worker adopts
+        # straight from shared memory. A full region falls back to the
+        # relay frame (the parts join into a blob over the socket).
+        kv_desc = None
+        if self._arena is not None:
+            from tpu_inference.server import shm_arena
+            try:
+                kv_desc = self._arena.publish_parts(parts)
+            except shm_arena.ArenaFull:
+                kv_desc = None
         self.engine.telemetry.recorder.add(
             "handoff_export", seq.trace_id or str(seq.request_id),
-            t0, time.perf_counter(), pages=len(pages), bytes=len(blob),
-            ctx_len=ctx_len)
+            t0, t_ser, pages=len(pages), bytes=total,
+            ctx_len=ctx_len, plane="shm" if kv_desc else "relay")
         self._req_conn.pop(seq.request_id, None)
-        conn.send({"ev": "handoff", "rid": seq.request_id,
-                   "n_generated": len(seq.generated),
-                   "ctx_len": ctx_len,
-                   "export_s": round(time.perf_counter() - t0, 6),
-                   "digests": [d.hex() for d in digests]}, blob,
-                  verb="handoff")
+        ev = {"ev": "handoff", "rid": seq.request_id,
+              "n_generated": len(seq.generated),
+              "ctx_len": ctx_len,
+              "export_s": round(time.perf_counter() - t0, 6),
+              "digests": [d.hex() for d in digests]}
+        if kv_desc is not None:
+            ev["kv_desc"] = kv_desc
+            conn.send(ev, verb="handoff")
+        else:
+            conn.send(ev, b"".join(parts), verb="handoff")
         return True
 
     def _verb_hello(self, conn, obj, blob) -> dict:
@@ -503,6 +641,13 @@ class EngineWorker:
             seq.generated = list(generated)
             seq.resume_base = len(generated)
         handoff = s.get("handoff")
+        if handoff and not blob and handoff.get("kv_desc") is not None:
+            # Zero-copy adoption: pull the export straight out of the
+            # arena slab the prefill worker wrote. A stale slab (owner
+            # died, region reclaimed) or a failed crc leaves blob empty
+            # and the recompute-resume fallback below takes over —
+            # byte-identical under greedy, exactly the relay semantics.
+            blob, _ = self._arena_blob(handoff["kv_desc"], "handoff")
         if handoff and blob and generated:
             # P/D handoff resume (README "P/D disaggregation"): the blob
             # carries the prefill worker's settled KV pages (incl. the
@@ -511,7 +656,10 @@ class EngineWorker:
             # back to the recompute-resume path above at adoption time.
             from tpu_inference.engine import kv_cache as kvc
             try:
-                pages = kvc.deserialize_host_pages(blob)
+                # copy=False: the adopt path hands the pages straight
+                # to the device restore — views over the blob (kept
+                # alive by the arrays) skip a full payload copy.
+                pages = kvc.deserialize_host_pages(blob, copy=False)
             except KVIntegrityError:
                 # Corrupt blob: rejected AND counted — never adopted.
                 self.engine.kv_integrity_rejections += 1
@@ -522,6 +670,8 @@ class EngineWorker:
                 seq.adopt_kv = (pages, int(handoff.get("ctx_len", 0)))
             else:
                 self.engine.adopt_fallbacks += 1
+        elif handoff and generated and not blob:
+            self.engine.adopt_fallbacks += 1
         if self.role == "prefill" and seq.adopt_kv is None:
             # Prefill-role workers hand every prefill they settle off to
             # the decode tier (adoptions skip _prefill_done, so an
@@ -631,6 +781,17 @@ class EngineWorker:
                      / max(e.ladder[-1], 1), 4)
 
     def _verb_stats(self, conn, obj, blob) -> dict:
+        # The router's stats tick doubles as the data plane's control
+        # channel: the fabric pool's free-page watermark rides in
+        # (publish back-pressure) and the batched arena slab frees ride
+        # in (descriptor lifecycle — the router freed every consumer).
+        ff = obj.get("fabric_free")
+        if ff is not None:
+            self._fabric_free = int(ff)
+        frees = obj.get("arena_free")
+        if frees and self._arena is not None:
+            for off in frees:
+                self._arena.free(int(off))
         return {"stats": self.sched.stats.snapshot(self.engine)}
 
     def _verb_steps(self, conn, obj, blob) -> dict:
@@ -753,9 +914,25 @@ class EngineWorker:
     def _verb_import_kv(self, conn, obj, blob) -> dict:
         """Adopt a sibling replica's drain export into the host tier.
         Replies only after the engine loop APPLIED the import, so the
-        router's subsequent resubmit is guaranteed to see the pages."""
+        router's subsequent resubmit is guaranteed to see the pages.
+
+        Three payload shapes: a concatenated blob (relay plane), a list
+        of per-page arena descriptors (``descs`` — fabric warmboot and
+        fabric pulls on the shm plane), or one multi-page descriptor
+        (``kv_desc`` — drain migrate on the shm plane). Descriptor
+        reads that fail integrity come back in ``rejected_digests`` so
+        the router evicts the poisoned pool entries."""
         from tpu_inference.engine import kv_cache as kvc
+        descs = obj.get("descs")
+        if descs is not None:
+            return self._import_kv_descs(obj.get("digests") or (), descs)
         digests = [bytes.fromhex(d) for d in obj.get("digests") or ()]
+        if not blob and obj.get("kv_desc") is not None:
+            blob, rejected = self._arena_blob(obj["kv_desc"], "migrate")
+            if not blob:
+                return {"offered": 0, "applied": False, "adopted": 0,
+                        "rejected": "arena slab unreadable"
+                        if not rejected else "arena slab corrupt"}
         try:
             pages = kvc.deserialize_host_pages(blob) if blob else []
         except KVIntegrityError as e:
@@ -773,6 +950,42 @@ class EngineWorker:
         applied = done.wait(timeout=10.0)
         return {"offered": n, "applied": bool(applied),
                 "adopted": self.engine.migrate_in_pages - before}
+
+    def _import_kv_descs(self, hex_digests, descs) -> dict:
+        """Descriptor-list import (shm plane): read each per-page slab
+        from the arena, deserialize its single-page blob, and offer the
+        survivors to the host tier. Integrity failures (slab crc, page
+        digest) are counted AND reported back by digest so the router
+        drops the unusable pool entries; stale slabs are simply skipped
+        (the pull falls back to recompute warmth)."""
+        from tpu_inference.engine import kv_cache as kvc
+        offers, rejected_hex = [], []
+        for hexd, desc in zip(hex_digests, descs):
+            pblob, rejected = self._arena_blob(desc, "fabric_pull")
+            if not pblob:
+                if rejected:
+                    rejected_hex.append(hexd)
+                continue
+            try:
+                pgs = kvc.deserialize_host_pages(pblob)
+            except KVIntegrityError:
+                self.engine.kv_integrity_rejections += 1
+                rejected_hex.append(hexd)
+                continue
+            except Exception:  # noqa: BLE001 — skip, recompute covers it
+                continue
+            if pgs:
+                offers.append((bytes.fromhex(hexd), pgs[0]))
+        if not offers:
+            return {"offered": 0, "applied": False, "adopted": 0,
+                    "rejected_digests": rejected_hex}
+        before = self.engine.migrate_in_pages
+        done = self.engine.request_import_host(offers)
+        self.sched.kick()
+        applied = done.wait(timeout=10.0)
+        return {"offered": len(offers), "applied": bool(applied),
+                "adopted": self.engine.migrate_in_pages - before,
+                "rejected_digests": rejected_hex}
 
     def _verb_drain(self, conn, obj, blob) -> dict:
         migrate = obj.get("migrate")
@@ -889,6 +1102,16 @@ class EngineWorker:
                   "spans": engine.telemetry.recorder.export_open(tid)}
             blob = (kvc.serialize_host_pages(host_pages)
                     if host_pages else b"")
+            if blob and self._arena is not None:
+                # Zero-copy migrate: the export outlives this process
+                # in the arena (the segment is router-owned); only the
+                # descriptor rides the event. Region full → relay blob.
+                from tpu_inference.server import shm_arena
+                try:
+                    ev["kv_desc"] = self._arena.publish(blob)
+                    blob = b""
+                except shm_arena.ArenaFull:
+                    pass
             target = self._req_conn.get(seq.request_id)
             if target is not None and target.alive:
                 target.send(ev, blob, verb="migrate")
@@ -972,6 +1195,13 @@ def main() -> None:
     worker = EngineWorker(cfg, replica=args.replica,
                           socket_path=args.socket,
                           warmup=bool(envelope.get("warmup", True)))
+    # Zero-copy KV plane (README "KV data plane"): the router ships
+    # this worker's arena region spec plus the fabric pool's current
+    # free-page watermark; both are absent on the relay plane.
+    worker.attach_arena(envelope.get("shm"))
+    ff = envelope.get("fabric_free")
+    if ff is not None:
+        worker._fabric_free = int(ff)
 
     def _sigterm(signum, frame):
         # Signal-handler context: just flag; the drain thread does the
